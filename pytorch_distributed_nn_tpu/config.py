@@ -1,0 +1,215 @@
+"""Config / flag system.
+
+The reference configures each trainer with argparse flags (``--rank``,
+``--world-size``, ``--backend``, ``--lr``, …; SURVEY.md §5 "Config/flag
+system"). Here configs are typed dataclasses with dotted CLI overrides
+(``--optim.lr=0.1``), and the five benchmark configs from
+/root/repo/BASELINE.json:6-12 are named presets.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Any
+
+from pytorch_distributed_nn_tpu.runtime.mesh import MeshSpec
+
+
+@dataclass
+class OptimConfig:
+    name: str = "sgd"  # sgd | momentum | adam | adamw
+    lr: float = 0.01
+    momentum: float = 0.9
+    weight_decay: float = 0.0
+    b1: float = 0.9
+    b2: float = 0.999
+    eps: float = 1e-8
+    grad_clip_norm: float = 0.0  # 0 = off
+    warmup_steps: int = 0
+    schedule: str = "constant"  # constant | cosine | linear
+
+
+@dataclass
+class DataConfig:
+    dataset: str = "mnist"  # mnist | cifar10 | imagenet_synthetic | lm_synthetic
+    batch_size: int = 128  # global batch size
+    num_workers: int = 2
+    seq_len: int = 512
+    vocab_size: int = 32000
+    synthetic: bool = True  # zero-egress environment: synthetic by default
+    prefetch: int = 2
+
+
+@dataclass
+class ModelConfig:
+    name: str = "mlp"  # mlp | lenet | resnet50 | bert_base | transformer_lm | llama3_8b
+    dtype: str = "float32"  # param dtype
+    compute_dtype: str = "bfloat16"
+    remat: bool = False  # jax.checkpoint on blocks
+    extra: dict[str, Any] = field(default_factory=dict)
+
+
+@dataclass
+class ParallelConfig:
+    strategy: str = "dp"  # single | dp | zero | pipeline | ps
+    # DDP-style bucket controller (SURVEY.md §2b Reducer row):
+    bucket_mb: float = 25.0
+    overlap: bool = True
+    zero_stage: int = 3  # 1 = optimizer-state shard; 3 = params too
+    microbatches: int = 1  # pipeline microbatching
+    pipeline_schedule: str = "gpipe"  # gpipe | 1f1b
+    quantized_allreduce: bool = False  # EQuARX-style int8 grad allreduce
+
+
+@dataclass
+class TrainConfig:
+    preset: str = ""
+    seed: int = 0
+    steps: int = 100
+    log_every: int = 10
+    eval_every: int = 0
+    checkpoint_dir: str = ""
+    checkpoint_every: int = 0
+    resume: bool = True
+    profile_dir: str = ""
+    mesh: MeshSpec = field(default_factory=MeshSpec)
+    optim: OptimConfig = field(default_factory=OptimConfig)
+    data: DataConfig = field(default_factory=DataConfig)
+    model: ModelConfig = field(default_factory=ModelConfig)
+    parallel: ParallelConfig = field(default_factory=ParallelConfig)
+
+    def override(self, **dotted: Any) -> "TrainConfig":
+        cfg = copy.deepcopy(self)  # nested sub-configs must not alias self's
+        for key, value in dotted.items():
+            _set_dotted(cfg, key.replace("-", "_"), value)
+        return cfg
+
+
+def _set_dotted(obj: Any, dotted: str, value: Any) -> None:
+    parts = dotted.split(".")
+    for part in parts[:-1]:
+        obj = getattr(obj, part)
+    leaf = parts[-1]
+    if not hasattr(obj, leaf):
+        raise AttributeError(f"unknown config field {dotted!r}")
+    current = getattr(obj, leaf)
+    if current is not None and not isinstance(value, type(current)):
+        if isinstance(current, bool):
+            value = str(value).lower() in ("1", "true", "yes", "on")
+        elif isinstance(current, (int, float)):
+            value = type(current)(value)
+    setattr(obj, leaf, value)
+
+
+def parse_overrides(argv: list[str]) -> dict[str, str]:
+    """Parse ``--a.b=c`` / ``--a.b c`` style CLI overrides."""
+    out: dict[str, str] = {}
+    i = 0
+    while i < len(argv):
+        arg = argv[i]
+        if not arg.startswith("--"):
+            raise ValueError(f"unexpected argument {arg!r}")
+        arg = arg[2:]
+        if "=" in arg:
+            key, value = arg.split("=", 1)
+        else:
+            if i + 1 >= len(argv):
+                raise ValueError(f"flag --{arg} expects a value")
+            key, value = arg, argv[i + 1]
+            i += 1
+        out[key] = value
+        i += 1
+    return out
+
+
+# ---------------------------------------------------------------------------
+# The five benchmark presets (BASELINE.json "configs", lines 6-12).
+# ---------------------------------------------------------------------------
+
+def _mlp_mnist() -> TrainConfig:
+    # Config 1: "2-layer MLP on MNIST, single process (gloo backend, CPU)".
+    # Gloo-on-CPU maps to the XLA host platform (SURVEY.md §4).
+    return TrainConfig(
+        preset="mlp_mnist",
+        steps=200,
+        optim=OptimConfig(name="momentum", lr=0.01),
+        data=DataConfig(dataset="mnist", batch_size=128),
+        model=ModelConfig(name="mlp", compute_dtype="float32"),
+        parallel=ParallelConfig(strategy="dp"),
+    )
+
+
+def _resnet50_dp() -> TrainConfig:
+    # Config 2: "ResNet-50 / ImageNet, pure data-parallel DDP allreduce".
+    return TrainConfig(
+        preset="resnet50_dp",
+        steps=100,
+        optim=OptimConfig(name="momentum", lr=0.1, weight_decay=1e-4,
+                          warmup_steps=5, schedule="cosine"),
+        data=DataConfig(dataset="imagenet_synthetic", batch_size=1024),
+        model=ModelConfig(name="resnet50"),
+        parallel=ParallelConfig(strategy="dp", bucket_mb=25.0, overlap=True),
+    )
+
+
+def _bert_base_buckets() -> TrainConfig:
+    # Config 3: "BERT-base pretraining, large fused gradient buckets".
+    return TrainConfig(
+        preset="bert_base_buckets",
+        steps=100,
+        optim=OptimConfig(name="adamw", lr=1e-4, weight_decay=0.01,
+                          warmup_steps=10, schedule="linear"),
+        data=DataConfig(dataset="lm_synthetic", batch_size=256, seq_len=128,
+                        vocab_size=30522),
+        model=ModelConfig(name="bert_base"),
+        parallel=ParallelConfig(strategy="dp", bucket_mb=100.0, overlap=True),
+    )
+
+
+def _transformer_lm_pp() -> TrainConfig:
+    # Config 4: "Transformer-LM pipeline-parallel (send/recv p2p)".
+    return TrainConfig(
+        preset="transformer_lm_pp",
+        steps=50,
+        mesh=MeshSpec(pipe=4, data=-1),
+        optim=OptimConfig(name="adam", lr=3e-4, warmup_steps=10,
+                          schedule="cosine"),
+        data=DataConfig(dataset="lm_synthetic", batch_size=64, seq_len=1024),
+        model=ModelConfig(name="transformer_lm", remat=True),
+        parallel=ParallelConfig(strategy="pipeline", microbatches=8,
+                                pipeline_schedule="gpipe"),
+    )
+
+
+def _llama3_8b_zero() -> TrainConfig:
+    # Config 5: "Llama-3-8B sharded data-parallel (allgather params +
+    # reduce-scatter grads)".
+    return TrainConfig(
+        preset="llama3_8b_zero",
+        steps=20,
+        mesh=MeshSpec(fsdp=-1, data=1),
+        optim=OptimConfig(name="adamw", lr=3e-4, weight_decay=0.1,
+                          grad_clip_norm=1.0, warmup_steps=10,
+                          schedule="cosine"),
+        data=DataConfig(dataset="lm_synthetic", batch_size=16, seq_len=4096,
+                        vocab_size=128256),
+        model=ModelConfig(name="llama3_8b", remat=True),
+        parallel=ParallelConfig(strategy="zero", zero_stage=3),
+    )
+
+
+PRESETS = {
+    "mlp_mnist": _mlp_mnist,
+    "resnet50_dp": _resnet50_dp,
+    "bert_base_buckets": _bert_base_buckets,
+    "transformer_lm_pp": _transformer_lm_pp,
+    "llama3_8b_zero": _llama3_8b_zero,
+}
+
+
+def get_config(preset: str, **overrides: Any) -> TrainConfig:
+    if preset not in PRESETS:
+        raise KeyError(f"unknown preset {preset!r}; have {sorted(PRESETS)}")
+    cfg = PRESETS[preset]()
+    return cfg.override(**overrides) if overrides else cfg

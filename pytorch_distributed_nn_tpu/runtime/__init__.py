@@ -1,0 +1,13 @@
+"""Runtime layer: device topology, process bootstrap, launch, checkpoint,
+profiling — the TPU-native replacement for the reference's launch scripts,
+``torchrun`` rendezvous, and c10d process-group plumbing (SURVEY.md §1
+"Launch / CLI" and "Communication backend" rows)."""
+
+from pytorch_distributed_nn_tpu.runtime.mesh import (
+    AXES,
+    MeshSpec,
+    make_mesh,
+    make_abstract_mesh,
+)
+
+__all__ = ["AXES", "MeshSpec", "make_mesh", "make_abstract_mesh"]

@@ -1,0 +1,90 @@
+"""Process bootstrap — the ``torchrun`` / ``dist.init_process_group``
+replacement (SURVEY.md §3.5: TCPStore rendezvous → backend pg → barrier).
+
+TPU-native flow: each host process calls :func:`initialize` once;
+``jax.distributed.initialize`` connects to the coordinator (rank 0), PJRT
+enumerates the local chips, and the global device list becomes visible to
+every process. Environment variables mirror the reference's contract
+(``RANK``/``WORLD_SIZE``/``MASTER_ADDR``/``MASTER_PORT`` — SURVEY.md §1
+Launch row) with JAX-native names taking precedence.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+
+import jax
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class ProcessInfo:
+    process_index: int
+    process_count: int
+    coordinator: str
+    local_device_count: int
+    global_device_count: int
+
+    @property
+    def is_coordinator(self) -> bool:
+        return self.process_index == 0
+
+
+def _env(*names: str, default: str | None = None) -> str | None:
+    for name in names:
+        if name in os.environ:
+            return os.environ[name]
+    return default
+
+
+def initialize(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> ProcessInfo:
+    """Initialize multi-process JAX. Single-process (the common test and
+    single-host case) needs no rendezvous and is a no-op.
+
+    Resolution order for each field: explicit argument → JAX-native env var
+    (``COORDINATOR_ADDRESS``/``NUM_PROCESSES``/``PROCESS_ID``) → the
+    reference's torch-style env contract (``MASTER_ADDR:MASTER_PORT`` /
+    ``WORLD_SIZE`` / ``RANK``).
+    """
+    if num_processes is None:
+        raw = _env("NUM_PROCESSES", "WORLD_SIZE", default="1")
+        num_processes = int(raw)
+    if process_id is None:
+        process_id = int(_env("PROCESS_ID", "RANK", default="0"))
+    if coordinator_address is None:
+        coordinator_address = _env("COORDINATOR_ADDRESS")
+        if coordinator_address is None:
+            addr = _env("MASTER_ADDR", default="127.0.0.1")
+            port = _env("MASTER_PORT", default="12355")
+            coordinator_address = f"{addr}:{port}"
+
+    if num_processes > 1:
+        log.info(
+            "jax.distributed.initialize(%s, num_processes=%d, process_id=%d)",
+            coordinator_address, num_processes, process_id,
+        )
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes,
+            process_id=process_id,
+        )
+
+    return ProcessInfo(
+        process_index=jax.process_index(),
+        process_count=jax.process_count(),
+        coordinator=coordinator_address,
+        local_device_count=jax.local_device_count(),
+        global_device_count=jax.device_count(),
+    )
+
+
+def shutdown() -> None:
+    if jax.process_count() > 1:
+        jax.distributed.shutdown()

@@ -1,0 +1,154 @@
+"""Device mesh / topology.
+
+The reference delegates topology to c10d process groups: a flat
+``rank``/``world_size`` with NCCL communicators built per collective
+(SURVEY.md §1 "Communication backend"; §3.5 init/rendezvous). TPU-native
+design replaces the flat rank world with a *named* ``jax.sharding.Mesh``
+whose axes map onto the hardware fabric:
+
+- inner axes (``tensor``, ``seq``) ride ICI — highest bandwidth, so they
+  carry the per-layer collectives (TP all-reduce, ring-attention ppermute);
+- ``fsdp`` (sharded-DP / ZeRO) sits next — its all-gather/reduce-scatter
+  wants ICI too;
+- outer axes (``data``, ``pipe``) can span DCN across slices — DP gradient
+  allreduce tolerates lower bandwidth, pipeline p2p is narrow.
+
+Every strategy in :mod:`pytorch_distributed_nn_tpu.parallel` addresses the
+mesh only by axis *name*, so a size-1 axis composes for free — strategies
+never special-case "axis absent".
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import math
+from typing import Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, AbstractMesh, PartitionSpec as P
+
+# Canonical axis order: outermost (DCN-tolerant) → innermost (ICI-hungry).
+# `pipe` outermost: stages exchange only activation edges (narrow traffic,
+# DCN-capable per MPMD-pipeline practice); `tensor` innermost: per-layer
+# allreduce is the most bandwidth-hungry collective.
+AXIS_PIPE = "pipe"
+AXIS_DATA = "data"
+AXIS_FSDP = "fsdp"
+AXIS_EXPERT = "expert"
+AXIS_SEQ = "seq"
+AXIS_TENSOR = "tensor"
+
+AXES: tuple[str, ...] = (
+    AXIS_PIPE,
+    AXIS_DATA,
+    AXIS_FSDP,
+    AXIS_EXPERT,
+    AXIS_SEQ,
+    AXIS_TENSOR,
+)
+
+
+@dataclasses.dataclass
+class MeshSpec:
+    """Logical parallelism degrees. Unused axes default to 1 and are kept in
+    the mesh (size-1 axes cost nothing and keep PartitionSpecs uniform).
+
+    ``data = -1`` means "absorb all remaining devices" — the common case
+    where you fix tensor/pipe degrees and data-parallelism fills the pod.
+    """
+
+    pipe: int = 1
+    data: int = -1
+    fsdp: int = 1
+    expert: int = 1
+    seq: int = 1
+    tensor: int = 1
+
+    def resolve(self, n_devices: int) -> "MeshSpec":
+        sizes = self.sizes()
+        bad = {name: s for name, s in sizes.items() if s < 1 and s != -1}
+        if bad:
+            raise ValueError(f"axis sizes must be positive or -1, got {bad}")
+        wildcard = [name for name, s in sizes.items() if s == -1]
+        if len(wildcard) > 1:
+            raise ValueError(f"at most one -1 axis, got {wildcard}")
+        fixed = math.prod(s for s in sizes.values() if s != -1)
+        if wildcard:
+            if n_devices % fixed != 0:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes product {fixed}"
+                )
+            sizes[wildcard[0]] = n_devices // fixed
+        elif fixed != n_devices:
+            raise ValueError(
+                f"mesh spec {sizes} wants {fixed} devices, have {n_devices}"
+            )
+        return MeshSpec(**sizes)
+
+    def sizes(self) -> dict[str, int]:
+        return {name: getattr(self, name) for name in AXES}
+
+    @property
+    def shape(self) -> tuple[int, ...]:
+        return tuple(self.sizes()[a] for a in AXES)
+
+    def world_size(self) -> int:
+        if -1 in self.shape:
+            raise ValueError("unresolved MeshSpec; call .resolve(n_devices)")
+        return math.prod(self.shape)
+
+
+def make_mesh(
+    spec: MeshSpec | None = None,
+    devices: Sequence[jax.Device] | None = None,
+) -> Mesh:
+    """Build a named Mesh over ``devices`` (default: all).
+
+    Uses ``jax.experimental.mesh_utils`` device assignment when available so
+    inner axes land on physically adjacent chips (ICI rings); falls back to
+    row-major reshape (fine for CPU test meshes).
+    """
+    if devices is None:
+        devices = jax.devices()
+    spec = (spec or MeshSpec()).resolve(len(devices))
+    try:
+        from jax.experimental import mesh_utils
+
+        dev_array = mesh_utils.create_device_mesh(
+            spec.shape, devices=list(devices)
+        )
+    except ImportError:
+        dev_array = np.asarray(devices, dtype=object).reshape(spec.shape)
+    except Exception as e:  # topology assigner rejected the shape
+        logging.getLogger(__name__).warning(
+            "mesh_utils.create_device_mesh failed (%s); falling back to "
+            "row-major placement — inner axes may not be ICI-adjacent", e
+        )
+        dev_array = np.asarray(devices, dtype=object).reshape(spec.shape)
+    return Mesh(dev_array, AXES)
+
+
+def make_abstract_mesh(spec: MeshSpec, n_devices: int) -> AbstractMesh:
+    """Shape-only mesh for compile-only checks (no devices needed)."""
+    resolved = spec.resolve(n_devices)
+    return AbstractMesh(resolved.shape, AXES)
+
+
+def batch_pspec(extra_inner: str | None = None) -> P:
+    """PartitionSpec for a per-example batch dimension: sharded over every
+    data-like axis (data × fsdp), the TPU analogue of torch's
+    ``DistributedSampler`` per-rank split (SURVEY.md §2a data-loading row)."""
+    first = (AXIS_DATA, AXIS_FSDP)
+    return P(first, extra_inner) if extra_inner else P(first)
+
+
+def replicated_pspec() -> P:
+    return P()
+
+
+def data_axis_size(mesh: Mesh) -> int:
+    """Total data-parallel degree (data × fsdp), i.e. how many ways the
+    global batch is split."""
+    return mesh.shape[AXIS_DATA] * mesh.shape[AXIS_FSDP]

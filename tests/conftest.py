@@ -1,0 +1,32 @@
+"""Test harness: 8 fake XLA-CPU devices in one process.
+
+This is the TPU-world analogue of the reference's "gloo backend on CPU"
+escape hatch (BASELINE.json config 1; SURVEY.md §4 "Multi-device without a
+cluster"): every collective, mesh, and sharding test runs on the host
+platform with 8 virtual devices and never touches the real chip.
+"""
+
+import jax
+
+# Force CPU even though the ambient environment selects a TPU platform
+# (JAX_PLATFORMS=axon, and sitecustomize.py imports jax before this file
+# runs, so env vars are too late): jax.config takes effect as long as no
+# backend has been initialized yet.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 fake CPU devices, got {len(devs)}"
+    return devs
+
+
+@pytest.fixture()
+def mesh8():
+    from pytorch_distributed_nn_tpu.runtime.mesh import MeshSpec, make_mesh
+
+    return make_mesh(MeshSpec(data=8))

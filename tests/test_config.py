@@ -1,0 +1,46 @@
+import pytest
+
+from pytorch_distributed_nn_tpu.config import (
+    PRESETS,
+    get_config,
+    parse_overrides,
+)
+
+
+def test_all_five_presets_exist():
+    # The five benchmark configs from BASELINE.json:6-12.
+    assert set(PRESETS) == {
+        "mlp_mnist",
+        "resnet50_dp",
+        "bert_base_buckets",
+        "transformer_lm_pp",
+        "llama3_8b_zero",
+    }
+
+
+def test_get_config_and_override():
+    cfg = get_config("mlp_mnist", **{"optim.lr": "0.5", "steps": "7"})
+    assert cfg.optim.lr == 0.5
+    assert cfg.steps == 7
+    assert cfg.model.name == "mlp"
+
+
+def test_override_unknown_field_raises():
+    with pytest.raises(AttributeError):
+        get_config("mlp_mnist", **{"optim.nope": "1"})
+
+
+def test_parse_overrides():
+    assert parse_overrides(["--optim.lr=0.1", "--steps", "5"]) == {
+        "optim.lr": "0.1",
+        "steps": "5",
+    }
+
+
+def test_preset_mesh_specs_resolve():
+    cfg = get_config("transformer_lm_pp")
+    spec = cfg.mesh.resolve(8)
+    assert spec.pipe == 4 and spec.data == 2
+    cfg = get_config("llama3_8b_zero")
+    spec = cfg.mesh.resolve(8)
+    assert spec.fsdp == 8

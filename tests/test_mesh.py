@@ -1,0 +1,63 @@
+import jax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from pytorch_distributed_nn_tpu.runtime.mesh import (
+    AXES,
+    MeshSpec,
+    batch_pspec,
+    data_axis_size,
+    make_abstract_mesh,
+    make_mesh,
+)
+
+
+def test_axes_order_outer_to_inner():
+    assert AXES == ("pipe", "data", "fsdp", "expert", "seq", "tensor")
+
+
+def test_resolve_wildcard():
+    spec = MeshSpec(tensor=2).resolve(8)
+    assert spec.data == 4 and spec.tensor == 2
+    assert spec.world_size() == 8
+
+
+def test_resolve_exact_and_errors():
+    assert MeshSpec(data=8).resolve(8).world_size() == 8
+    with pytest.raises(ValueError):
+        MeshSpec(data=3).resolve(8)
+    with pytest.raises(ValueError):
+        MeshSpec(data=-1, fsdp=-1).resolve(8)
+    with pytest.raises(ValueError):
+        MeshSpec(data=-1, tensor=3).resolve(8)
+
+
+def test_make_mesh_all_axes_present(devices):
+    mesh = make_mesh(MeshSpec(data=2, fsdp=2, tensor=2))
+    assert set(mesh.axis_names) == set(AXES)
+    assert mesh.shape["data"] == 2
+    assert mesh.shape["tensor"] == 2
+    assert mesh.size == 8
+    assert data_axis_size(mesh) == 4
+
+
+def test_abstract_mesh_no_devices():
+    amesh = make_abstract_mesh(MeshSpec(data=4, tensor=8), 32)
+    assert amesh.shape["data"] == 4
+    assert amesh.shape["tensor"] == 8
+
+
+def test_batch_pspec():
+    assert batch_pspec() == P(("data", "fsdp"))
+    assert batch_pspec("seq") == P(("data", "fsdp"), "seq")
+
+
+def test_sharded_array_roundtrip(mesh8):
+    import numpy as np
+    from jax.sharding import NamedSharding
+
+    x = np.arange(64, dtype=np.float32).reshape(8, 8)
+    sharding = NamedSharding(mesh8, batch_pspec())
+    gx = jax.device_put(x, sharding)
+    assert gx.sharding.is_equivalent_to(sharding, ndim=2)
+    np.testing.assert_array_equal(np.asarray(gx), x)
