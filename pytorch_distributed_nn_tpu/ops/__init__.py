@@ -1,0 +1,3 @@
+"""Ops layer: typed collective wrappers, gradient bucketing, and Pallas
+kernels — the TPU-native replacement for the reference's dependence on
+c10d collectives and the DDP Reducer (SURVEY.md §2b)."""

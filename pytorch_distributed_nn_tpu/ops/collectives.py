@@ -1,0 +1,198 @@
+"""Typed collective wrappers over XLA collectives.
+
+The reference exposes collectives through ``torch.distributed``:
+``dist.all_reduce``, ``dist.broadcast``, ``dist.all_gather``,
+``dist.reduce_scatter``, ``dist.send/recv`` dispatched to NCCL/Gloo process
+groups (SURVEY.md §1 "Communication backend" row; §3.2 hand-rolled
+``average_gradients``). Here the same verbs are thin, *named-axis* wrappers
+over ``jax.lax`` collectives, usable inside ``shard_map``/``jit`` — XLA
+lowers them to ICI ring/tree implementations on TPU, so there is no NCCL
+analogue to manage.
+
+Each wrapper also records its traffic with :class:`CommRecorder` at trace
+time: bytes-on-the-wire per the standard ring-algorithm accounting, which
+is what the BASELINE "grad-allreduce bus-bw" metric divides by measured
+step time (SURVEY.md §6).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import threading
+from functools import partial
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+AxisName = str | tuple[str, ...]
+
+
+# ---------------------------------------------------------------------------
+# Traffic accounting (trace-time; drives the bus-bw metric)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class CommRecord:
+    op: str
+    bytes_payload: int  # logical payload per participating device
+    bytes_wire: float  # ring-algorithm bytes crossing links per device
+    axis: str
+
+
+class CommRecorder(threading.local):
+    """Trace-time recorder. Wrappers call :meth:`record` when tracing; a
+    benchmark wraps tracing in :func:`recording` and reads the totals.
+    Ring-allreduce accounting: 2(n-1)/n × payload crosses each device's
+    link; all-gather / reduce-scatter: (n-1)/n; ppermute / all-to-all: full
+    payload (all_to_all: (n-1)/n)."""
+
+    def __init__(self) -> None:
+        self.active: list[list[CommRecord]] = []
+
+    def record(self, rec: CommRecord) -> None:
+        for sink in self.active:
+            sink.append(rec)
+
+
+_recorder = CommRecorder()
+
+
+@contextlib.contextmanager
+def recording():
+    sink: list[CommRecord] = []
+    _recorder.active.append(sink)
+    try:
+        yield sink
+    finally:
+        _recorder.active.remove(sink)
+
+
+def wire_bytes(records: Sequence[CommRecord]) -> float:
+    return sum(r.bytes_wire for r in records)
+
+
+def _axis_size(axis: AxisName) -> int:
+    names = (axis,) if isinstance(axis, str) else tuple(axis)
+    size = 1
+    for name in names:
+        size *= lax.axis_size(name)
+    return size
+
+
+def _nbytes(x: jax.Array | jax.core.Tracer) -> int:
+    return x.size * x.dtype.itemsize
+
+
+def _record(op: str, x, axis: AxisName, wire_factor: float) -> None:
+    n = _axis_size(axis)
+    payload = _nbytes(x)
+    _recorder.record(CommRecord(
+        op=op,
+        bytes_payload=payload,
+        bytes_wire=wire_factor * payload * (n - 1) / max(n, 1),
+        axis=str(axis),
+    ))
+
+
+# ---------------------------------------------------------------------------
+# Collective verbs (named-axis; call inside shard_map / jit)
+# ---------------------------------------------------------------------------
+
+def all_reduce_sum(x, axis: AxisName):
+    """``dist.all_reduce(SUM)`` equivalent: ``lax.psum`` over a mesh axis."""
+    _record("all_reduce", x, axis, wire_factor=2.0)
+    return lax.psum(x, axis)
+
+
+def all_reduce_mean(x, axis: AxisName):
+    """The reference's ``average_gradients``: sum-allreduce then divide by
+    world size (SURVEY.md §3.2) — here fused as ``lax.pmean``."""
+    _record("all_reduce", x, axis, wire_factor=2.0)
+    return lax.pmean(x, axis)
+
+
+def all_reduce_max(x, axis: AxisName):
+    _record("all_reduce", x, axis, wire_factor=2.0)
+    return lax.pmax(x, axis)
+
+
+def all_gather(x, axis: AxisName, *, gather_axis: int = 0, tiled: bool = True):
+    """``dist.all_gather``: concatenate per-device shards along
+    ``gather_axis`` (tiled) or stack on a new leading axis."""
+    _record("all_gather", x, axis, wire_factor=1.0)
+    return lax.all_gather(x, axis, axis=gather_axis, tiled=tiled)
+
+
+def reduce_scatter_sum(x, axis: AxisName, *, scatter_axis: int = 0):
+    """``dist.reduce_scatter``: sum across the axis, each device keeps its
+    1/n slice of ``scatter_axis``."""
+    _record("reduce_scatter", x, axis, wire_factor=1.0)
+    return lax.psum_scatter(x, axis, scatter_dimension=scatter_axis,
+                            tiled=True)
+
+
+def broadcast(x, axis: AxisName, *, root: int = 0):
+    """``dist.broadcast(src=root)``: every device gets root's value. The
+    reference uses this for initial parameter sync (SURVEY.md §3.1). SPMD
+    form: zero out non-root shards and psum."""
+    _record("broadcast", x, axis, wire_factor=1.0)
+    idx = lax.axis_index(axis)
+    return lax.psum(jnp.where(idx == root, x, jnp.zeros_like(x)), axis)
+
+
+def ppermute(x, axis: str, perm: Sequence[tuple[int, int]]):
+    """``dist.send``+``dist.recv`` pairs as one collective-permute: data
+    follows ``(src, dst)`` edges; devices with no incoming edge get zeros.
+    This is the pipeline-stage transport (SURVEY.md §3.3)."""
+    _record("ppermute", x, axis, wire_factor=1.0)
+    return lax.ppermute(x, axis, perm=list(perm))
+
+
+def shift_right(x, axis: str):
+    """Ring shift i → i+1 (wrapping): the pipeline forward edge."""
+    n = lax.axis_size(axis)
+    return ppermute(x, axis, [(i, (i + 1) % n) for i in range(n)])
+
+
+def shift_left(x, axis: str):
+    """Ring shift i → i-1 (wrapping): the pipeline backward edge."""
+    n = lax.axis_size(axis)
+    return ppermute(x, axis, [(i, (i - 1) % n) for i in range(n)])
+
+
+def all_to_all(x, axis: str, *, split_axis: int, concat_axis: int):
+    """``dist.all_to_all``: repartition — each device splits ``split_axis``
+    n ways and concatenates received chunks on ``concat_axis``. Used for
+    Ulysses-style seq↔heads resharding (SURVEY.md §2c)."""
+    _record("all_to_all", x, axis, wire_factor=1.0)
+    return lax.all_to_all(x, axis, split_axis=split_axis,
+                          concat_axis=concat_axis, tiled=True)
+
+
+def axis_index(axis: str):
+    """``dist.get_rank()`` along one mesh axis."""
+    return lax.axis_index(axis)
+
+
+def axis_size(axis: AxisName) -> int:
+    """``dist.get_world_size()`` along one or more mesh axes."""
+    return _axis_size(axis)
+
+
+# ---------------------------------------------------------------------------
+# Tree-level helpers (whole-pytree variants used by the strategies)
+# ---------------------------------------------------------------------------
+
+def tree_all_reduce_mean(tree, axis: AxisName):
+    """Gradient averaging over a whole pytree — the bucketless form of the
+    reference's per-tensor loop (SURVEY.md §3.2). XLA fuses adjacent psums,
+    so this already behaves like DDP's fused buckets on TPU; the explicit
+    bucket controller lives in ops/buckets.py."""
+    return jax.tree.map(partial(all_reduce_mean, axis=axis), tree)
+
+
+def tree_broadcast(tree, axis: AxisName, *, root: int = 0):
+    return jax.tree.map(partial(broadcast, axis=axis, root=root), tree)

@@ -1,0 +1,106 @@
+"""FakeCollectives: a pure-numpy N-rank world.
+
+Analogue of torch's ``FakeProcessGroup`` (SURVEY.md §4 "Fake backend"):
+scheduler and strategy logic (bucket partitioning, pipeline schedules,
+shard layouts) is tested against this world with no devices and no XLA —
+each collective is literal numpy over a list of per-rank arrays.
+
+Semantics mirror ops/collectives.py verb-for-verb so a strategy's math can
+be cross-checked between the fake world and a real shard_map.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import numpy as np
+
+
+class FakeWorld:
+    """An N-rank world. Every method takes ``shards`` — a list of numpy
+    arrays, one per rank — and returns the post-collective list."""
+
+    def __init__(self, world_size: int) -> None:
+        if world_size < 1:
+            raise ValueError("world_size must be >= 1")
+        self.world_size = world_size
+
+    def _check(self, shards: Sequence[np.ndarray]) -> list[np.ndarray]:
+        if len(shards) != self.world_size:
+            raise ValueError(
+                f"expected {self.world_size} shards, got {len(shards)}"
+            )
+        return [np.asarray(s) for s in shards]
+
+    def all_reduce_sum(self, shards):
+        shards = self._check(shards)
+        total = np.sum(shards, axis=0)
+        return [total.copy() for _ in range(self.world_size)]
+
+    def all_reduce_mean(self, shards):
+        return [s / self.world_size for s in self.all_reduce_sum(shards)]
+
+    def all_reduce_max(self, shards):
+        shards = self._check(shards)
+        peak = np.max(shards, axis=0)
+        return [peak.copy() for _ in range(self.world_size)]
+
+    def all_gather(self, shards, *, gather_axis: int = 0):
+        shards = self._check(shards)
+        full = np.concatenate(shards, axis=gather_axis)
+        return [full.copy() for _ in range(self.world_size)]
+
+    def reduce_scatter_sum(self, shards, *, scatter_axis: int = 0):
+        shards = self._check(shards)
+        total = np.sum(shards, axis=0)
+        if total.shape[scatter_axis] % self.world_size:
+            raise ValueError(
+                f"dim {scatter_axis} ({total.shape[scatter_axis]}) not "
+                f"divisible by world size {self.world_size}"
+            )
+        return list(np.split(total, self.world_size, axis=scatter_axis))
+
+    def broadcast(self, shards, *, root: int = 0):
+        shards = self._check(shards)
+        return [shards[root].copy() for _ in range(self.world_size)]
+
+    def ppermute(self, shards, perm: Sequence[tuple[int, int]]):
+        shards = self._check(shards)
+        out = [np.zeros_like(s) for s in shards]
+        seen_dst = set()
+        for src, dst in perm:
+            if dst in seen_dst:
+                raise ValueError(f"duplicate destination {dst} in perm")
+            seen_dst.add(dst)
+            out[dst] = shards[src].copy()
+        return out
+
+    def shift_right(self, shards):
+        n = self.world_size
+        return self.ppermute(shards, [(i, (i + 1) % n) for i in range(n)])
+
+    def shift_left(self, shards):
+        n = self.world_size
+        return self.ppermute(shards, [(i, (i - 1) % n) for i in range(n)])
+
+    def send_recv(self, shards, *, src: int, dst: int):
+        """Point-to-point ``dist.send``/``dist.recv`` pair: dst receives
+        src's buffer; everyone else keeps theirs."""
+        shards = self._check(shards)
+        out = [s.copy() for s in shards]
+        out[dst] = shards[src].copy()
+        return out
+
+    def all_to_all(self, shards, *, split_axis: int = 0,
+                   concat_axis: int = 0):
+        shards = self._check(shards)
+        n = self.world_size
+        pieces = [np.split(s, n, axis=split_axis) for s in shards]
+        return [
+            np.concatenate([pieces[src][dst] for src in range(n)],
+                           axis=concat_axis)
+            for dst in range(n)
+        ]
+
+    def barrier(self, shards=None):
+        return shards
