@@ -163,7 +163,10 @@ def _bert_base_buckets() -> TrainConfig:
         data=DataConfig(dataset="lm_synthetic", batch_size=256, seq_len=128,
                         vocab_size=30522),
         model=ModelConfig(name="bert_base"),
-        parallel=ParallelConfig(strategy="dp", bucket_mb=100.0, overlap=True),
+        # dp_explicit so the named "large fused gradient buckets" actually
+        # run through the bucket controller (ops/buckets.py)
+        parallel=ParallelConfig(strategy="dp_explicit", bucket_mb=100.0,
+                                overlap=True),
     )
 
 

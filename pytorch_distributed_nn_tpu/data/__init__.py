@@ -1,0 +1,8 @@
+"""Data pipeline: deterministic synthetic datasets, per-process sharding
+(the ``DistributedSampler`` equivalent — SURVEY.md §2a Data-loading row),
+and a prefetching host→device loader."""
+
+from pytorch_distributed_nn_tpu.data.datasets import get_dataset
+from pytorch_distributed_nn_tpu.data.loader import DataLoader
+
+__all__ = ["get_dataset", "DataLoader"]

@@ -1,0 +1,121 @@
+"""Synthetic datasets, deterministic by (seed, step).
+
+The reference feeds ``torchvision.datasets`` MNIST/CIFAR/ImageNet through
+a ``DistributedSampler`` (SURVEY.md §2a). This container is zero-egress,
+so the framework ships procedurally generated stand-ins with the same
+shapes/dtypes and *learnable* structure (class-conditional templates for
+vision, an affine next-token process for LM) — loss curves genuinely
+descend, which the golden-equivalence tests rely on.
+
+Determinism contract: ``batch(step)`` depends only on (seed, step, global
+batch size) — never on topology — so any device/process layout sees the
+identical global batch and distributed training is bit-comparable to
+single-device training (SURVEY.md §4 "Golden-equivalence").
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class BatchSpec:
+    x_shape: tuple[int, ...]  # per-example
+    x_dtype: np.dtype
+    y_shape: tuple[int, ...]
+    y_dtype: np.dtype
+    num_classes: int
+
+
+class SyntheticDataset:
+    """Base: infinite stream of batches, indexed by step."""
+
+    spec: BatchSpec
+
+    def __init__(self, seed: int, batch_size: int) -> None:
+        self.seed = seed
+        self.batch_size = batch_size
+
+    def _rng(self, step: int) -> np.random.Generator:
+        return np.random.default_rng(
+            np.random.SeedSequence([self.seed, step])
+        )
+
+    def batch(self, step: int) -> tuple[np.ndarray, np.ndarray]:
+        raise NotImplementedError
+
+
+class ClassTemplateImages(SyntheticDataset):
+    """Class-conditional template + noise images: y ~ uniform(classes),
+    x = template[y] + N(0, noise). Linearly separable enough that small
+    nets learn it fast, hard enough that loss curves are informative."""
+
+    def __init__(self, seed: int, batch_size: int, *,
+                 shape: tuple[int, ...], num_classes: int,
+                 noise: float = 0.35) -> None:
+        super().__init__(seed, batch_size)
+        self.noise = noise
+        self.spec = BatchSpec(shape, np.dtype(np.float32), (),
+                              np.dtype(np.int32), num_classes)
+        tmpl_rng = np.random.default_rng(
+            np.random.SeedSequence([seed, 0xC1A55])
+        )
+        self.templates = tmpl_rng.normal(
+            0.0, 1.0, size=(num_classes, *shape)
+        ).astype(np.float32)
+
+    def batch(self, step: int) -> tuple[np.ndarray, np.ndarray]:
+        rng = self._rng(step)
+        y = rng.integers(0, self.spec.num_classes, size=self.batch_size,
+                         dtype=np.int32)
+        x = self.templates[y] + rng.normal(
+            0.0, self.noise, size=(self.batch_size, *self.spec.x_shape)
+        ).astype(np.float32)
+        return x.astype(np.float32), y
+
+
+class SyntheticLM(SyntheticDataset):
+    """Learnable token stream: tokens follow a noised affine recurrence
+    t_{i+1} = (a·t_i + c) mod V, with a fraction of uniform-random tokens.
+    Targets are inputs shifted by one (standard causal LM)."""
+
+    def __init__(self, seed: int, batch_size: int, *, seq_len: int,
+                 vocab_size: int, noise_frac: float = 0.1) -> None:
+        super().__init__(seed, batch_size)
+        self.seq_len = seq_len
+        self.noise_frac = noise_frac
+        self.spec = BatchSpec((seq_len,), np.dtype(np.int32), (seq_len,),
+                              np.dtype(np.int32), vocab_size)
+        self.a = 31337 % vocab_size or 1
+        self.c = 7919 % vocab_size
+
+    def batch(self, step: int) -> tuple[np.ndarray, np.ndarray]:
+        rng = self._rng(step)
+        V = self.spec.num_classes
+        toks = np.empty((self.batch_size, self.seq_len + 1), dtype=np.int64)
+        toks[:, 0] = rng.integers(0, V, size=self.batch_size)
+        for i in range(self.seq_len):
+            toks[:, i + 1] = (self.a * toks[:, i] + self.c) % V
+        flip = rng.random(toks.shape) < self.noise_frac
+        toks[flip] = rng.integers(0, V, size=int(flip.sum()))
+        return (toks[:, :-1].astype(np.int32),
+                toks[:, 1:].astype(np.int32))
+
+
+def get_dataset(name: str, *, seed: int, batch_size: int,
+                seq_len: int = 512, vocab_size: int = 32000):
+    if name == "mnist":
+        return ClassTemplateImages(seed, batch_size, shape=(28, 28),
+                                   num_classes=10)
+    if name == "cifar10":
+        return ClassTemplateImages(seed, batch_size, shape=(32, 32, 3),
+                                   num_classes=10)
+    if name == "imagenet_synthetic":
+        return ClassTemplateImages(seed, batch_size, shape=(224, 224, 3),
+                                   num_classes=1000)
+    if name == "lm_synthetic":
+        return SyntheticLM(seed, batch_size, seq_len=seq_len,
+                           vocab_size=vocab_size)
+    raise KeyError(f"unknown dataset {name!r}")

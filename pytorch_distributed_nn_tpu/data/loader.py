@@ -1,0 +1,106 @@
+"""Host→device loader with per-process sharding and background prefetch.
+
+Replaces the reference's ``DataLoader`` + ``DistributedSampler`` pair
+(SURVEY.md §2a): each host process materialises only its slice of the
+global batch, then the slices are assembled into one global ``jax.Array``
+sharded over the mesh's data axes. A background thread keeps ``prefetch``
+batches in flight so host generation overlaps device compute (the TPU
+analogue of torch's pinned-memory worker pool).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Iterator
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding
+
+from pytorch_distributed_nn_tpu.data.datasets import SyntheticDataset
+from pytorch_distributed_nn_tpu.runtime.mesh import batch_pspec
+
+
+class DataLoader:
+    def __init__(
+        self,
+        dataset: SyntheticDataset,
+        mesh: Mesh,
+        *,
+        start_step: int = 0,
+        prefetch: int = 2,
+    ) -> None:
+        self.dataset = dataset
+        self.mesh = mesh
+        self.start_step = start_step
+        self.prefetch = prefetch
+        self.sharding = NamedSharding(mesh, batch_pspec())
+        gbs = dataset.batch_size
+        n_proc = jax.process_count()
+        if gbs % n_proc:
+            raise ValueError(
+                f"global batch {gbs} not divisible by {n_proc} processes"
+            )
+        from pytorch_distributed_nn_tpu.runtime.mesh import data_axis_size
+
+        dp = data_axis_size(mesh)
+        if gbs % dp:
+            raise ValueError(
+                f"global batch {gbs} not divisible by data degree {dp}"
+            )
+
+    def _host_slice(self, arr: np.ndarray) -> np.ndarray:
+        """The rows of the global batch this process owns (contiguous
+        block layout, matching NamedSharding's row-major split)."""
+        n = jax.process_count()
+        per = arr.shape[0] // n
+        i = jax.process_index()
+        return arr[i * per:(i + 1) * per]
+
+    def _to_global(self, arr: np.ndarray) -> jax.Array:
+        if jax.process_count() == 1:
+            return jax.device_put(arr, self.sharding)
+        return jax.make_array_from_process_local_data(
+            self.sharding, self._host_slice(arr)
+        )
+
+    def batch_at(self, step: int) -> tuple[jax.Array, ...]:
+        """Deterministic global batch for one step (no prefetch)."""
+        return tuple(self._to_global(a) for a in self.dataset.batch(step))
+
+    def __iter__(self) -> Iterator[tuple[jax.Array, ...]]:
+        if self.prefetch <= 0:
+            step = self.start_step
+            while True:
+                yield self.batch_at(step)
+                step += 1
+            return
+
+        q: queue.Queue = queue.Queue(maxsize=self.prefetch)
+        stop = threading.Event()
+
+        def producer() -> None:
+            step = self.start_step
+            while not stop.is_set():
+                try:
+                    batch = self.batch_at(step)
+                except Exception as e:  # surface errors to the consumer
+                    q.put(e)
+                    return
+                q.put(batch)
+                step += 1
+
+        thread = threading.Thread(target=producer, daemon=True)
+        thread.start()
+        try:
+            while True:
+                item = q.get()
+                if isinstance(item, Exception):
+                    raise item
+                yield item
+        finally:
+            stop.set()
+            # unblock a producer stuck on a full queue
+            while not q.empty():
+                q.get_nowait()

@@ -1,0 +1,48 @@
+"""LeNet-style CNN for MNIST/CIFAR — the reference's classic small conv
+net (SURVEY.md §2a Models row, [R] "LeNet-ish CNN").
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from pytorch_distributed_nn_tpu.config import ModelConfig
+from pytorch_distributed_nn_tpu.models import register
+from pytorch_distributed_nn_tpu.nn.dtypes import get_policy
+
+
+class LeNet(nn.Module):
+    num_classes: int = 10
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        # NHWC; grayscale inputs arrive as (B, 28, 28) → add channel dim
+        if x.ndim == 3:
+            x = x[..., None]
+        x = x.astype(self.dtype)
+        x = nn.Conv(6, (5, 5), padding="SAME", dtype=self.dtype,
+                    param_dtype=self.param_dtype)(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = nn.Conv(16, (5, 5), padding="VALID", dtype=self.dtype,
+                    param_dtype=self.param_dtype)(x)
+        x = nn.relu(x)
+        x = nn.avg_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(120, dtype=self.dtype,
+                             param_dtype=self.param_dtype)(x))
+        x = nn.relu(nn.Dense(84, dtype=self.dtype,
+                             param_dtype=self.param_dtype)(x))
+        return nn.Dense(self.num_classes, dtype=self.dtype,
+                        param_dtype=self.param_dtype)(x)
+
+
+@register("lenet")
+def build_lenet(cfg: ModelConfig) -> LeNet:
+    policy = get_policy(cfg.dtype, cfg.compute_dtype)
+    return LeNet(num_classes=cfg.extra.get("num_classes", 10),
+                 dtype=policy.compute_dtype,
+                 param_dtype=policy.param_dtype)

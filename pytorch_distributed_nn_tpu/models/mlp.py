@@ -1,0 +1,39 @@
+"""2-layer MLP — BASELINE.json config 1's model ("2-layer MLP on MNIST"),
+the reference's minimal `Net(nn.Module)` (SURVEY.md §2a single-process
+baseline row), built as a flax module.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from pytorch_distributed_nn_tpu.config import ModelConfig
+from pytorch_distributed_nn_tpu.models import register
+from pytorch_distributed_nn_tpu.nn.dtypes import get_policy
+
+
+class MLP(nn.Module):
+    features: Sequence[int] = (128, 10)
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        for i, feat in enumerate(self.features):
+            x = nn.Dense(feat, dtype=self.dtype,
+                         param_dtype=self.param_dtype)(x)
+            if i < len(self.features) - 1:
+                x = nn.relu(x)
+        return x
+
+
+@register("mlp")
+def build_mlp(cfg: ModelConfig) -> MLP:
+    policy = get_policy(cfg.dtype, cfg.compute_dtype)
+    features = tuple(cfg.extra.get("features", (128, 10)))
+    return MLP(features=features, dtype=policy.compute_dtype,
+               param_dtype=policy.param_dtype)

@@ -1,0 +1,12 @@
+"""NN utilities shared by the model zoo.
+
+The reference's models are ordinary ``torch.nn.Module`` subclasses
+(SURVEY.md §2a Models row). Here models are flax.linen modules — the
+idiomatic JAX compute path — and this package holds the cross-cutting
+pieces: the mixed-precision dtype policy (bf16 compute / f32 params, the
+TPU-native analogue of CUDA amp) and rematerialisation helpers.
+"""
+
+from pytorch_distributed_nn_tpu.nn.dtypes import Policy, get_policy
+
+__all__ = ["Policy", "get_policy"]

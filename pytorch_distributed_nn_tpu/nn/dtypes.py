@@ -1,0 +1,44 @@
+"""Mixed-precision policy: params in f32, compute in bf16.
+
+On TPU the MXU natively multiplies bf16 with f32 accumulation, so "amp"
+is just a dtype choice on the module — no loss scaling needed (bf16 has
+f32's exponent range, unlike fp16 on the reference's GPUs).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+_DTYPES = {
+    "float32": jnp.float32,
+    "bfloat16": jnp.bfloat16,
+    "float16": jnp.float16,
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Policy:
+    param_dtype: jnp.dtype
+    compute_dtype: jnp.dtype
+
+    def cast_to_compute(self, tree):
+        import jax
+
+        return jax.tree.map(
+            lambda x: x.astype(self.compute_dtype)
+            if hasattr(x, "astype") and jnp.issubdtype(x.dtype, jnp.floating)
+            else x,
+            tree,
+        )
+
+
+def get_policy(param_dtype: str = "float32",
+               compute_dtype: str = "bfloat16") -> Policy:
+    try:
+        return Policy(_DTYPES[param_dtype], _DTYPES[compute_dtype])
+    except KeyError as e:
+        raise ValueError(
+            f"unknown dtype {e.args[0]!r}; have {sorted(_DTYPES)}"
+        ) from None

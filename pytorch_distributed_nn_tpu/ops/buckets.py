@@ -1,0 +1,98 @@
+"""Gradient bucketing — the DDP ``Reducer`` equivalent.
+
+The reference's key perf behavior is DDP's C++ Reducer: gradients are
+packed into ~25 MB buckets and all-reduced per-bucket, overlapped with the
+remaining backward pass (SURVEY.md §2b Reducer row; BASELINE.json "large
+fused gradient buckets"). On TPU the *overlap* is compiler-owned — XLA's
+async-collective scheduler hides psum latency behind compute — but the
+*fusion* (few large collectives instead of one tiny psum per tensor) is
+still ours to control, and it is what the bus-bw benchmark measures.
+
+:func:`make_bucket_reduce` builds a ``grads -> grads`` transform for the
+explicit shard_map DP path: flatten leaves in reverse-autograd order (the
+order gradients become ready, matching DDP's bucket assignment), greedily
+pack to ``bucket_mb``, one ``pmean`` per bucket, unpack. All shapes are
+static, so this costs two reshapes per leaf at trace time and nothing at
+run time beyond the collectives themselves.
+
+``quantized=True`` compresses each bucket to bfloat16 on the wire
+(EQuARX-style lossy allreduce, PAPERS.md) — halves bus traffic for f32
+grads; the int8 Pallas variant plugs in here later.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from pytorch_distributed_nn_tpu.ops import collectives as cc
+
+
+def partition_buckets(
+    sizes_bytes: Sequence[int], bucket_bytes: int
+) -> list[list[int]]:
+    """Greedy contiguous packing of leaf indices into buckets of at most
+    ``bucket_bytes`` (a leaf larger than the budget gets its own bucket).
+    Pure function — unit-tested against the FakeWorld (SURVEY.md §4
+    "Unit" row)."""
+    if bucket_bytes <= 0:
+        raise ValueError("bucket_bytes must be positive")
+    buckets: list[list[int]] = []
+    current: list[int] = []
+    used = 0
+    for idx, size in enumerate(sizes_bytes):
+        if current and used + size > bucket_bytes:
+            buckets.append(current)
+            current, used = [], 0
+        current.append(idx)
+        used += size
+    if current:
+        buckets.append(current)
+    return buckets
+
+
+def make_bucket_reduce(
+    *,
+    bucket_mb: float = 25.0,
+    axis=("data", "fsdp"),
+    quantized: bool = False,
+) -> Callable:
+    """Build the bucketed gradient-mean transform (runs inside shard_map)."""
+    bucket_bytes = int(bucket_mb * 1024 * 1024)
+
+    def reduce_grads(grads):
+        leaves, treedef = jax.tree.flatten(grads)
+        # Reverse order: last-layer grads are ready first in backward, so
+        # their bucket's allreduce can start earliest (DDP's heuristic).
+        # Group by dtype so buckets concatenate and reduce in the leaves'
+        # native dtype — no f32 upcast doubling bf16 wire traffic.
+        order = list(range(len(leaves)))[::-1]
+        by_dtype: dict = {}
+        for i in order:
+            by_dtype.setdefault(leaves[i].dtype, []).append(i)
+
+        reduced: dict[int, jax.Array] = {}
+        for dtype, idx_group in by_dtype.items():
+            sizes = [leaves[i].size * dtype.itemsize for i in idx_group]
+            for bucket in partition_buckets(sizes, bucket_bytes):
+                idxs = [idx_group[j] for j in bucket]
+                flat = jnp.concatenate([leaves[i].ravel() for i in idxs])
+                if quantized and flat.dtype.itemsize > 2:
+                    wire = flat.astype(jnp.bfloat16)
+                    mean = cc.all_reduce_mean(wire, axis).astype(dtype)
+                else:
+                    mean = cc.all_reduce_mean(flat, axis)
+                offset = 0
+                for i in idxs:
+                    leaf = leaves[i]
+                    reduced[i] = (
+                        mean[offset:offset + leaf.size].reshape(leaf.shape)
+                    )
+                    offset += leaf.size
+        return jax.tree.unflatten(
+            treedef, [reduced[i] for i in range(len(leaves))]
+        )
+
+    return reduce_grads

@@ -45,9 +45,17 @@ class CommRecord:
 class CommRecorder(threading.local):
     """Trace-time recorder. Wrappers call :meth:`record` when tracing; a
     benchmark wraps tracing in :func:`recording` and reads the totals.
-    Ring-allreduce accounting: 2(n-1)/n × payload crosses each device's
-    link; all-gather / reduce-scatter: (n-1)/n; ppermute / all-to-all: full
-    payload (all_to_all: (n-1)/n)."""
+
+    Per-device ring-algorithm wire accounting, with ``payload`` = the
+    *input* buffer size the wrapper sees:
+
+    - all_reduce / broadcast-as-psum: 2(n-1)/n × payload
+    - all_gather: (n-1) × payload (payload is the local shard; each
+      device forwards every other device's shard once)
+    - reduce_scatter: (n-1)/n × payload (payload is the full buffer)
+    - ppermute: 1 × payload (each edge moves the whole buffer)
+    - all_to_all: (n-1)/n × payload (keeps own chunk local)
+    """
 
     def __init__(self) -> None:
         self.active: list[list[CommRecord]] = []
@@ -86,13 +94,24 @@ def _nbytes(x: jax.Array | jax.core.Tracer) -> int:
     return x.size * x.dtype.itemsize
 
 
-def _record(op: str, x, axis: AxisName, wire_factor: float) -> None:
+# wire bytes per device as f(payload, axis size n)
+_WIRE = {
+    "all_reduce": lambda p, n: 2.0 * p * (n - 1) / max(n, 1),
+    "broadcast": lambda p, n: 2.0 * p * (n - 1) / max(n, 1),
+    "all_gather": lambda p, n: float(p * (n - 1)),
+    "reduce_scatter": lambda p, n: p * (n - 1) / max(n, 1),
+    "ppermute": lambda p, n: float(p),
+    "all_to_all": lambda p, n: p * (n - 1) / max(n, 1),
+}
+
+
+def _record(op: str, x, axis: AxisName) -> None:
     n = _axis_size(axis)
     payload = _nbytes(x)
     _recorder.record(CommRecord(
         op=op,
         bytes_payload=payload,
-        bytes_wire=wire_factor * payload * (n - 1) / max(n, 1),
+        bytes_wire=_WIRE[op](payload, n),
         axis=str(axis),
     ))
 
@@ -103,33 +122,33 @@ def _record(op: str, x, axis: AxisName, wire_factor: float) -> None:
 
 def all_reduce_sum(x, axis: AxisName):
     """``dist.all_reduce(SUM)`` equivalent: ``lax.psum`` over a mesh axis."""
-    _record("all_reduce", x, axis, wire_factor=2.0)
+    _record("all_reduce", x, axis)
     return lax.psum(x, axis)
 
 
 def all_reduce_mean(x, axis: AxisName):
     """The reference's ``average_gradients``: sum-allreduce then divide by
     world size (SURVEY.md §3.2) — here fused as ``lax.pmean``."""
-    _record("all_reduce", x, axis, wire_factor=2.0)
+    _record("all_reduce", x, axis)
     return lax.pmean(x, axis)
 
 
 def all_reduce_max(x, axis: AxisName):
-    _record("all_reduce", x, axis, wire_factor=2.0)
+    _record("all_reduce", x, axis)
     return lax.pmax(x, axis)
 
 
 def all_gather(x, axis: AxisName, *, gather_axis: int = 0, tiled: bool = True):
     """``dist.all_gather``: concatenate per-device shards along
     ``gather_axis`` (tiled) or stack on a new leading axis."""
-    _record("all_gather", x, axis, wire_factor=1.0)
+    _record("all_gather", x, axis)
     return lax.all_gather(x, axis, axis=gather_axis, tiled=tiled)
 
 
 def reduce_scatter_sum(x, axis: AxisName, *, scatter_axis: int = 0):
     """``dist.reduce_scatter``: sum across the axis, each device keeps its
     1/n slice of ``scatter_axis``."""
-    _record("reduce_scatter", x, axis, wire_factor=1.0)
+    _record("reduce_scatter", x, axis)
     return lax.psum_scatter(x, axis, scatter_dimension=scatter_axis,
                             tiled=True)
 
@@ -138,7 +157,7 @@ def broadcast(x, axis: AxisName, *, root: int = 0):
     """``dist.broadcast(src=root)``: every device gets root's value. The
     reference uses this for initial parameter sync (SURVEY.md §3.1). SPMD
     form: zero out non-root shards and psum."""
-    _record("broadcast", x, axis, wire_factor=1.0)
+    _record("broadcast", x, axis)
     idx = lax.axis_index(axis)
     return lax.psum(jnp.where(idx == root, x, jnp.zeros_like(x)), axis)
 
@@ -147,7 +166,7 @@ def ppermute(x, axis: str, perm: Sequence[tuple[int, int]]):
     """``dist.send``+``dist.recv`` pairs as one collective-permute: data
     follows ``(src, dst)`` edges; devices with no incoming edge get zeros.
     This is the pipeline-stage transport (SURVEY.md §3.3)."""
-    _record("ppermute", x, axis, wire_factor=1.0)
+    _record("ppermute", x, axis)
     return lax.ppermute(x, axis, perm=list(perm))
 
 
@@ -167,7 +186,7 @@ def all_to_all(x, axis: str, *, split_axis: int, concat_axis: int):
     """``dist.all_to_all``: repartition — each device splits ``split_axis``
     n ways and concatenates received chunks on ``concat_axis``. Used for
     Ulysses-style seq↔heads resharding (SURVEY.md §2c)."""
-    _record("all_to_all", x, axis, wire_factor=1.0)
+    _record("all_to_all", x, axis)
     return lax.all_to_all(x, axis, split_axis=split_axis,
                           concat_axis=concat_axis, tiled=True)
 

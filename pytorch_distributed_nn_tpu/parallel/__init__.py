@@ -1,0 +1,8 @@
+"""Parallelism strategies — the heart of the framework, as the strategy
+layer is the heart of the reference (SURVEY.md §1). Each strategy builds a
+jit-compiled train step over the named mesh; they compose through mesh
+axes rather than through wrapper classes."""
+
+from pytorch_distributed_nn_tpu.parallel.api import make_train_step
+
+__all__ = ["make_train_step"]

@@ -1,0 +1,67 @@
+"""Strategy dispatch: config → jit-compiled train step.
+
+The reference selects a communication strategy by running a different
+trainer script (SURVEY.md §1 Entrypoints row); here the strategy is a
+config field and every strategy exposes the same contract:
+
+    step(state, x, y) -> (state, metrics)      # jit-compiled over mesh
+
+with TrainState sharded per the strategy (replicated for DP, parameter-
+sharded for ZeRO, stage-sharded for pipeline).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Callable
+
+from jax.sharding import Mesh
+
+from pytorch_distributed_nn_tpu.config import TrainConfig
+from pytorch_distributed_nn_tpu.train.state import TrainState
+
+
+def make_train_step(
+    cfg: TrainConfig, mesh: Mesh, loss_fn: Callable
+) -> tuple[Callable, Callable[[TrainState], TrainState]]:
+    """Returns ``(step_fn, place_state_fn)``: the compiled step and the
+    function that lays the freshly-initialised TrainState out on the mesh
+    (replication broadcast, ZeRO sharding, or stage split)."""
+    from pytorch_distributed_nn_tpu.parallel import dp
+
+    strategy = cfg.parallel.strategy
+    if strategy in ("single", "dp"):
+        if cfg.parallel.quantized_allreduce:
+            logging.getLogger(__name__).warning(
+                "quantized_allreduce requires strategy='dp_explicit' "
+                "(the compiler-sharded 'dp' path owns its own collectives) "
+                "— ignoring"
+            )
+        step = dp.make_dp_train_step(mesh, loss_fn)
+        return step, lambda s: dp.replicate_state(s, mesh)
+    if strategy == "dp_explicit":
+        bucket_reduce = None
+        if cfg.parallel.bucket_mb > 0:
+            from pytorch_distributed_nn_tpu.ops.buckets import (
+                make_bucket_reduce,
+            )
+
+            bucket_reduce = make_bucket_reduce(
+                bucket_mb=cfg.parallel.bucket_mb,
+                quantized=cfg.parallel.quantized_allreduce,
+            )
+        step = dp.make_dp_train_step_explicit(
+            mesh, loss_fn, bucket_reduce=bucket_reduce
+        )
+        return step, lambda s: dp.replicate_state(s, mesh)
+    if strategy == "zero":
+        from pytorch_distributed_nn_tpu.parallel import zero
+
+        return zero.make_zero_train_step(
+            mesh, loss_fn, stage=cfg.parallel.zero_stage
+        )
+    if strategy == "pipeline":
+        from pytorch_distributed_nn_tpu.parallel import pipeline
+
+        return pipeline.make_pipeline_train_step(cfg, mesh, loss_fn)
+    raise ValueError(f"unknown strategy {strategy!r}")

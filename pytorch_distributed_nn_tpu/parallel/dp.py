@@ -1,0 +1,150 @@
+"""Data parallelism.
+
+Two implementations of the same math, mirroring the reference's two DP
+code paths (SURVEY.md §3.1 vs §3.2):
+
+- :func:`make_dp_train_step` — *compiler-sharded* DP, the DDP analogue:
+  params replicated, batch sharded over the data axes, one ``jit``; XLA
+  derives the gradient all-reduce from the shardings and schedules it
+  asynchronously, overlapped with remaining backward compute — the
+  compiler-native form of DDP's bucket/overlap Reducer (SURVEY.md §2b).
+
+- :func:`make_dp_train_step_explicit` — *hand-rolled* DP under
+  ``shard_map``, the analogue of the reference's pedagogical
+  ``average_gradients`` loop: per-device grads, then an explicit
+  per-tensor (or bucketed — ops/buckets.py) ``pmean``. Exists for parity,
+  for the bucket-size experiments behind the BASELINE bus-bw metric, and
+  as the hook point for quantized allreduce.
+
+Both produce bit-identical results to single-device training on the same
+global batch (the golden-equivalence oracle, SURVEY.md §4).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from pytorch_distributed_nn_tpu.ops import collectives as cc
+from pytorch_distributed_nn_tpu.runtime.mesh import (
+    AXIS_DATA,
+    AXIS_FSDP,
+    batch_pspec,
+)
+from pytorch_distributed_nn_tpu.train.state import TrainState
+
+DATA_AXES = (AXIS_DATA, AXIS_FSDP)
+
+
+def forward(state: TrainState, params, x, *, train: bool):
+    """Run the model, threading mutable collections (BatchNorm stats) when
+    present. Returns (logits, new_model_state)."""
+    variables = {"params": params, **state.model_state}
+    if train and state.model_state:
+        logits, updated = state.apply_fn(
+            variables, x, train=True, mutable=list(state.model_state)
+        )
+        return logits, dict(updated)
+    logits = state.apply_fn(variables, x, train=train)
+    return logits, state.model_state
+
+
+def _loss_and_grads(state, x, y, loss_fn):
+    def compute(params):
+        logits, new_model_state = forward(state, params, x, train=True)
+        loss = loss_fn(logits, y)
+        return loss, new_model_state
+
+    (loss, new_model_state), grads = jax.value_and_grad(
+        compute, has_aux=True
+    )(state.params)
+    return loss, new_model_state, grads
+
+
+def make_dp_train_step(
+    mesh: Mesh,
+    loss_fn: Callable,
+    *,
+    donate: bool = True,
+):
+    """Compiler-sharded DP step: ``step(state, x, y) -> (state, metrics)``.
+
+    Sharding contract: every TrainState leaf replicated, batch sharded
+    over data×fsdp. Gradients of a global-batch-mean loss w.r.t.
+    replicated params make XLA emit exactly one all-reduce per parameter
+    (fused and overlapped by the async-collective scheduler).
+    """
+    replicated = NamedSharding(mesh, P())
+    batch_sh = NamedSharding(mesh, batch_pspec())
+
+    def step(state: TrainState, x, y):
+        loss, new_model_state, grads = _loss_and_grads(state, x, y, loss_fn)
+        new_state = state.apply_gradients(grads).replace(
+            model_state=new_model_state
+        )
+        return new_state, {"loss": loss}
+
+    return jax.jit(
+        step,
+        in_shardings=(replicated, batch_sh, batch_sh),
+        out_shardings=(replicated, replicated),
+        donate_argnums=(0,) if donate else (),
+    )
+
+
+def make_dp_train_step_explicit(
+    mesh: Mesh,
+    loss_fn: Callable,
+    *,
+    bucket_reduce: Callable | None = None,
+    donate: bool = True,
+):
+    """Hand-rolled DP under shard_map (the reference's §3.2 path).
+
+    ``bucket_reduce(grads_tree) -> grads_tree`` replaces the default
+    per-tensor pmean when given — that's where the DDP-style bucket
+    controller (ops/buckets.py) or quantized allreduce plugs in. It runs
+    *inside* shard_map, so it may use any named-axis collective.
+    """
+    replicated = NamedSharding(mesh, P())
+    batch_sh = NamedSharding(mesh, batch_pspec())
+
+    reduce_grads = bucket_reduce or partial(
+        cc.tree_all_reduce_mean, axis=DATA_AXES
+    )
+
+    @partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(P(), batch_pspec(), batch_pspec()),
+        out_specs=(P(), P()),
+        check_vma=False,
+    )
+    def step(state: TrainState, x, y):
+        # Per-device microloss on the local shard; mean of per-device
+        # means == global mean because shards are equal-sized.
+        loss, new_model_state, grads = _loss_and_grads(state, x, y, loss_fn)
+        grads = reduce_grads(grads)
+        loss = cc.all_reduce_mean(loss, DATA_AXES)
+        # model_state (BN stats) must agree across replicas: average like
+        # grads (SyncBN semantics — torch DDP leaves them local, which
+        # diverges; syncing is strictly more correct).
+        new_model_state = cc.tree_all_reduce_mean(
+            new_model_state, DATA_AXES
+        ) if new_model_state else new_model_state
+        new_state = state.apply_gradients(grads).replace(
+            model_state=new_model_state
+        )
+        return new_state, {"loss": loss}
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def replicate_state(state: TrainState, mesh: Mesh) -> TrainState:
+    """Initial parameter broadcast — the reference's rank-0 ``broadcast``
+    at DDP construction (SURVEY.md §3.1). SPMD form: place every leaf
+    with a fully-replicated sharding."""
+    return jax.device_put(state, NamedSharding(mesh, P()))

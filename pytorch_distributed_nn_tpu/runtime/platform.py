@@ -1,0 +1,24 @@
+"""Platform selection for entrypoints.
+
+This container's ``sitecustomize`` registers the TPU backend at interpreter
+start and overwrites ``jax_platforms`` (to ``axon,cpu``), so the standard
+``JAX_PLATFORMS=cpu`` env contract is silently ignored by the time any
+script body runs. Entrypoints call :func:`apply_platform_overrides` first
+thing to re-assert the user's env intent through ``jax.config`` (effective
+until the first backend use).
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def apply_platform_overrides() -> None:
+    platforms = os.environ.get("JAX_PLATFORMS")
+    if platforms and jax.config.jax_platforms != platforms:
+        jax.config.update("jax_platforms", platforms)
+    n_cpu = os.environ.get("JAX_NUM_CPU_DEVICES")
+    if n_cpu:
+        jax.config.update("jax_num_cpu_devices", int(n_cpu))

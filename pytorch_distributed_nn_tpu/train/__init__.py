@@ -1,0 +1,3 @@
+"""Training drivers: optimizer factory, train state, and the Trainer — the
+TPU-native counterpart of the reference's per-strategy ``train.py``
+entrypoints (SURVEY.md §1 "Entrypoints / training drivers" row)."""

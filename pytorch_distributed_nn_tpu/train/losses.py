@@ -1,0 +1,30 @@
+"""Loss functions. Mean-reduction over the *global* batch, matching the
+reference's ``nn.CrossEntropyLoss`` default so distributed loss curves are
+directly comparable to single-device ones (SURVEY.md §4)."""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+import optax
+
+
+def softmax_xent(logits, labels) -> jnp.ndarray:
+    """Classification: logits (B, C) float, labels (B,) int."""
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), labels
+    ).mean()
+
+
+def lm_xent(logits, targets) -> jnp.ndarray:
+    """Causal LM: logits (B, T, V), targets (B, T) int."""
+    return optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), targets
+    ).mean()
+
+
+def accuracy(logits, labels) -> jnp.ndarray:
+    return (logits.argmax(-1) == labels).mean()
+
+
+def get_loss_fn(dataset_name: str):
+    return lm_xent if dataset_name == "lm_synthetic" else softmax_xent

@@ -1,0 +1,61 @@
+"""Optimizer factory over optax.
+
+The reference calls ``torch.optim.SGD``/``Adam`` after its hand-rolled or
+DDP-driven gradient averaging (SURVEY.md §3.1-3.2). Here the optimizer is
+an optax gradient-transformation chain built from
+:class:`~pytorch_distributed_nn_tpu.config.OptimConfig`; under sharded DP
+the same chain runs on parameter shards unchanged (optax transforms are
+elementwise over the pytree), which is what makes ZeRO-style optimizer
+state sharding free (SURVEY.md §2c sharded-DP row).
+"""
+
+from __future__ import annotations
+
+import optax
+
+from pytorch_distributed_nn_tpu.config import OptimConfig
+
+
+def make_schedule(cfg: OptimConfig, total_steps: int) -> optax.Schedule:
+    if cfg.schedule == "constant":
+        base = optax.constant_schedule(cfg.lr)
+    elif cfg.schedule == "cosine":
+        base = optax.cosine_decay_schedule(
+            cfg.lr, decay_steps=max(total_steps - cfg.warmup_steps, 1)
+        )
+    elif cfg.schedule == "linear":
+        base = optax.linear_schedule(
+            cfg.lr, 0.0, max(total_steps - cfg.warmup_steps, 1)
+        )
+    else:
+        raise ValueError(f"unknown schedule {cfg.schedule!r}")
+    if cfg.warmup_steps > 0:
+        warmup = optax.linear_schedule(0.0, cfg.lr, cfg.warmup_steps)
+        return optax.join_schedules([warmup, base], [cfg.warmup_steps])
+    return base
+
+
+def make_optimizer(cfg: OptimConfig,
+                   total_steps: int = 10_000) -> optax.GradientTransformation:
+    schedule = make_schedule(cfg, total_steps)
+    if cfg.name == "sgd":
+        opt = optax.sgd(schedule)
+    elif cfg.name == "momentum":
+        opt = optax.sgd(schedule, momentum=cfg.momentum)
+    elif cfg.name == "adam":
+        opt = optax.adam(schedule, b1=cfg.b1, b2=cfg.b2, eps=cfg.eps)
+    elif cfg.name == "adamw":
+        opt = optax.adamw(schedule, b1=cfg.b1, b2=cfg.b2, eps=cfg.eps,
+                          weight_decay=cfg.weight_decay)
+    else:
+        raise ValueError(f"unknown optimizer {cfg.name!r}")
+
+    chain = []
+    if cfg.grad_clip_norm > 0:
+        chain.append(optax.clip_by_global_norm(cfg.grad_clip_norm))
+    if cfg.weight_decay > 0 and cfg.name in ("sgd", "momentum", "adam"):
+        # L2-into-grad semantics (torch's SGD/Adam weight_decay); adamw
+        # applies decoupled decay internally instead.
+        chain.append(optax.add_decayed_weights(cfg.weight_decay))
+    chain.append(opt)
+    return optax.chain(*chain) if len(chain) > 1 else opt

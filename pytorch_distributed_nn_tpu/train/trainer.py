@@ -1,0 +1,111 @@
+"""The Trainer: config → mesh → model → data → strategy → step loop.
+
+This is the counterpart of the reference's per-strategy ``train.py``
+drivers collapsed into one driver (SURVEY.md §1 Entrypoints row): the
+hot loop is one jit-compiled step; everything else (logging cadence,
+checkpointing, metrics host-sync) happens off the critical path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Any
+
+import jax
+import numpy as np
+
+from pytorch_distributed_nn_tpu.config import TrainConfig
+from pytorch_distributed_nn_tpu.data import DataLoader, get_dataset
+from pytorch_distributed_nn_tpu.models import get_model
+from pytorch_distributed_nn_tpu.parallel import make_train_step
+from pytorch_distributed_nn_tpu.runtime.mesh import make_mesh
+from pytorch_distributed_nn_tpu.train.losses import get_loss_fn
+from pytorch_distributed_nn_tpu.train.optim import make_optimizer
+from pytorch_distributed_nn_tpu.train.state import TrainState, param_count
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class StepRecord:
+    step: int
+    loss: float
+    seconds: float
+
+
+class Trainer:
+    def __init__(self, cfg: TrainConfig, mesh=None) -> None:
+        self.cfg = cfg
+        self.mesh = mesh if mesh is not None else make_mesh(
+            cfg.mesh.resolve(len(jax.devices()))
+        )
+        self.dataset = get_dataset(
+            cfg.data.dataset,
+            seed=cfg.seed,
+            batch_size=cfg.data.batch_size,
+            seq_len=cfg.data.seq_len,
+            vocab_size=cfg.data.vocab_size,
+        )
+        self.loader = DataLoader(self.dataset, self.mesh,
+                                 prefetch=cfg.data.prefetch)
+        self.loss_fn = get_loss_fn(cfg.data.dataset)
+        self.model = get_model(cfg.model)
+        self.state = self._init_state()
+        step_fn, place_fn = make_train_step(cfg, self.mesh, self.loss_fn)
+        self.step_fn = step_fn
+        self.state = place_fn(self.state)
+        self.history: list[StepRecord] = []
+        self.data_step = 0  # next dataset step to consume (resume-aware)
+
+    def _init_state(self) -> TrainState:
+        cfg = self.cfg
+        rng = jax.random.key(cfg.seed)
+        x0, _ = self.dataset.batch(0)
+        # init on one example — shapes only; keeps init cheap for big nets
+        with jax.default_device(jax.devices()[0]):
+            variables = self.model.init(rng, x0[:1], train=False)
+        params = variables.pop("params")
+        model_state = dict(variables)
+        tx = make_optimizer(cfg.optim, total_steps=cfg.steps)
+        state = TrainState.create(
+            apply_fn=self.model.apply, params=params, tx=tx,
+            model_state=model_state,
+        )
+        log.info("model %s: %.2fM params", cfg.model.name,
+                 param_count(params) / 1e6)
+        return state
+
+    def train(self, steps: int | None = None) -> list[StepRecord]:
+        cfg = self.cfg
+        steps = steps if steps is not None else cfg.steps
+        self.loader.start_step = self.data_step  # don't replay batches
+        it = iter(self.loader)
+        t_last = time.perf_counter()
+        for i in range(steps):
+            x, y = next(it)
+            self.data_step += 1
+            self.state, metrics = self.step_fn(self.state, x, y)
+            if cfg.log_every and (i % cfg.log_every == 0 or i == steps - 1):
+                loss = float(jax.device_get(metrics["loss"]))
+                now = time.perf_counter()
+                rec = StepRecord(step=i, loss=loss, seconds=now - t_last)
+                t_last = now
+                self.history.append(rec)
+                if jax.process_index() == 0:
+                    log.info("step %d loss %.4f (%.3fs)", i, loss,
+                             rec.seconds)
+        # sync before returning so wall-clock timings are honest
+        jax.block_until_ready(self.state.params)
+        return self.history
+
+    def losses(self) -> list[float]:
+        return [r.loss for r in self.history]
+
+
+def run_preset(preset: str, **overrides: Any) -> list[StepRecord]:
+    from pytorch_distributed_nn_tpu.config import get_config
+
+    trainer = Trainer(get_config(preset, **overrides))
+    return trainer.train()

@@ -1,0 +1,62 @@
+import numpy as np
+import pytest
+
+from pytorch_distributed_nn_tpu.data import DataLoader, get_dataset
+
+
+def test_batches_deterministic_by_step():
+    d1 = get_dataset("mnist", seed=3, batch_size=16)
+    d2 = get_dataset("mnist", seed=3, batch_size=16)
+    x1, y1 = d1.batch(5)
+    x2, y2 = d2.batch(5)
+    np.testing.assert_array_equal(x1, x2)
+    np.testing.assert_array_equal(y1, y2)
+    x3, _ = d1.batch(6)
+    assert not np.array_equal(x1, x3)
+
+
+def test_images_shapes_and_learnable_structure():
+    d = get_dataset("cifar10", seed=0, batch_size=32)
+    x, y = d.batch(0)
+    assert x.shape == (32, 32, 32, 3) and y.shape == (32,)
+    assert x.dtype == np.float32 and y.dtype == np.int32
+    # same-class examples are closer to their template than cross-class
+    t = d.templates
+    same = np.mean([np.linalg.norm(x[i] - t[y[i]]) for i in range(32)])
+    cross = np.mean([np.linalg.norm(x[i] - t[(y[i] + 1) % 10])
+                     for i in range(32)])
+    assert same < cross
+
+
+def test_lm_shapes_and_shift():
+    d = get_dataset("lm_synthetic", seed=0, batch_size=4, seq_len=32,
+                    vocab_size=101)
+    x, y = d.batch(0)
+    assert x.shape == (4, 32) and y.shape == (4, 32)
+    np.testing.assert_array_equal(x[:, 1:], y[:, :-1])
+    assert x.max() < 101 and x.min() >= 0
+
+
+def test_loader_shards_batch_over_mesh(mesh8):
+    d = get_dataset("mnist", seed=0, batch_size=64)
+    loader = DataLoader(d, mesh8, prefetch=0)
+    x, y = loader.batch_at(0)
+    assert x.shape == (64, 28, 28)
+    assert len(x.sharding.device_set) == 8
+    xa, ya = d.batch(0)
+    np.testing.assert_array_equal(np.asarray(x), xa)
+
+
+def test_loader_rejects_indivisible_batch(mesh8):
+    d = get_dataset("mnist", seed=0, batch_size=12)
+    with pytest.raises(ValueError):
+        DataLoader(d, mesh8)
+
+
+def test_loader_prefetch_iterates(mesh8):
+    d = get_dataset("mnist", seed=0, batch_size=16)
+    it = iter(DataLoader(d, mesh8, prefetch=2))
+    b0 = next(it)
+    b1 = next(it)
+    np.testing.assert_array_equal(np.asarray(b0[0]), d.batch(0)[0])
+    np.testing.assert_array_equal(np.asarray(b1[0]), d.batch(1)[0])

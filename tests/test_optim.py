@@ -1,0 +1,42 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_nn_tpu.config import OptimConfig
+from pytorch_distributed_nn_tpu.train.optim import make_optimizer, make_schedule
+
+
+@pytest.mark.parametrize("name", ["sgd", "momentum", "adam", "adamw"])
+def test_optimizers_step(name):
+    tx = make_optimizer(OptimConfig(name=name, lr=0.1), total_steps=10)
+    params = {"w": jnp.ones(4)}
+    state = tx.init(params)
+    grads = {"w": jnp.full(4, 0.5)}
+    updates, _ = tx.update(grads, state, params)
+    assert np.all(np.asarray(updates["w"]) < 0)  # descent direction
+
+
+def test_warmup_schedule():
+    sched = make_schedule(
+        OptimConfig(lr=1.0, warmup_steps=10, schedule="cosine"), 100
+    )
+    assert float(sched(0)) == 0.0
+    assert float(sched(10)) == pytest.approx(1.0)
+    assert float(sched(99)) < 0.5
+
+
+def test_grad_clip_applied():
+    tx = make_optimizer(
+        OptimConfig(name="sgd", lr=1.0, grad_clip_norm=1.0), 10
+    )
+    params = {"w": jnp.zeros(4)}
+    state = tx.init(params)
+    grads = {"w": jnp.full(4, 100.0)}
+    updates, _ = tx.update(grads, state, params)
+    assert np.linalg.norm(np.asarray(updates["w"])) == pytest.approx(1.0,
+                                                                     rel=1e-3)
+
+
+def test_unknown_optimizer():
+    with pytest.raises(ValueError):
+        make_optimizer(OptimConfig(name="rmsprop"), 10)
