@@ -10,6 +10,7 @@ system"). Here configs are typed dataclasses with dotted CLI overrides
 from __future__ import annotations
 
 import copy
+import json
 from dataclasses import dataclass, field
 from typing import Any
 
@@ -59,7 +60,7 @@ class ParallelConfig:
     zero_stage: int = 3  # 1 = optimizer-state shard; 3 = params too
     microbatches: int = 1  # pipeline microbatching
     pipeline_schedule: str = "gpipe"  # gpipe | 1f1b
-    quantized_allreduce: bool = False  # EQuARX-style int8 grad allreduce
+    quantized_allreduce: str = ""  # "" | "bf16" | "int8" (EQuARX-style)
 
 
 @dataclass
@@ -99,6 +100,8 @@ def _set_dotted(obj: Any, dotted: str, value: Any) -> None:
             value = str(value).lower() in ("1", "true", "yes", "on")
         elif isinstance(current, (int, float)):
             value = type(current)(value)
+        elif isinstance(current, dict):
+            value = json.loads(value)  # e.g. --model.extra '{"d_model":64}'
     setattr(obj, leaf, value)
 
 
@@ -160,7 +163,7 @@ def _bert_base_buckets() -> TrainConfig:
         steps=100,
         optim=OptimConfig(name="adamw", lr=1e-4, weight_decay=0.01,
                           warmup_steps=10, schedule="linear"),
-        data=DataConfig(dataset="lm_synthetic", batch_size=256, seq_len=128,
+        data=DataConfig(dataset="mlm_synthetic", batch_size=256, seq_len=128,
                         vocab_size=30522),
         model=ModelConfig(name="bert_base"),
         # dp_explicit so the named "large fused gradient buckets" actually

@@ -104,6 +104,26 @@ class SyntheticLM(SyntheticDataset):
                 toks[:, 1:].astype(np.int32))
 
 
+class SyntheticMLM(SyntheticLM):
+    """Masked-LM view of the synthetic token stream (BERT pretraining,
+    BASELINE config 3): 15% of positions replaced by the [MASK] token
+    (vocab_size - 1); labels hold the original token at masked positions
+    and -1 elsewhere (ignored by ``masked_lm_xent``)."""
+
+    mask_frac = 0.15
+
+    def batch(self, step: int) -> tuple[np.ndarray, np.ndarray]:
+        tokens, _ = super().batch(step)
+        rng = np.random.default_rng(
+            np.random.SeedSequence([self.seed, step, 0xA5C])
+        )
+        mask = rng.random(tokens.shape) < self.mask_frac
+        labels = np.where(mask, tokens, -1).astype(np.int32)
+        inputs = np.where(mask, self.spec.num_classes - 1,
+                          tokens).astype(np.int32)
+        return inputs, labels
+
+
 def get_dataset(name: str, *, seed: int, batch_size: int,
                 seq_len: int = 512, vocab_size: int = 32000):
     if name == "mnist":
@@ -118,4 +138,7 @@ def get_dataset(name: str, *, seed: int, batch_size: int,
     if name == "lm_synthetic":
         return SyntheticLM(seed, batch_size, seq_len=seq_len,
                            vocab_size=vocab_size)
+    if name == "mlm_synthetic":
+        return SyntheticMLM(seed, batch_size, seq_len=seq_len,
+                            vocab_size=vocab_size)
     raise KeyError(f"unknown dataset {name!r}")

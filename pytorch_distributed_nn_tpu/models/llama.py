@@ -1,1 +1,122 @@
-"""Implemented in a later milestone (model zoo build-out)."""
+"""Llama-3-style decoder — BASELINE.json config 5's model ("Llama-3-8B
+sharded data-parallel"; SURVEY.md §2a Models row).
+
+RMSNorm, rotary embeddings (theta 500k), SwiGLU MLP, grouped-query
+attention (32 q heads / 8 kv heads at 8B scale), no biases, untied LM
+head — the architecture, not the weights (zero-egress container). The
+``llama3_8b`` builder defaults to the real 8B dims; tests shrink via
+``ModelConfig.extra``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from pytorch_distributed_nn_tpu.config import ModelConfig
+from pytorch_distributed_nn_tpu.models import register
+from pytorch_distributed_nn_tpu.nn.attention import MultiHeadAttention
+from pytorch_distributed_nn_tpu.nn.dtypes import get_policy
+
+
+class RMSNorm(nn.Module):
+    eps: float = 1e-5
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        scale = self.param("scale", nn.initializers.ones, (x.shape[-1],),
+                           self.param_dtype)
+        x32 = x.astype(jnp.float32)
+        normed = x32 * jax.lax.rsqrt(
+            jnp.mean(x32 * x32, axis=-1, keepdims=True) + self.eps
+        )
+        return (normed * scale).astype(self.dtype)
+
+
+class LlamaBlock(nn.Module):
+    num_heads: int
+    num_kv_heads: int
+    mlp_dim: int
+    rope_theta: float = 500000.0
+    attn_impl: str = "xla"
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        d = x.shape[-1]
+        y = RMSNorm(dtype=self.dtype, param_dtype=self.param_dtype,
+                    name="attn_norm")(x)
+        y = MultiHeadAttention(
+            num_heads=self.num_heads, head_dim=d // self.num_heads,
+            num_kv_heads=self.num_kv_heads, causal=True, rotary=True,
+            rope_theta=self.rope_theta, impl=self.attn_impl,
+            use_bias=False, dtype=self.dtype,
+            param_dtype=self.param_dtype, name="attn",
+        )(y)
+        x = x + y
+        y = RMSNorm(dtype=self.dtype, param_dtype=self.param_dtype,
+                    name="mlp_norm")(x)
+        gate = nn.Dense(self.mlp_dim, use_bias=False, dtype=self.dtype,
+                        param_dtype=self.param_dtype, name="gate_proj")(y)
+        up = nn.Dense(self.mlp_dim, use_bias=False, dtype=self.dtype,
+                      param_dtype=self.param_dtype, name="up_proj")(y)
+        y = nn.Dense(d, use_bias=False, dtype=self.dtype,
+                     param_dtype=self.param_dtype,
+                     name="down_proj")(nn.silu(gate) * up)
+        return x + y
+
+
+class Llama(nn.Module):
+    vocab_size: int = 128256
+    num_layers: int = 32
+    d_model: int = 4096
+    num_heads: int = 32
+    num_kv_heads: int = 8
+    mlp_dim: int = 14336
+    rope_theta: float = 500000.0
+    remat: bool = False
+    attn_impl: str = "xla"
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, tokens, *, train: bool = False):
+        x = nn.Embed(self.vocab_size, self.d_model,
+                     param_dtype=self.param_dtype,
+                     name="tok_embed")(tokens).astype(self.dtype)
+        block_cls = (nn.remat(LlamaBlock, static_argnums=(2,))
+                     if self.remat else LlamaBlock)
+        for i in range(self.num_layers):
+            x = block_cls(
+                num_heads=self.num_heads, num_kv_heads=self.num_kv_heads,
+                mlp_dim=self.mlp_dim, rope_theta=self.rope_theta,
+                attn_impl=self.attn_impl, dtype=self.dtype,
+                param_dtype=self.param_dtype, name=f"layer{i}",
+            )(x, train)
+        x = RMSNorm(dtype=self.dtype, param_dtype=self.param_dtype,
+                    name="final_norm")(x)
+        return nn.Dense(self.vocab_size, use_bias=False, dtype=jnp.float32,
+                        param_dtype=self.param_dtype, name="lm_head")(x)
+
+
+@register("llama3_8b")
+def build_llama3_8b(cfg: ModelConfig) -> Llama:
+    policy = get_policy(cfg.dtype, cfg.compute_dtype)
+    e = cfg.extra
+    return Llama(
+        vocab_size=e.get("vocab_size", 128256),
+        num_layers=e.get("num_layers", 32),
+        d_model=e.get("d_model", 4096),
+        num_heads=e.get("num_heads", 32),
+        num_kv_heads=e.get("num_kv_heads", 8),
+        mlp_dim=e.get("mlp_dim", 14336),
+        rope_theta=e.get("rope_theta", 500000.0),
+        remat=cfg.remat,
+        attn_impl=e.get("attn_impl", "xla"),
+        dtype=policy.compute_dtype,
+        param_dtype=policy.param_dtype,
+    )

@@ -1,1 +1,94 @@
-"""Implemented in a later milestone (model zoo build-out)."""
+"""ResNet-50 — BASELINE.json config 2's model ("ResNet-50 / ImageNet,
+pure data-parallel DDP allreduce"; SURVEY.md §2a Models row).
+
+NHWC layout (TPU-native: channels-last feeds the MXU's 128-lane minor
+dimension), BatchNorm running stats in the ``batch_stats`` collection.
+Under compiler-sharded DP the batch statistics are computed over the
+*global* batch (SyncBN semantics) because the batch axis is sharded, not
+vmapped — strictly stronger than torch DDP's local BN.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from pytorch_distributed_nn_tpu.config import ModelConfig
+from pytorch_distributed_nn_tpu.models import register
+from pytorch_distributed_nn_tpu.nn.dtypes import get_policy
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: int = 1
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype,
+                       param_dtype=self.param_dtype)
+        norm = partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=self.dtype,
+                       param_dtype=self.param_dtype)
+        residual = x
+        y = conv(self.filters, (1, 1), name="conv1")(x)
+        y = nn.relu(norm(name="bn1")(y))
+        y = conv(self.filters, (3, 3), strides=(self.strides,) * 2,
+                 name="conv2")(y)
+        y = nn.relu(norm(name="bn2")(y))
+        y = conv(self.filters * 4, (1, 1), name="conv3")(y)
+        # zero-init final BN scale: residual branch starts as identity
+        y = norm(name="bn3", scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = conv(self.filters * 4, (1, 1),
+                            strides=(self.strides,) * 2,
+                            name="conv_proj")(residual)
+            residual = norm(name="bn_proj")(residual)
+        return nn.relu(y + residual)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int] = (3, 4, 6, 3)  # ResNet-50
+    width: int = 64
+    num_classes: int = 1000
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.width, (7, 7), strides=(2, 2), padding=[(3, 3)] * 2,
+                    use_bias=False, dtype=self.dtype,
+                    param_dtype=self.param_dtype, name="conv_init")(x)
+        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
+                         epsilon=1e-5, dtype=self.dtype,
+                         param_dtype=self.param_dtype, name="bn_init")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for stage, n_blocks in enumerate(self.stage_sizes):
+            for block in range(n_blocks):
+                strides = 2 if stage > 0 and block == 0 else 1
+                x = BottleneckBlock(
+                    self.width * 2 ** stage, strides=strides,
+                    dtype=self.dtype, param_dtype=self.param_dtype,
+                    name=f"stage{stage}_block{block}",
+                )(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        param_dtype=self.param_dtype, name="head")(x)
+
+
+@register("resnet50")
+def build_resnet50(cfg: ModelConfig) -> ResNet:
+    policy = get_policy(cfg.dtype, cfg.compute_dtype)
+    return ResNet(
+        stage_sizes=tuple(cfg.extra.get("stage_sizes", (3, 4, 6, 3))),
+        width=cfg.extra.get("width", 64),
+        num_classes=cfg.extra.get("num_classes", 1000),
+        dtype=policy.compute_dtype,
+        param_dtype=policy.param_dtype,
+    )

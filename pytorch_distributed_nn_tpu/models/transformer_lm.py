@@ -1,1 +1,121 @@
-"""Implemented in a later milestone (model zoo build-out)."""
+"""Decoder-only Transformer-LM — BASELINE.json config 4's model
+("Transformer-LM pipeline-parallel"; SURVEY.md §2a Models row).
+
+GPT-style pre-LN blocks. The block stack is written as a single scanned
+module when ``remat`` is on — ``nn.remat_scan`` gives O(1) compile-time in
+depth and rematerialised activations (SURVEY.md §7 hard part (e)); the
+pipeline strategy instead slices the stack into per-stage segments.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from pytorch_distributed_nn_tpu.config import ModelConfig
+from pytorch_distributed_nn_tpu.models import register
+from pytorch_distributed_nn_tpu.nn.attention import MultiHeadAttention
+from pytorch_distributed_nn_tpu.nn.dtypes import get_policy
+
+
+class DecoderBlock(nn.Module):
+    num_heads: int
+    mlp_dim: int
+    dropout: float = 0.0
+    attn_impl: str = "xla"
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        d = x.shape[-1]
+        y = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="ln1")(x)
+        y = MultiHeadAttention(
+            num_heads=self.num_heads, head_dim=d // self.num_heads,
+            causal=True, impl=self.attn_impl, dtype=self.dtype,
+            param_dtype=self.param_dtype, name="attn",
+        )(y)
+        if self.dropout:
+            y = nn.Dropout(self.dropout, deterministic=not train)(y)
+        x = x + y
+        y = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="ln2")(x)
+        y = nn.Dense(self.mlp_dim, dtype=self.dtype,
+                     param_dtype=self.param_dtype, name="mlp_in")(y)
+        y = nn.gelu(y)
+        y = nn.Dense(d, dtype=self.dtype, param_dtype=self.param_dtype,
+                     name="mlp_out")(y)
+        if self.dropout:
+            y = nn.Dropout(self.dropout, deterministic=not train)(y)
+        return x + y
+
+
+class TransformerLM(nn.Module):
+    vocab_size: int = 32000
+    num_layers: int = 12
+    d_model: int = 768
+    num_heads: int = 12
+    mlp_dim: int = 3072
+    max_len: int = 2048
+    dropout: float = 0.0
+    remat: bool = False
+    attn_impl: str = "xla"
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    def block_kwargs(self) -> dict:
+        return dict(num_heads=self.num_heads, mlp_dim=self.mlp_dim,
+                    dropout=self.dropout, attn_impl=self.attn_impl,
+                    dtype=self.dtype, param_dtype=self.param_dtype)
+
+    @nn.compact
+    def __call__(self, tokens, *, train: bool = False,
+                 positions: Optional[jnp.ndarray] = None):
+        T = tokens.shape[1]
+        if T > self.max_len:
+            raise ValueError(
+                f"sequence length {T} exceeds max_len {self.max_len}"
+            )
+        x = nn.Embed(self.vocab_size, self.d_model,
+                     param_dtype=self.param_dtype, name="tok_embed")(tokens)
+        if positions is None:
+            positions = jnp.arange(T)[None]
+        pos = nn.Embed(self.max_len, self.d_model,
+                       param_dtype=self.param_dtype,
+                       name="pos_embed")(positions)
+        x = (x + pos).astype(self.dtype)
+        block_cls = DecoderBlock
+        if self.remat:
+            # static_argnums counts (self, x, train) — train must be
+            # static or `deterministic=not train` fails on a tracer
+            block_cls = nn.remat(DecoderBlock, static_argnums=(2,))
+        for i in range(self.num_layers):
+            x = block_cls(**self.block_kwargs(), name=f"block{i}")(
+                x, train
+            )
+        x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="ln_f")(x)
+        return nn.Dense(self.vocab_size, use_bias=False, dtype=jnp.float32,
+                        param_dtype=self.param_dtype, name="lm_head")(x)
+
+
+@register("transformer_lm")
+def build_transformer_lm(cfg: ModelConfig) -> TransformerLM:
+    policy = get_policy(cfg.dtype, cfg.compute_dtype)
+    e = cfg.extra
+    return TransformerLM(
+        vocab_size=e.get("vocab_size", 32000),
+        num_layers=e.get("num_layers", 12),
+        d_model=e.get("d_model", 768),
+        num_heads=e.get("num_heads", 12),
+        mlp_dim=e.get("mlp_dim", 3072),
+        max_len=e.get("max_len", 2048),
+        dropout=e.get("dropout", 0.0),
+        remat=cfg.remat,
+        attn_impl=e.get("attn_impl", "xla"),
+        dtype=policy.compute_dtype,
+        param_dtype=policy.param_dtype,
+    )

@@ -1,0 +1,112 @@
+"""Multi-head attention shared by the transformer families.
+
+One module covers BERT (bidirectional), Transformer-LM (causal), and
+Llama (causal + rotary + grouped-query). The inner product is routed
+through :func:`dot_product_attention`, which selects the implementation:
+``xla`` (einsum softmax — XLA fuses this well for moderate sequence
+lengths) or ``flash`` (the Pallas blockwise kernel, ops/pallas/) once the
+sequence is long enough to be HBM-bound. Ring/context-parallel attention
+wraps the same kernel over the ``seq`` mesh axis (parallel/sequence.py).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+def rotary_embedding(q, k, *, theta: float = 10000.0, positions=None):
+    """Apply rotary position embeddings to q, k of shape (B, T, H, D)."""
+    d = q.shape[-1]
+    if d % 2:
+        raise ValueError(f"rotary needs even head_dim, got {d}")
+    if positions is None:
+        positions = jnp.arange(q.shape[1])[None, :]  # (1, T)
+    freqs = theta ** (-jnp.arange(0, d // 2) * 2.0 / d)  # (D/2,)
+    angles = positions[..., None] * freqs  # (B?, T, D/2)
+    cos = jnp.cos(angles)[:, :, None, :]  # (B?, T, 1, D/2)
+    sin = jnp.sin(angles)[:, :, None, :]
+
+    def rotate(x):
+        x1, x2 = jnp.split(x, 2, axis=-1)
+        return jnp.concatenate(
+            [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+        )
+
+    return rotate(q), rotate(k)
+
+
+def dot_product_attention(
+    q, k, v, *, causal: bool, impl: str = "xla",
+    mask: Optional[jax.Array] = None,
+):
+    """q: (B, T, H, D); k/v: (B, S, Hkv, D) with H % Hkv == 0.
+
+    Returns (B, T, H, D). f32 softmax accumulation regardless of input
+    dtype (MXU-friendly: bf16 operands, f32 accumulate).
+    """
+    if impl not in ("xla", "flash"):
+        raise ValueError(f"unknown attention impl {impl!r}")
+    B, T, H, D = q.shape
+    S, Hkv = k.shape[1], k.shape[2]
+    if H != Hkv:  # grouped-query: repeat kv heads
+        k = jnp.repeat(k, H // Hkv, axis=2)
+        v = jnp.repeat(v, H // Hkv, axis=2)
+    if impl == "flash":
+        if mask is not None:
+            raise ValueError(
+                "flash impl does not take a padding mask; use impl='xla'"
+            )
+        from pytorch_distributed_nn_tpu.ops.pallas.flash_attention import (
+            flash_attention,
+        )
+
+        return flash_attention(q, k, v, causal=causal)
+    scale = D ** -0.5
+    logits = jnp.einsum(
+        "bthd,bshd->bhts", q, k, preferred_element_type=jnp.float32
+    ) * scale
+    if causal:
+        causal_mask = jnp.tril(jnp.ones((T, S), dtype=bool))
+        logits = jnp.where(causal_mask[None, None], logits, -1e30)
+    if mask is not None:  # (B, S) padding mask
+        logits = jnp.where(mask[:, None, None, :], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bhts,bshd->bthd", probs, v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+class MultiHeadAttention(nn.Module):
+    num_heads: int
+    head_dim: int
+    num_kv_heads: Optional[int] = None  # None = MHA; < num_heads = GQA
+    causal: bool = False
+    rotary: bool = False
+    rope_theta: float = 10000.0
+    impl: str = "xla"
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x, mask: Optional[jax.Array] = None):
+        kv_heads = self.num_kv_heads or self.num_heads
+        dense = lambda heads, name: nn.DenseGeneral(  # noqa: E731
+            (heads, self.head_dim), axis=-1, name=name, dtype=self.dtype,
+            param_dtype=self.param_dtype, use_bias=self.use_bias,
+        )
+        q = dense(self.num_heads, "query")(x)
+        k = dense(kv_heads, "key")(x)
+        v = dense(kv_heads, "value")(x)
+        if self.rotary:
+            q, k = rotary_embedding(q, k, theta=self.rope_theta)
+            q, k = q.astype(self.dtype), k.astype(self.dtype)
+        out = dot_product_attention(q, k, v, causal=self.causal,
+                                    impl=self.impl, mask=mask)
+        return nn.DenseGeneral(
+            x.shape[-1], axis=(-2, -1), name="out", dtype=self.dtype,
+            param_dtype=self.param_dtype, use_bias=self.use_bias,
+        )(out)

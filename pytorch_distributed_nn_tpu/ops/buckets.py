@@ -15,9 +15,11 @@ pack to ``bucket_mb``, one ``pmean`` per bucket, unpack. All shapes are
 static, so this costs two reshapes per leaf at trace time and nothing at
 run time beyond the collectives themselves.
 
-``quantized=True`` compresses each bucket to bfloat16 on the wire
-(EQuARX-style lossy allreduce, PAPERS.md) — halves bus traffic for f32
-grads; the int8 Pallas variant plugs in here later.
+``quantized`` compresses the wire format (EQuARX-style, PAPERS.md):
+``"bf16"``/True halves f32 traffic by casting; ``"int8"`` quarters it —
+stochastic-rounded symmetric int8 (Pallas hardware-PRNG kernel on TPU)
+with an exact int32 psum and a shared pmax scale, so the reduction itself
+loses nothing beyond the 8-bit encode.
 """
 
 from __future__ import annotations
@@ -57,12 +59,20 @@ def make_bucket_reduce(
     *,
     bucket_mb: float = 25.0,
     axis=("data", "fsdp"),
-    quantized: bool = False,
+    quantized: bool | str = False,
 ) -> Callable:
-    """Build the bucketed gradient-mean transform (runs inside shard_map)."""
-    bucket_bytes = int(bucket_mb * 1024 * 1024)
+    """Build the bucketed gradient-mean transform (runs inside shard_map).
 
-    def reduce_grads(grads):
+    ``quantized``: False (exact), "bf16"/True (cast wire), or "int8"
+    (stochastic-rounded; ``seed`` keyword decorrelates rounding across
+    steps — pass the step counter).
+    """
+    bucket_bytes = int(bucket_mb * 1024 * 1024)
+    mode = {False: None, True: "bf16"}.get(quantized, quantized)
+    if mode not in (None, "bf16", "int8"):
+        raise ValueError(f"unknown quantized mode {quantized!r}")
+
+    def reduce_grads(grads, *, seed=0):
         leaves, treedef = jax.tree.flatten(grads)
         # Reverse order: last-layer grads are ready first in backward, so
         # their bucket's allreduce can start earliest (DDP's heuristic).
@@ -74,12 +84,34 @@ def make_bucket_reduce(
             by_dtype.setdefault(leaves[i].dtype, []).append(i)
 
         reduced: dict[int, jax.Array] = {}
+        bucket_counter = 0  # global across dtype groups: unique seeds
         for dtype, idx_group in by_dtype.items():
             sizes = [leaves[i].size * dtype.itemsize for i in idx_group]
             for bucket in partition_buckets(sizes, bucket_bytes):
+                bucket_counter += 1
                 idxs = [idx_group[j] for j in bucket]
                 flat = jnp.concatenate([leaves[i].ravel() for i in idxs])
-                if quantized and flat.dtype.itemsize > 2:
+                if mode == "int8" and jnp.issubdtype(dtype, jnp.floating):
+                    from pytorch_distributed_nn_tpu.ops.pallas.quantize import (
+                        dequantize_int8,
+                        quantize_int8,
+                    )
+
+                    absmax = cc.all_reduce_max(
+                        jnp.abs(flat).max(), axis
+                    )
+                    scale = jnp.maximum(absmax / 127.0, 1e-12)
+                    # decorrelate rounding noise across devices so it
+                    # averages down ~1/sqrt(n) in the mean
+                    dev = cc.linear_axis_index(axis)
+                    tile_seed = (seed * 65537 + bucket_counter * 257
+                                 + dev)
+                    q = quantize_int8(flat.astype(jnp.float32),
+                                      scale, seed=tile_seed)
+                    total = cc.all_reduce_sum(q.astype(jnp.int32), axis)
+                    n = cc.axis_size(axis)
+                    mean = (dequantize_int8(total, scale) / n).astype(dtype)
+                elif mode == "bf16" and flat.dtype.itemsize > 2:
                     wire = flat.astype(jnp.bfloat16)
                     mean = cc.all_reduce_mean(wire, axis).astype(dtype)
                 else:

@@ -201,6 +201,16 @@ def axis_size(axis: AxisName) -> int:
     return _axis_size(axis)
 
 
+def linear_axis_index(axis: AxisName):
+    """Row-major rank within one or several mesh axes (the flat
+    ``dist.get_rank()`` over a sub-grid)."""
+    names = (axis,) if isinstance(axis, str) else tuple(axis)
+    idx = 0
+    for name in names:
+        idx = idx * lax.axis_size(name) + lax.axis_index(name)
+    return idx
+
+
 # ---------------------------------------------------------------------------
 # Tree-level helpers (whole-pytree variants used by the strategies)
 # ---------------------------------------------------------------------------

@@ -1,0 +1,4 @@
+"""Pallas TPU kernels for the hot ops (SURVEY.md §2b: the TPU-native
+replacement for the reference's cuDNN/NCCL kernel layer). Every kernel has
+a jnp reference implementation used on non-TPU backends (CPU tests) and as
+the correctness oracle."""

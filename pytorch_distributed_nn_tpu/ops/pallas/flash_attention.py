@@ -1,0 +1,145 @@
+"""Blockwise (flash) attention Pallas TPU kernel.
+
+Replaces the reference's cuDNN/SDPA attention (SURVEY.md §2b ATen row)
+with an HBM-friendly TPU kernel: Q blocks stay resident in VMEM while K/V
+stream through, online softmax keeps running (max, denom) so the (T, T)
+score matrix never materialises in HBM. bf16 operands hit the MXU; all
+accumulation is f32.
+
+On non-TPU backends (the CPU test mesh) :func:`flash_attention` falls back
+to the jnp reference — same math, same signature — so CPU tests exercise
+callers' integration while the kernel itself is validated on the real
+chip (tests/test_pallas.py + bench).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _attention_reference(q, k, v, *, causal: bool):
+    """jnp oracle: (BH, T, D) inputs."""
+    T, S = q.shape[1], k.shape[1]
+    logits = jnp.einsum(
+        "btd,bsd->bts", q, k, preferred_element_type=jnp.float32
+    ) * (q.shape[-1] ** -0.5)
+    if causal:
+        mask = jnp.tril(jnp.ones((T, S), dtype=bool))
+        logits = jnp.where(mask[None], logits, NEG_INF)
+    probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
+    return jnp.einsum("bts,bsd->btd", probs, v,
+                      preferred_element_type=jnp.float32).astype(q.dtype)
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, causal: bool,
+                  block_q: int, block_k: int, seq_len: int):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32)  # (block_q, D)
+    scale = q.shape[-1] ** -0.5
+    q = q * scale
+    num_k_blocks = pl.cdiv(seq_len, block_k)
+    # causal: skip K blocks entirely in the future of this Q block
+    if causal:
+        k_limit = jnp.minimum(
+            num_k_blocks, (qi + 1) * block_q // block_k + 1
+        )
+    else:
+        k_limit = num_k_blocks
+
+    def body(j, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k)].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k)].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(-1, keepdims=True)
+        acc_new = acc * corr + jnp.dot(
+            p, v_blk, preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc_new
+
+    d = q.shape[-1]
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, d), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, k_limit, body, (m0, l0, acc0))
+    o_ref[0] = (acc / jnp.maximum(l, 1e-30)).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k"))
+def _flash_bhtd(q, k, v, *, causal: bool, block_q: int, block_k: int):
+    """(BH, T, D) flash attention via pallas_call."""
+    BH, T, D = q.shape
+    grid = (BH, pl.cdiv(T, block_q))
+    kernel = functools.partial(
+        _flash_kernel, causal=causal, block_q=block_q, block_k=block_k,
+        seq_len=T,
+    )
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, T, D), lambda bh, qi: (bh, 0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, T, D), lambda bh, qi: (bh, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+        cost_estimate=pl.CostEstimate(
+            flops=4 * BH * T * T * D,
+            bytes_accessed=3 * BH * T * D * q.dtype.itemsize,
+            transcendentals=BH * T * T,
+        ),
+    )(q, k, v)
+
+
+def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 128,
+                    block_k: int = 128):
+    """(B, T, H, D) attention. KV heads must already be expanded to match
+    Q heads (the caller handles GQA). Falls back to the jnp reference off
+    TPU."""
+    B, T, H, D = q.shape
+    if k.shape[2] != H:
+        raise ValueError(
+            f"flash_attention expects expanded kv heads ({k.shape[2]} vs "
+            f"{H}); repeat kv before calling"
+        )
+
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+
+    def from_bh(x):
+        return x.reshape(B, H, T, D).transpose(0, 2, 1, 3)
+
+    qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
+    if jax.default_backend() != "tpu":
+        return from_bh(_attention_reference(qb, kb, vb, causal=causal))
+    block_q = min(block_q, T)
+    block_k = min(block_k, T)
+    if T % block_q or T % block_k:
+        return from_bh(_attention_reference(qb, kb, vb, causal=causal))
+    return from_bh(
+        _flash_bhtd(qb, kb, vb, causal=causal, block_q=block_q,
+                    block_k=block_k)
+    )

@@ -22,7 +22,7 @@ from pytorch_distributed_nn_tpu.train.state import TrainState
 
 
 def make_train_step(
-    cfg: TrainConfig, mesh: Mesh, loss_fn: Callable
+    cfg: TrainConfig, mesh: Mesh, loss_fn: Callable, model=None
 ) -> tuple[Callable, Callable[[TrainState], TrainState]]:
     """Returns ``(step_fn, place_state_fn)``: the compiled step and the
     function that lays the freshly-initialised TrainState out on the mesh
@@ -37,18 +37,25 @@ def make_train_step(
                 "(the compiler-sharded 'dp' path owns its own collectives) "
                 "— ignoring"
             )
-        step = dp.make_dp_train_step(mesh, loss_fn)
-        return step, lambda s: dp.replicate_state(s, mesh)
+        return dp.make_dp_train_step(mesh, loss_fn)
     if strategy == "dp_explicit":
+        quant = cfg.parallel.quantized_allreduce
+        if quant.lower() in ("true", "1", "yes", "on"):  # legacy bool flag
+            quant = "bf16"
+        bucket_mb = cfg.parallel.bucket_mb
+        if bucket_mb <= 0 and quant:
+            # quantization rides the bucket path; one giant bucket keeps
+            # it active when bucketing is "off"
+            bucket_mb = 1e9
         bucket_reduce = None
-        if cfg.parallel.bucket_mb > 0:
+        if bucket_mb > 0:
             from pytorch_distributed_nn_tpu.ops.buckets import (
                 make_bucket_reduce,
             )
 
             bucket_reduce = make_bucket_reduce(
-                bucket_mb=cfg.parallel.bucket_mb,
-                quantized=cfg.parallel.quantized_allreduce,
+                bucket_mb=bucket_mb,
+                quantized=quant or False,
             )
         step = dp.make_dp_train_step_explicit(
             mesh, loss_fn, bucket_reduce=bucket_reduce
@@ -63,5 +70,7 @@ def make_train_step(
     if strategy == "pipeline":
         from pytorch_distributed_nn_tpu.parallel import pipeline
 
-        return pipeline.make_pipeline_train_step(cfg, mesh, loss_fn)
+        if model is None:
+            raise ValueError("pipeline strategy needs the model instance")
+        return pipeline.make_pipeline_train_step(cfg, mesh, loss_fn, model)
     raise ValueError(f"unknown strategy {strategy!r}")

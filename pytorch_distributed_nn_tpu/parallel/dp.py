@@ -40,15 +40,21 @@ DATA_AXES = (AXIS_DATA, AXIS_FSDP)
 
 
 def forward(state: TrainState, params, x, *, train: bool):
-    """Run the model, threading mutable collections (BatchNorm stats) when
-    present. Returns (logits, new_model_state)."""
+    """Run the model, threading mutable collections (BatchNorm stats) and
+    a per-step dropout PRNG. Returns (logits, new_model_state)."""
     variables = {"params": params, **state.model_state}
+    # deterministic per-step dropout stream seeded from the TrainState's
+    # base key (cfg.seed); under jit-sharding the mask generation
+    # partitions with the batch (threefry is partitionable)
+    rngs = {"dropout": jax.random.fold_in(state.rng, state.step)}
     if train and state.model_state:
         logits, updated = state.apply_fn(
-            variables, x, train=True, mutable=list(state.model_state)
+            variables, x, train=True, mutable=list(state.model_state),
+            rngs=rngs,
         )
         return logits, dict(updated)
-    logits = state.apply_fn(variables, x, train=train)
+    logits = state.apply_fn(variables, x, train=train,
+                            rngs=rngs if train else None)
     return logits, state.model_state
 
 
@@ -64,35 +70,20 @@ def _loss_and_grads(state, x, y, loss_fn):
     return loss, new_model_state, grads
 
 
-def make_dp_train_step(
-    mesh: Mesh,
-    loss_fn: Callable,
-    *,
-    donate: bool = True,
-):
-    """Compiler-sharded DP step: ``step(state, x, y) -> (state, metrics)``.
+def make_dp_train_step(mesh: Mesh, loss_fn: Callable):
+    """Compiler-sharded DP step: ``(step, place_state)``.
 
-    Sharding contract: every TrainState leaf replicated, batch sharded
-    over data×fsdp. Gradients of a global-batch-mean loss w.r.t.
-    replicated params make XLA emit exactly one all-reduce per parameter
-    (fused and overlapped by the async-collective scheduler).
+    Sharding contract: TrainState replicated over the data axes (TP rules
+    still shard over ``tensor`` when that axis is >1), batch sharded over
+    data×fsdp. Gradients of a global-batch-mean loss w.r.t. replicated
+    params make XLA emit exactly one all-reduce per parameter (fused and
+    overlapped by the async-collective scheduler). Implemented as
+    ZeRO-stage-0 — DP is the layout special case, not a separate code
+    path.
     """
-    replicated = NamedSharding(mesh, P())
-    batch_sh = NamedSharding(mesh, batch_pspec())
+    from pytorch_distributed_nn_tpu.parallel import zero
 
-    def step(state: TrainState, x, y):
-        loss, new_model_state, grads = _loss_and_grads(state, x, y, loss_fn)
-        new_state = state.apply_gradients(grads).replace(
-            model_state=new_model_state
-        )
-        return new_state, {"loss": loss}
-
-    return jax.jit(
-        step,
-        in_shardings=(replicated, batch_sh, batch_sh),
-        out_shardings=(replicated, replicated),
-        donate_argnums=(0,) if donate else (),
-    )
+    return zero.make_zero_train_step(mesh, loss_fn, stage=0)
 
 
 def make_dp_train_step_explicit(
@@ -112,9 +103,11 @@ def make_dp_train_step_explicit(
     replicated = NamedSharding(mesh, P())
     batch_sh = NamedSharding(mesh, batch_pspec())
 
-    reduce_grads = bucket_reduce or partial(
-        cc.tree_all_reduce_mean, axis=DATA_AXES
-    )
+    if bucket_reduce is None:
+        def bucket_reduce(grads, *, seed=0):
+            return cc.tree_all_reduce_mean(grads, DATA_AXES)
+
+    reduce_grads = bucket_reduce
 
     @partial(
         jax.shard_map,
@@ -124,10 +117,23 @@ def make_dp_train_step_explicit(
         check_vma=False,
     )
     def step(state: TrainState, x, y):
+        # Decorrelate dropout masks across devices (single-device golden
+        # equivalence for dropout>0 holds only for the compiler-sharded
+        # path, where one global mask exists).
+        dev = cc.axis_index(AXIS_DATA) * cc.axis_size(AXIS_FSDP) \
+            + cc.axis_index(AXIS_FSDP)
+        # fwd-only view: the per-device fold must not escape into the
+        # (replicated) output state
+        fwd_state = state.replace(rng=jax.random.fold_in(state.rng, dev))
         # Per-device microloss on the local shard; mean of per-device
-        # means == global mean because shards are equal-sized.
-        loss, new_model_state, grads = _loss_and_grads(state, x, y, loss_fn)
-        grads = reduce_grads(grads)
+        # means == global mean because shards are equal-sized. (For
+        # token-weighted losses like masked_lm_xent this reproduces torch
+        # DDP's per-rank-denominator semantics — reference parity — not
+        # the exact global mean the compiler-sharded path computes.)
+        loss, new_model_state, grads = _loss_and_grads(
+            fwd_state, x, y, loss_fn
+        )
+        grads = reduce_grads(grads, seed=state.step)
         loss = cc.all_reduce_mean(loss, DATA_AXES)
         # model_state (BN stats) must agree across replicas: average like
         # grads (SyncBN semantics — torch DDP leaves them local, which

@@ -1,0 +1,309 @@
+"""Pipeline parallelism — BASELINE.json config 4: "Transformer-LM
+pipeline-parallel (torch.distributed send/recv p2p)".
+
+The reference moves activations between stage ranks with blocking
+``dist.send``/``dist.recv`` and hand-schedules the backward pass
+(SURVEY.md §3.3). TPU-native design (SURVEY.md §7 hard part (b)):
+
+- the block stack is *stacked* into per-stage parameter groups — every
+  leaf gains a leading ``(n_stages, layers_per_stage, ...)`` dim, sharded
+  over the ``pipe`` mesh axis;
+- one ``shard_map`` over ``pipe`` runs the GPipe fill-drain schedule as a
+  ``lax.scan`` over ticks; stage s's output reaches stage s+1 via
+  ``lax.ppermute`` over the ICI ring — the send/recv pair as one
+  collective;
+- the *backward* pipeline comes from AD: transposing the scan reverses
+  the tick order and transposes each ppermute edge s→s+1 into s+1→s,
+  which is exactly the reference's hand-written reverse send/recv chain;
+- embedding and head are cheap and stay *outside* the shard_map,
+  replicated over ``pipe`` and sharded over batch like any DP compute, so
+  pipeline composes with data parallelism on the same mesh.
+
+Bubble accounting matches GPipe: S+M-1 ticks for M microbatches over S
+stages; every stage computes on every tick (fill/drain ticks process
+garbage that is masked out of the output slots and contributes zero
+gradient).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from pytorch_distributed_nn_tpu.config import TrainConfig
+from pytorch_distributed_nn_tpu.runtime.mesh import (
+    AXIS_PIPE,
+    batch_pspec,
+)
+from pytorch_distributed_nn_tpu.train.state import TrainState
+
+
+@dataclasses.dataclass
+class StagePartition:
+    """How to split one model family into (embed | blocks | head)."""
+
+    block_names: list[str]  # ordered param-tree keys of the block stack
+    embed: Callable  # (params, tokens) -> activations
+    block: Callable  # (one_block_params, x) -> x
+    head: Callable  # (params, x) -> logits
+
+
+def partition_for(model) -> StagePartition:
+    """Build the stage partition for a supported model family by
+    re-instantiating its leaf modules (no duplicated math)."""
+    from pytorch_distributed_nn_tpu.models.llama import Llama, LlamaBlock, RMSNorm
+    from pytorch_distributed_nn_tpu.models.transformer_lm import (
+        DecoderBlock,
+        TransformerLM,
+    )
+
+    if isinstance(model, TransformerLM):
+        block_mod = DecoderBlock(**model.block_kwargs())
+        tok = nn.Embed(model.vocab_size, model.d_model,
+                       param_dtype=model.param_dtype)
+        pos = nn.Embed(model.max_len, model.d_model,
+                       param_dtype=model.param_dtype)
+        ln_f = nn.LayerNorm(dtype=model.dtype,
+                            param_dtype=model.param_dtype)
+        lm_head = nn.Dense(model.vocab_size, use_bias=False,
+                           dtype=jnp.float32,
+                           param_dtype=model.param_dtype)
+
+        def embed(params, tokens):
+            T = tokens.shape[1]
+            x = tok.apply({"params": params["tok_embed"]}, tokens)
+            x = x + pos.apply({"params": params["pos_embed"]},
+                              jnp.arange(T)[None])
+            return x.astype(model.dtype)
+
+        def block(p, x):
+            return block_mod.apply({"params": p}, x, train=True)
+
+        def head(params, x):
+            x = ln_f.apply({"params": params["ln_f"]}, x)
+            return lm_head.apply({"params": params["lm_head"]}, x)
+
+        names = [f"block{i}" for i in range(model.num_layers)]
+        return StagePartition(names, embed, block, head)
+
+    if isinstance(model, Llama):
+        block_mod = LlamaBlock(
+            num_heads=model.num_heads, num_kv_heads=model.num_kv_heads,
+            mlp_dim=model.mlp_dim, rope_theta=model.rope_theta,
+            attn_impl=model.attn_impl, dtype=model.dtype,
+            param_dtype=model.param_dtype,
+        )
+        tok = nn.Embed(model.vocab_size, model.d_model,
+                       param_dtype=model.param_dtype)
+        norm = RMSNorm(dtype=model.dtype, param_dtype=model.param_dtype)
+        lm_head = nn.Dense(model.vocab_size, use_bias=False,
+                           dtype=jnp.float32,
+                           param_dtype=model.param_dtype)
+
+        def embed(params, tokens):
+            x = tok.apply({"params": params["tok_embed"]}, tokens)
+            return x.astype(model.dtype)
+
+        def block(p, x):
+            return block_mod.apply({"params": p}, x, train=True)
+
+        def head(params, x):
+            x = norm.apply({"params": params["final_norm"]}, x)
+            return lm_head.apply({"params": params["lm_head"]}, x)
+
+        names = [f"layer{i}" for i in range(model.num_layers)]
+        return StagePartition(names, embed, block, head)
+
+    raise ValueError(
+        f"pipeline parallelism supports TransformerLM/Llama, got "
+        f"{type(model).__name__}"
+    )
+
+
+def stack_stage_params(params: dict, part: StagePartition,
+                       n_stages: int) -> dict:
+    """Restack flat per-block params into a stacked (S, K, ...) tree plus
+    the non-block remainder. Keeps single-device init bit-identical to the
+    unpipelined model (golden-equivalence oracle)."""
+    L = len(part.block_names)
+    if L % n_stages:
+        raise ValueError(f"{L} blocks not divisible by {n_stages} stages")
+    blocks = [params[name] for name in part.block_names]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *blocks)
+    # (L, ...) -> (S, K, ...)
+    stacked = jax.tree.map(
+        lambda x: x.reshape((n_stages, L // n_stages) + x.shape[1:]),
+        stacked,
+    )
+    rest = {k: v for k, v in params.items() if k not in part.block_names}
+    return {"stages": stacked, "rest": rest}
+
+
+def unstack_stage_params(params: dict, part: StagePartition) -> dict:
+    """Inverse of :func:`stack_stage_params` (for checkpoint export)."""
+    stacked = params["stages"]
+    flat = jax.tree.map(
+        lambda x: x.reshape((-1,) + x.shape[2:]), stacked
+    )
+    out = dict(params["rest"])
+    for i, name in enumerate(part.block_names):
+        out[name] = jax.tree.map(lambda x: x[i], flat)
+    return out
+
+
+def _stage_apply(part: StagePartition, stage_params, x):
+    """Run this device's K blocks sequentially (scan over the stacked
+    leading dim)."""
+    def body(h, p):
+        return part.block(p, h), None
+
+    out, _ = lax.scan(body, x, stage_params)
+    return out
+
+
+def make_pipeline_train_step(cfg: TrainConfig, mesh: Mesh,
+                             loss_fn: Callable, model):
+    S = mesh.shape[AXIS_PIPE]
+    M = max(cfg.parallel.microbatches, 1)
+    if S < 2:
+        raise ValueError("pipeline strategy needs mesh.pipe >= 2")
+    if getattr(model, "dropout", 0.0):
+        raise ValueError(
+            "pipeline strategy does not support dropout yet; set "
+            "model dropout to 0"
+        )
+    part = partition_for(model)
+
+    fwd_edges = [(i, i + 1) for i in range(S - 1)]  # no wraparound
+
+    def pipelined_blocks(stage_params, x_mb):
+        """Inside shard_map over `pipe` (and the data axes). stage_params:
+        local (1, K, ...) tree — squeeze the pipe dim; x_mb: (M, mb, T, D)
+        local batch shard."""
+        stage_params = jax.tree.map(lambda p: p.squeeze(0), stage_params)
+        idx = lax.axis_index(AXIS_PIPE)
+        mb_shape = x_mb.shape[1:]
+        buf = jnp.zeros(mb_shape, x_mb.dtype)
+        outputs = jnp.zeros_like(x_mb)
+
+        def tick(carry, t):
+            buf, outputs = carry
+            feed = x_mb[jnp.clip(t, 0, M - 1)]
+            x_in = jnp.where(idx == 0, feed, buf)
+            y = _stage_apply(part, stage_params, x_in)
+            sent = lax.ppermute(y, AXIS_PIPE, fwd_edges)
+            out_t = t - (S - 1)
+            write = jnp.logical_and(idx == S - 1, out_t >= 0)
+            outputs = lax.cond(
+                write,
+                lambda o: lax.dynamic_update_index_in_dim(
+                    o, y, jnp.clip(out_t, 0, M - 1), 0
+                ),
+                lambda o: o,
+                outputs,
+            )
+            return (sent, outputs), None
+
+        (_, outputs), _ = lax.scan(
+            tick, (buf, outputs), jnp.arange(M + S - 1)
+        )
+        # everyone needs the last stage's outputs for the (replicated)
+        # head: broadcast by masked psum over pipe
+        outputs = lax.psum(
+            jnp.where(idx == S - 1, outputs, jnp.zeros_like(outputs)),
+            AXIS_PIPE,
+        )
+        return outputs
+
+    data_spec = batch_pspec()  # P(('data','fsdp'))
+    x_mb_spec = P(None, ("data", "fsdp"))  # (M, mb, T, D)
+    stage_spec = P(AXIS_PIPE)
+
+    sharded_pipeline = jax.shard_map(
+        pipelined_blocks,
+        mesh=mesh,
+        in_specs=(stage_spec, x_mb_spec),
+        out_specs=x_mb_spec,
+        check_vma=False,
+    )
+
+    def step(state: TrainState, tokens, targets):
+        B = tokens.shape[0]
+        if B % M:
+            raise ValueError(f"batch {B} not divisible by {M} microbatches")
+
+        def compute(params):
+            h = part.embed(params["rest"], tokens)  # (B, T, D)
+            h_mb = h.reshape((M, B // M) + h.shape[1:])
+            h_mb = sharded_pipeline(params["stages"], h_mb)
+            h = h_mb.reshape((B,) + h_mb.shape[2:])
+            logits = part.head(params["rest"], h)
+            return loss_fn(logits, targets)
+
+        loss, grads = jax.value_and_grad(compute)(state.params)
+        new_state = state.apply_gradients(grads)
+        return new_state, {"loss": loss}
+
+    replicated = NamedSharding(mesh, P())
+    batch_sh = NamedSharding(mesh, data_spec)
+
+    def shardings_of(state):
+        # stages sharded over pipe (leading dim); everything else
+        # replicated
+        stage_sh = jax.tree.map(
+            lambda _: NamedSharding(mesh, stage_spec),
+            state.params["stages"],
+        )
+        param_sh = {"stages": stage_sh,
+                    "rest": jax.tree.map(lambda _: replicated,
+                                         state.params["rest"])}
+        return state.replace(
+            step=replicated,
+            rng=replicated,
+            params=param_sh,
+            model_state=jax.tree.map(lambda _: replicated,
+                                     state.model_state),
+            opt_state=_opt_shardings(state.opt_state, mesh),
+        )
+
+    def _opt_shardings(opt_state, mesh):
+        # optimizer moments mirror param shapes: shard any leaf whose
+        # leading dims match the stacked (S, K, ...) pattern
+        def spec_of(x):
+            if hasattr(x, "ndim") and x.ndim >= 2 and x.shape[0] == S:
+                return NamedSharding(mesh, stage_spec)
+            return replicated
+
+        return jax.tree.map(spec_of, opt_state)
+
+    compiled: dict = {}
+
+    def place_state(state: TrainState) -> TrainState:
+        stacked_params = stack_stage_params(state.params, part, S)
+        state = TrainState.create(
+            apply_fn=state.apply_fn, params=stacked_params, tx=state.tx,
+            model_state=state.model_state, rng=state.rng,
+        )
+        sh = shardings_of(state)
+        placed = jax.device_put(state, sh)
+        compiled["step"] = jax.jit(
+            step,
+            in_shardings=(sh, batch_sh, batch_sh),
+            out_shardings=(sh, replicated),
+            donate_argnums=(0,),
+        )
+        return placed
+
+    def step_dispatch(state, x, y):
+        if "step" not in compiled:
+            raise RuntimeError("call place_state before stepping")
+        return compiled["step"](state, x, y)
+
+    return step_dispatch, place_state

@@ -1,0 +1,117 @@
+"""Sequence / context parallelism for long sequences.
+
+Absent from the reference (SURVEY.md §2c/§5 "Long-context" rows) but
+first-class here per the task mandate. Two schemes over the ``seq`` mesh
+axis, both exact (not approximations):
+
+- :func:`ring_attention` — context parallelism: Q stays put, KV blocks
+  rotate around the ICI ring via ``ppermute`` while a numerically-stable
+  online-softmax accumulates (flash-attention math, blockwise over
+  devices). O(T/s) memory per device; comm fully overlappable with the
+  per-block matmuls. The Pallas fused kernel (ops/pallas/ring_attention)
+  shares this schedule; this jnp version is its reference and the CPU
+  test path.
+
+- :func:`ulysses_attention` — head-scatter: two ``all_to_all``s reshard
+  seq↔heads around an ordinary full-sequence attention, so each device
+  handles all T positions for H/s heads. Cheaper comm for moderate T;
+  requires heads % seq-degree == 0.
+
+Both run inside ``shard_map`` with activations sharded (B, T/s, H, D) on
+the sequence dim.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from pytorch_distributed_nn_tpu.ops import collectives as cc
+from pytorch_distributed_nn_tpu.runtime.mesh import AXIS_SEQ
+
+_NEG_INF = -1e30
+
+
+def ring_attention(q, k, v, *, axis: str = AXIS_SEQ, causal: bool = True):
+    """Exact blockwise attention with rotating KV. q,k,v: local shards
+    (B, Tl, H, D) of a (B, T, H, D) sequence-sharded tensor; returns the
+    local (B, Tl, H, D) output shard."""
+    s = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    B, Tl, H, D = q.shape
+    Hkv = k.shape[2]
+    if H != Hkv:  # grouped-query: expand kv once, locally
+        k = jnp.repeat(k, H // Hkv, axis=2)
+        v = jnp.repeat(v, H // Hkv, axis=2)
+    scale = D ** -0.5
+    qf = q.astype(jnp.float32)
+
+    # global positions of my query rows
+    q_pos = idx * Tl + lax.broadcasted_iota(jnp.int32, (Tl, 1), 0)
+
+    def block_contrib(k_blk, v_blk, src_block, m, l, acc):
+        logits = jnp.einsum(
+            "bthd,bshd->bhts", qf, k_blk.astype(jnp.float32)
+        ) * scale
+        if causal:
+            k_pos = src_block * Tl + lax.broadcasted_iota(
+                jnp.int32, (1, Tl), 1
+            )
+            mask = q_pos >= k_pos  # (Tl, Tl) global causal
+            logits = jnp.where(mask[None, None], logits, _NEG_INF)
+        m_new = jnp.maximum(m, logits.max(-1, keepdims=True))
+        p = jnp.exp(logits - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(-1, keepdims=True)
+        # corr: (B, H, Tq, 1) → (B, Tq, H, 1) to rescale acc (B, Tq, H, D)
+        corr_t = corr.transpose(0, 2, 1, 3)
+        acc_new = acc * corr_t + jnp.einsum(
+            "bhts,bshd->bthd", p, v_blk.astype(jnp.float32)
+        )
+        return m_new, l_new, acc_new
+
+    def step(carry, i):
+        k_blk, v_blk, m, l, acc = carry
+        src_block = (idx - i) % s  # whose KV block we hold this round
+        m, l, acc = block_contrib(k_blk, v_blk, src_block, m, l, acc)
+        # rotate KV to the right neighbour for the next round
+        k_blk = cc.shift_right(k_blk, axis)
+        v_blk = cc.shift_right(v_blk, axis)
+        return (k_blk, v_blk, m, l, acc), None
+
+    m0 = jnp.full((B, H, Tl, 1), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, Tl, 1), jnp.float32)
+    acc0 = jnp.zeros((B, Tl, H, D), jnp.float32)
+    (k, v, m, l, acc), _ = lax.scan(
+        step, (k, v, m0, l0, acc0), jnp.arange(s)
+    )
+    # l: (B, H, Tl, 1) → (B, Tl, H, 1)
+    denom = l.transpose(0, 2, 1, 3)
+    out = acc / jnp.maximum(denom, 1e-30)
+    return out.astype(q.dtype)
+
+
+def ulysses_attention(q, k, v, *, axis: str = AXIS_SEQ,
+                      causal: bool = True, impl: str = "xla"):
+    """All-to-all head-scatter attention (DeepSpeed-Ulysses scheme,
+    SURVEY.md §2c). Local shards (B, Tl, H, D) → full-seq per-head-group
+    attention → back."""
+    from pytorch_distributed_nn_tpu.nn.attention import (
+        dot_product_attention,
+    )
+
+    s = lax.axis_size(axis)
+    H = q.shape[2]
+    Hkv = k.shape[2]
+    if H % s or Hkv % s:
+        raise ValueError(
+            f"ulysses needs heads divisible by seq degree: {H}/{Hkv} vs {s}"
+        )
+    # (B, Tl, H, D) → (B, T, H/s, D): gather seq, scatter heads
+    q = cc.all_to_all(q, axis, split_axis=2, concat_axis=1)
+    k = cc.all_to_all(k, axis, split_axis=2, concat_axis=1)
+    v = cc.all_to_all(v, axis, split_axis=2, concat_axis=1)
+    out = dot_product_attention(q, k, v, causal=causal, impl=impl)
+    # back: (B, T, H/s, D) → (B, Tl, H, D)
+    return cc.all_to_all(out, axis, split_axis=1, concat_axis=2)

@@ -1,0 +1,83 @@
+"""Parameter-layout rules: path + shape → PartitionSpec.
+
+One rule function covers every strategy's layout needs (SURVEY.md §2c):
+
+- **TP** (Megatron-style, pjit-native per arXiv 2204.06514, PAPERS.md):
+  name-driven — attention q/k/v shard the heads dim, attention out shards
+  the heads dim (row-parallel), MLP in/gate/up shard the hidden dim
+  (column-parallel), MLP out/down shard it row-parallel, embeddings and
+  LM heads shard the vocab dim. XLA's SPMD partitioner then inserts the
+  Megatron all-reduces automatically.
+- **ZeRO/FSDP**: shape-driven — after TP assignment, the largest remaining
+  divisible dim of any big-enough leaf is sharded over ``fsdp``.
+
+Optimizer state needs no special handling: optax moment trees embed the
+parameter paths (``mu/block0/attn/query/kernel``), so the same path rules
+apply verbatim — moments land on the same devices as their params (the
+weight-update sharding of arXiv 2004.13336).
+"""
+
+from __future__ import annotations
+
+import re
+
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from pytorch_distributed_nn_tpu.runtime.mesh import AXIS_FSDP, AXIS_TENSOR
+
+# Leaves smaller than this stay replicated under fsdp (a gather of a bias
+# costs more than it saves).
+MIN_SHARD_ELEMS = 2 ** 14
+
+# (path regex, dim to shard over `tensor`). Paths are '/'-joined param
+# paths; optimizer-state paths contain these as suffixes.
+TP_RULES: list[tuple[re.Pattern, int]] = [
+    (re.compile(r"(query|key|value)/kernel$"), 1),  # (d, H, Dh): heads
+    (re.compile(r"attn/out/kernel$"), 0),  # (H, Dh, d): heads (row-par)
+    (re.compile(r"(mlp_in|gate_proj|up_proj)/kernel$"), 1),  # (d, ff)
+    (re.compile(r"(mlp_in|gate_proj|up_proj)/bias$"), 0),  # (ff,)
+    (re.compile(r"(mlp_out|down_proj)/kernel$"), 0),  # (ff, d): row-par
+    (re.compile(r"(tok_embed|pos_embed|type_embed)/embedding$"), 0),
+    (re.compile(r"(lm_head|mlm_decoder|head)/kernel$"), 1),  # (d, V)
+]
+
+
+def spec_for(path: str, shape: tuple[int, ...], *, tensor: int = 1,
+             fsdp: int = 1, min_elems: int = MIN_SHARD_ELEMS) -> P:
+    """The layout rule. ``path`` is the '/'-joined tree path of the leaf
+    (params or optimizer state); ``shape`` its shape."""
+    ndim = len(shape)
+    axes: list = [None] * ndim
+    if tensor > 1:
+        for pattern, dim in TP_RULES:
+            if pattern.search(path) and dim < ndim \
+                    and shape[dim] % tensor == 0:
+                axes[dim] = AXIS_TENSOR
+                break
+    if fsdp > 1 and int(np.prod(shape or (1,))) >= min_elems:
+        candidates = [
+            (size, i) for i, size in enumerate(shape)
+            if axes[i] is None and size % fsdp == 0
+        ]
+        if candidates:
+            _, best = max(candidates)
+            axes[best] = AXIS_FSDP
+    if all(a is None for a in axes):
+        return P()
+    return P(*axes)
+
+
+def path_str(key_path) -> str:
+    """jax.tree_util key path → '/'-joined string."""
+    parts = []
+    for key in key_path:
+        if hasattr(key, "key"):
+            parts.append(str(key.key))
+        elif hasattr(key, "name"):
+            parts.append(str(key.name))
+        elif hasattr(key, "idx"):
+            parts.append(str(key.idx))
+        else:
+            parts.append(str(key))
+    return "/".join(parts)

@@ -1,0 +1,108 @@
+"""Sharded data parallelism (ZeRO / FSDP) — BASELINE.json config 5:
+"allgather params + reduce-scatter grads" — and the shared compiler-
+sharded step used by plain DP and tensor parallelism.
+
+The reference implements sharded DP imperatively: gather each layer's
+shards before use, reduce-scatter gradients after backward, local shard
+optimizer step (SURVEY.md §3.4). TPU-native design is *declarative*:
+parameters and optimizer state are laid out per
+:mod:`~pytorch_distributed_nn_tpu.parallel.sharding_rules`, the train
+step is the ordinary DP step, and XLA's SPMD partitioner inserts exactly
+those all-gathers (scheduled ahead of first use) and reduce-scatters (on
+the gradient sum) — plus the weight-update sharding of arXiv 2004.13336
+(PAPERS.md): the optimizer update runs on the 1/n shard each device owns.
+
+Stages (ParallelConfig.zero_stage):
+- 0: nothing sharded over ``fsdp`` — plain DP layout (used by the 'dp'
+  strategy; tensor-parallel rules still apply when mesh.tensor > 1);
+- 1: optimizer state sharded, params replicated (ZeRO-1);
+- 3: params + optimizer state sharded (ZeRO-3/FSDP). (ZeRO-2 is
+  meaningless under XLA: gradients never materialise unsharded unless
+  the schedule wants them to.)
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from pytorch_distributed_nn_tpu.parallel import dp
+from pytorch_distributed_nn_tpu.parallel.sharding_rules import (
+    path_str,
+    spec_for,
+)
+from pytorch_distributed_nn_tpu.runtime.mesh import (
+    AXIS_FSDP,
+    AXIS_TENSOR,
+    batch_pspec,
+)
+from pytorch_distributed_nn_tpu.train.state import TrainState
+
+
+def state_shardings(state: TrainState, mesh: Mesh, *, stage: int = 3):
+    """NamedSharding for every TrainState leaf via the layout rules.
+
+    Optimizer-state paths embed the parameter paths (optax moment trees
+    mirror the params tree), so TP/fsdp rules hit them identically and
+    moments land with their params.
+    """
+    tensor = mesh.shape[AXIS_TENSOR]
+    fsdp = mesh.shape[AXIS_FSDP]
+
+    def shard_tree(tree, *, use_fsdp: bool):
+        return jax.tree_util.tree_map_with_path(
+            lambda kp, x: NamedSharding(
+                mesh,
+                spec_for(path_str(kp), tuple(x.shape), tensor=tensor,
+                         fsdp=fsdp if use_fsdp else 1),
+            ),
+            tree,
+        )
+
+    return state.replace(
+        step=NamedSharding(mesh, P()),
+        rng=NamedSharding(mesh, P()),
+        params=shard_tree(state.params, use_fsdp=stage >= 3),
+        model_state=shard_tree(state.model_state, use_fsdp=False),
+        opt_state=shard_tree(state.opt_state, use_fsdp=stage >= 1),
+    )
+
+
+def make_zero_train_step(mesh: Mesh, loss_fn: Callable, *, stage: int = 3):
+    """Returns (step, place_state). The step body is identical to DP —
+    sharded DP is purely a layout change (SURVEY.md §3.4 'expressed
+    declaratively as shardings')."""
+    if stage not in (0, 1, 3):
+        raise ValueError(f"zero_stage must be 0, 1 or 3, got {stage}")
+    batch_sh = NamedSharding(mesh, batch_pspec())
+
+    def step(state: TrainState, x, y):
+        loss, new_model_state, grads = dp._loss_and_grads(
+            state, x, y, loss_fn
+        )
+        new_state = state.apply_gradients(grads).replace(
+            model_state=new_model_state
+        )
+        return new_state, {"loss": loss}
+
+    compiled: dict = {}
+
+    def place_state(state: TrainState) -> TrainState:
+        shardings = state_shardings(state, mesh, stage=stage)
+        placed = jax.device_put(state, shardings)
+        compiled["step"] = jax.jit(
+            step,
+            in_shardings=(shardings, batch_sh, batch_sh),
+            out_shardings=(shardings, NamedSharding(mesh, P())),
+            donate_argnums=(0,),
+        )
+        return placed
+
+    def step_dispatch(state, x, y):
+        if "step" not in compiled:
+            raise RuntimeError("call place_state before stepping")
+        return compiled["step"](state, x, y)
+
+    return step_dispatch, place_state

@@ -22,9 +22,34 @@ def lm_xent(logits, targets) -> jnp.ndarray:
     ).mean()
 
 
+def masked_lm_xent(logits, labels) -> jnp.ndarray:
+    """BERT MLM: logits (B, T, V); labels (B, T) with -1 = ignore. Mean
+    over masked positions only (torch ``CrossEntropyLoss(ignore_index)``
+    semantics).
+
+    Note: the denominator is the *local* masked count. Under the
+    compiler-sharded 'dp' path the whole batch is one computation, so
+    this is the exact global mean; under 'dp_explicit' each device
+    divides by its shard's count before the pmean — which is precisely
+    torch DDP's per-rank behavior for ignore_index losses (reference
+    parity), not the global mean."""
+    valid = labels >= 0
+    per_tok = optax.softmax_cross_entropy_with_integer_labels(
+        logits.astype(jnp.float32), jnp.maximum(labels, 0)
+    )
+    per_tok = jnp.where(valid, per_tok, 0.0)
+    return per_tok.sum() / jnp.maximum(valid.sum(), 1)
+
+
 def accuracy(logits, labels) -> jnp.ndarray:
     return (logits.argmax(-1) == labels).mean()
 
 
+_LOSSES = {
+    "lm_synthetic": lm_xent,
+    "mlm_synthetic": masked_lm_xent,
+}
+
+
 def get_loss_fn(dataset_name: str):
-    return lm_xent if dataset_name == "lm_synthetic" else softmax_xent
+    return _LOSSES.get(dataset_name, softmax_xent)

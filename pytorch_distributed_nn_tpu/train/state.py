@@ -22,6 +22,7 @@ class TrainState:
     params: Any
     model_state: Any  # e.g. BatchNorm running stats ({} if none)
     opt_state: Any
+    rng: jax.Array  # base key for per-step stochastic ops (dropout)
     tx: optax.GradientTransformation = struct.field(pytree_node=False)
     apply_fn: Callable = struct.field(pytree_node=False)
 
@@ -36,12 +37,14 @@ class TrainState:
         )
 
     @classmethod
-    def create(cls, *, apply_fn, params, tx, model_state=None) -> "TrainState":
+    def create(cls, *, apply_fn, params, tx, model_state=None,
+               rng=None) -> "TrainState":
         return cls(
             step=jnp.zeros((), jnp.int32),
             params=params,
             model_state={} if model_state is None else model_state,
             opt_state=tx.init(params),
+            rng=jax.random.key(0) if rng is None else rng,
             tx=tx,
             apply_fn=apply_fn,
         )

@@ -53,7 +53,8 @@ class Trainer:
         self.loss_fn = get_loss_fn(cfg.data.dataset)
         self.model = get_model(cfg.model)
         self.state = self._init_state()
-        step_fn, place_fn = make_train_step(cfg, self.mesh, self.loss_fn)
+        step_fn, place_fn = make_train_step(cfg, self.mesh, self.loss_fn,
+                                            model=self.model)
         self.step_fn = step_fn
         self.state = place_fn(self.state)
         self.history: list[StepRecord] = []
@@ -72,6 +73,7 @@ class Trainer:
         state = TrainState.create(
             apply_fn=self.model.apply, params=params, tx=tx,
             model_state=model_state,
+            rng=jax.random.key(cfg.seed + 1),  # dropout stream != init key
         )
         log.info("model %s: %.2fM params", cfg.model.name,
                  param_count(params) / 1e6)

@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Validate Pallas kernels against their jnp oracles on the real TPU chip
+(tests/ runs on CPU where the wrappers fall back, so this script is the
+kernels' correctness gate; run it whenever a kernel changes)."""
+
+import sys
+
+sys.path.insert(0, ".")
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytorch_distributed_nn_tpu.ops.pallas.flash_attention import (
+    _attention_reference,
+    flash_attention,
+)
+from pytorch_distributed_nn_tpu.ops.pallas.quantize import (
+    dequantize_int8,
+    quantize_int8,
+)
+
+
+def check_flash() -> bool:
+    ok = True
+    rng = np.random.RandomState(0)
+    for (B, T, H, D) in [(2, 512, 8, 128), (1, 1024, 4, 64)]:
+        q = rng.randn(B, T, H, D).astype(np.float32) * 0.3
+        k = rng.randn(B, T, H, D).astype(np.float32) * 0.3
+        v = rng.randn(B, T, H, D).astype(np.float32)
+        for causal in (True, False):
+            got = np.asarray(flash_attention(
+                jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                causal=causal))
+            to_bh = lambda x: jnp.asarray(x).transpose(0, 2, 1, 3).reshape(
+                B * H, T, D)  # noqa: E731
+            want = np.asarray(_attention_reference(
+                to_bh(q), to_bh(k), to_bh(v), causal=causal,
+            )).reshape(B, H, T, D).transpose(0, 2, 1, 3)
+            err = float(np.abs(got - want).max())
+            line_ok = err < 2e-2
+            ok &= line_ok
+            print(f"flash B{B} T{T} H{H} D{D} causal={causal}: "
+                  f"max_err={err:.2e} {'OK' if line_ok else 'FAIL'}")
+    return ok
+
+
+def check_quantize() -> bool:
+    rng = np.random.RandomState(1)
+    x = rng.randn(8, 1024).astype(np.float32)
+    scale = float(np.abs(x).max() / 127.0)
+    acc = np.zeros_like(x)
+    n = 32
+    for seed in range(n):
+        q = quantize_int8(jnp.asarray(x), scale, seed=seed)
+        acc += np.asarray(dequantize_int8(q, scale))
+    err = float(np.abs(acc / n - x).max())
+    ok = err < 4 * scale
+    print(f"int8 stochastic quantize: mean-err={err:.2e} "
+          f"(scale {scale:.2e}) {'OK' if ok else 'FAIL'}")
+    return ok
+
+
+def main() -> int:
+    print(f"backend: {jax.default_backend()} devices: {jax.devices()}")
+    if jax.default_backend() != "tpu":
+        print("WARNING: not on TPU — validating fallbacks only")
+    ok = check_flash() & check_quantize()
+    print("ALL OK" if ok else "FAILURES")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

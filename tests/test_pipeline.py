@@ -1,0 +1,103 @@
+"""Pipeline parallelism: GPipe schedule over the `pipe` mesh axis must
+reproduce single-device training exactly (the strongest oracle for the
+fill-drain schedule + AD backward pipeline — SURVEY.md §3.3/§4)."""
+
+import jax
+import numpy as np
+import pytest
+
+from pytorch_distributed_nn_tpu.config import get_config
+from pytorch_distributed_nn_tpu.runtime.mesh import MeshSpec, make_mesh
+from pytorch_distributed_nn_tpu.train.trainer import Trainer
+
+STEPS = 4
+TINY_TLM = dict(num_layers=4, d_model=32, num_heads=2, mlp_dim=64,
+                vocab_size=101, max_len=64)
+TINY_LLAMA = dict(num_layers=4, d_model=32, num_heads=4, num_kv_heads=2,
+                  mlp_dim=64, vocab_size=101)
+
+
+def _train(strategy, mesh_spec, *, model="transformer_lm", extra=TINY_TLM,
+           microbatches=4, devices=None):
+    cfg = get_config(
+        "transformer_lm_pp",
+        **{"steps": str(STEPS), "log_every": "1", "data.prefetch": "0"},
+    )
+    cfg.data.batch_size = 16
+    cfg.data.seq_len = 16
+    cfg.data.vocab_size = 101
+    cfg.model.name = model
+    cfg.model.extra = extra
+    cfg.model.compute_dtype = "float32"
+    cfg.model.remat = False
+    cfg.parallel.strategy = strategy
+    cfg.parallel.microbatches = microbatches
+    cfg.mesh = mesh_spec
+    mesh = make_mesh(cfg.mesh.resolve(len(devices or jax.devices())),
+                     devices=devices)
+    trainer = Trainer(cfg, mesh=mesh)
+    trainer.train()
+    return np.array(trainer.losses())
+
+
+@pytest.fixture(scope="module")
+def single_losses():
+    return _train("single", MeshSpec(data=1, pipe=1),
+                  devices=jax.devices()[:1])
+
+
+def test_pipeline4_matches_single(single_losses):
+    pp = _train("pipeline", MeshSpec(pipe=4, data=2))
+    np.testing.assert_allclose(pp, single_losses, rtol=2e-5, atol=1e-5)
+
+
+def test_pipeline8_single_microbatch(single_losses):
+    pp = _train("pipeline", MeshSpec(pipe=2, data=4), microbatches=1)
+    np.testing.assert_allclose(pp, single_losses, rtol=2e-5, atol=1e-5)
+
+
+def test_pipeline_llama(single_losses):
+    single = _train("single", MeshSpec(data=1, pipe=1), model="llama3_8b",
+                    extra=TINY_LLAMA, devices=jax.devices()[:1])
+    pp = _train("pipeline", MeshSpec(pipe=4, data=2), model="llama3_8b",
+                extra=TINY_LLAMA)
+    np.testing.assert_allclose(pp, single, rtol=2e-5, atol=1e-5)
+
+
+def test_pipeline_stack_roundtrip():
+    from pytorch_distributed_nn_tpu.config import ModelConfig
+    from pytorch_distributed_nn_tpu.models import get_model
+    from pytorch_distributed_nn_tpu.parallel.pipeline import (
+        partition_for,
+        stack_stage_params,
+        unstack_stage_params,
+    )
+
+    model = get_model(ModelConfig(name="transformer_lm",
+                                  compute_dtype="float32",
+                                  extra=TINY_TLM))
+    x = np.zeros((1, 8), np.int32)
+    params = model.init(jax.random.key(0), x, train=False)["params"]
+    part = partition_for(model)
+    stacked = stack_stage_params(params, part, 2)
+    restored = unstack_stage_params(stacked, part)
+    jax.tree.map(
+        np.testing.assert_array_equal, params, restored
+    )
+
+
+def test_pipeline_rejects_indivisible_stages():
+    from pytorch_distributed_nn_tpu.config import ModelConfig
+    from pytorch_distributed_nn_tpu.models import get_model
+    from pytorch_distributed_nn_tpu.parallel.pipeline import (
+        partition_for,
+        stack_stage_params,
+    )
+
+    model = get_model(ModelConfig(name="transformer_lm",
+                                  compute_dtype="float32",
+                                  extra=TINY_TLM))
+    x = np.zeros((1, 8), np.int32)
+    params = model.init(jax.random.key(0), x, train=False)["params"]
+    with pytest.raises(ValueError):
+        stack_stage_params(params, partition_for(model), 3)
