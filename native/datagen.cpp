@@ -1,0 +1,127 @@
+// Threaded synthetic-batch generator — the native data pipeline
+// (SURVEY.md §2a "Data loading" row: the reference leans on torch's C++
+// DataLoader worker pool; this is the TPU framework's native equivalent,
+// feeding the host->device loader without Python-side RNG cost).
+//
+// Determinism contract mirrors data/datasets.py: every batch is a pure
+// function of (seed, step) — counter-based RNG (splitmix64 streams keyed
+// by (seed, step, row)), so any worker count / host layout produces the
+// identical global batch.
+//
+// C ABI for ctypes (no pybind11 in this image).
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstring>
+#include <functional>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct SplitMix64 {
+  uint64_t state;
+  explicit SplitMix64(uint64_t seed) : state(seed) {}
+  uint64_t next() {
+    uint64_t z = (state += 0x9E3779B97F4A7C15ULL);
+    z = (z ^ (z >> 30)) * 0xBF58476D1CE4E5B9ULL;
+    z = (z ^ (z >> 27)) * 0x94D049BB133111EBULL;
+    return z ^ (z >> 31);
+  }
+  // uniform in [0, 1)
+  double uniform() { return (next() >> 11) * 0x1.0p-53; }
+  // standard normal (Box-Muller; one value per call, second discarded —
+  // simplicity beats the 2x RNG cost here)
+  float normal() {
+    double u1 = uniform(), u2 = uniform();
+    while (u1 <= 1e-300) u1 = uniform();
+    return static_cast<float>(std::sqrt(-2.0 * std::log(u1)) *
+                              std::cos(2.0 * M_PI * u2));
+  }
+  uint64_t below(uint64_t n) { return next() % n; }
+};
+
+uint64_t mix_key(uint64_t a, uint64_t b, uint64_t c) {
+  SplitMix64 m(a * 0x9E3779B97F4A7C15ULL ^ b * 0xC2B2AE3D27D4EB4FULL ^ c);
+  return m.next();
+}
+
+void parallel_rows(int64_t rows, int threads,
+                   const std::function<void(int64_t, int64_t)>& body) {
+  if (threads <= 1 || rows < 2) {
+    body(0, rows);
+    return;
+  }
+  std::vector<std::thread> pool;
+  int64_t chunk = (rows + threads - 1) / threads;
+  for (int t = 0; t < threads; ++t) {
+    int64_t lo = t * chunk;
+    int64_t hi = std::min(rows, lo + chunk);
+    if (lo >= hi) break;
+    pool.emplace_back(body, lo, hi);
+  }
+  for (auto& th : pool) th.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// Class templates: (num_classes, elems) i.i.d. N(0,1), keyed by seed only.
+void datagen_templates(uint64_t seed, int64_t num_classes, int64_t elems,
+                       float* out, int threads) {
+  parallel_rows(num_classes, threads, [&](int64_t lo, int64_t hi) {
+    for (int64_t c = lo; c < hi; ++c) {
+      SplitMix64 rng(mix_key(seed, 0xC1A55ULL, static_cast<uint64_t>(c)));
+      float* row = out + c * elems;
+      for (int64_t i = 0; i < elems; ++i) row[i] = rng.normal();
+    }
+  });
+}
+
+// Class-conditional images: y ~ uniform(classes), x = template[y] + noise.
+void datagen_images(uint64_t seed, uint64_t step, int64_t batch,
+                    int64_t elems, int64_t num_classes, float noise,
+                    const float* templates, float* out_x, int32_t* out_y,
+                    int threads) {
+  parallel_rows(batch, threads, [&](int64_t lo, int64_t hi) {
+    for (int64_t b = lo; b < hi; ++b) {
+      SplitMix64 rng(mix_key(seed, step + 1, static_cast<uint64_t>(b)));
+      int32_t y = static_cast<int32_t>(
+          rng.below(static_cast<uint64_t>(num_classes)));
+      out_y[b] = y;
+      const float* tmpl = templates + static_cast<int64_t>(y) * elems;
+      float* row = out_x + b * elems;
+      for (int64_t i = 0; i < elems; ++i)
+        row[i] = tmpl[i] + noise * rng.normal();
+    }
+  });
+}
+
+// LM token stream: noised affine recurrence t[i+1] = (a*t[i] + c) % V
+// with noise_frac uniform-random tokens. Writes (batch, seq_len+1)
+// int32; the caller slices inputs/targets.
+void datagen_lm(uint64_t seed, uint64_t step, int64_t batch,
+                int64_t seq_len, int64_t vocab, int64_t a, int64_t c,
+                float noise_frac, int32_t* out, int threads) {
+  parallel_rows(batch, threads, [&](int64_t lo, int64_t hi) {
+    for (int64_t b = lo; b < hi; ++b) {
+      SplitMix64 rng(mix_key(seed, step + 1,
+                             0x1A11ULL ^ static_cast<uint64_t>(b)));
+      int32_t* row = out + b * (seq_len + 1);
+      int64_t tok = static_cast<int64_t>(
+          rng.below(static_cast<uint64_t>(vocab)));
+      row[0] = static_cast<int32_t>(tok);
+      for (int64_t i = 0; i < seq_len; ++i) {
+        tok = (a * tok + c) % vocab;
+        if (rng.uniform() < noise_frac)
+          tok = static_cast<int64_t>(
+              rng.below(static_cast<uint64_t>(vocab)));
+        row[i + 1] = static_cast<int32_t>(tok);
+      }
+    }
+  });
+}
+
+}  // extern "C"

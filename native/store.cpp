@@ -1,0 +1,364 @@
+// TCP key-value rendezvous store — the TPU framework's equivalent of
+// c10d's TCPStore (SURVEY.md §2b "torchrun elastic agent / c10d TCPStore"
+// row): multi-host rendezvous, atomic counters for rank assignment,
+// blocking key waits for barriers, heartbeat keys for failure detection.
+//
+// The reference freeloads on torch's C++ TCPStore; this is a fresh
+// implementation with the same capability surface, C ABI (driven from
+// Python via ctypes — no pybind11 in this image).
+//
+// Protocol (client -> server), length-prefixed binary over one TCP
+// connection per client:
+//   u8 op | u32 klen | key | u32 vlen | value
+// ops: 1=SET 2=GET(blocking, vlen=timeout_ms) 3=ADD(vlen=8, i64 delta)
+//      4=CHECK 5=DELETE
+// reply: u8 status (0=ok, 1=timeout/missing) | u32 vlen | value
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <sys/socket.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Store {
+  std::mutex mu;
+  std::condition_variable cv;
+  std::map<std::string, std::string> data;
+};
+
+struct Server {
+  int listen_fd = -1;
+  int port = 0;
+  std::atomic<bool> stop{false};
+  std::thread accept_thread;
+  std::vector<std::thread> clients;
+  std::vector<int> client_fds;  // parallel to clients; for shutdown()
+  std::mutex clients_mu;
+  Store store;
+};
+
+bool read_exact(int fd, void* buf, size_t n) {
+  char* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::read(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool write_exact(int fd, const void* buf, size_t n) {
+  const char* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = ::write(fd, p, n);
+    if (r <= 0) return false;
+    p += r;
+    n -= static_cast<size_t>(r);
+  }
+  return true;
+}
+
+bool send_reply(int fd, uint8_t status, const std::string& val) {
+  uint32_t vlen = static_cast<uint32_t>(val.size());
+  if (!write_exact(fd, &status, 1)) return false;
+  if (!write_exact(fd, &vlen, 4)) return false;
+  if (vlen && !write_exact(fd, val.data(), vlen)) return false;
+  return true;
+}
+
+void serve_client(Server* srv, int fd) {
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  for (;;) {
+    uint8_t op;
+    uint32_t klen, vlen;
+    if (!read_exact(fd, &op, 1)) break;
+    if (!read_exact(fd, &klen, 4)) break;
+    std::string key(klen, '\0');
+    if (klen && !read_exact(fd, key.data(), klen)) break;
+    if (!read_exact(fd, &vlen, 4)) break;
+    std::string val(vlen, '\0');
+    if (vlen && !read_exact(fd, val.data(), vlen)) break;
+
+    Store& st = srv->store;
+    bool ok = true;
+    switch (op) {
+      case 1: {  // SET
+        {
+          std::lock_guard<std::mutex> lk(st.mu);
+          st.data[key] = val;
+        }
+        st.cv.notify_all();
+        ok = send_reply(fd, 0, "");
+        break;
+      }
+      case 2: {  // GET with blocking wait; value carries i64 timeout_ms
+        int64_t timeout_ms = -1;
+        if (val.size() == 8) std::memcpy(&timeout_ms, val.data(), 8);
+        std::unique_lock<std::mutex> lk(st.mu);
+        auto ready = [&] { return st.data.count(key) > 0; };
+        bool found;
+        if (timeout_ms < 0) {
+          st.cv.wait(lk, [&] { return ready() || srv->stop.load(); });
+          found = ready();
+        } else {
+          found = st.cv.wait_for(lk, std::chrono::milliseconds(timeout_ms),
+                                 [&] { return ready() || srv->stop.load(); });
+          found = found && ready();
+        }
+        std::string out = found ? st.data[key] : "";
+        lk.unlock();
+        ok = send_reply(fd, found ? 0 : 1, out);
+        break;
+      }
+      case 3: {  // ADD: i64 delta; creates at 0; returns new value
+        int64_t delta = 0;
+        if (val.size() == 8) std::memcpy(&delta, val.data(), 8);
+        int64_t now;
+        {
+          std::lock_guard<std::mutex> lk(st.mu);
+          int64_t cur = 0;
+          auto it = st.data.find(key);
+          if (it != st.data.end() && it->second.size() == 8)
+            std::memcpy(&cur, it->second.data(), 8);
+          now = cur + delta;
+          std::string enc(8, '\0');
+          std::memcpy(enc.data(), &now, 8);
+          st.data[key] = enc;
+        }
+        st.cv.notify_all();
+        std::string out(8, '\0');
+        std::memcpy(out.data(), &now, 8);
+        ok = send_reply(fd, 0, out);
+        break;
+      }
+      case 4: {  // CHECK (non-blocking exists)
+        bool found;
+        {
+          std::lock_guard<std::mutex> lk(st.mu);
+          found = st.data.count(key) > 0;
+        }
+        ok = send_reply(fd, found ? 0 : 1, "");
+        break;
+      }
+      case 5: {  // DELETE
+        {
+          std::lock_guard<std::mutex> lk(st.mu);
+          st.data.erase(key);
+        }
+        st.cv.notify_all();
+        ok = send_reply(fd, 0, "");
+        break;
+      }
+      default:
+        ok = false;
+    }
+    if (!ok) break;
+  }
+  ::close(fd);
+}
+
+void accept_loop(Server* srv) {
+  for (;;) {
+    int fd = ::accept(srv->listen_fd, nullptr, nullptr);
+    if (fd < 0) {
+      if (srv->stop.load()) return;
+      continue;
+    }
+    std::lock_guard<std::mutex> lk(srv->clients_mu);
+    srv->client_fds.push_back(fd);
+    srv->clients.emplace_back(serve_client, srv, fd);
+  }
+}
+
+struct Client {
+  int fd = -1;
+  std::mutex mu;  // one in-flight request per client handle
+};
+
+bool client_request(Client* c, uint8_t op, const std::string& key,
+                    const std::string& val, uint8_t* status,
+                    std::string* out) {
+  std::lock_guard<std::mutex> lk(c->mu);
+  uint32_t klen = static_cast<uint32_t>(key.size());
+  uint32_t vlen = static_cast<uint32_t>(val.size());
+  if (!write_exact(c->fd, &op, 1)) return false;
+  if (!write_exact(c->fd, &klen, 4)) return false;
+  if (klen && !write_exact(c->fd, key.data(), klen)) return false;
+  if (!write_exact(c->fd, &vlen, 4)) return false;
+  if (vlen && !write_exact(c->fd, val.data(), vlen)) return false;
+  if (!read_exact(c->fd, status, 1)) return false;
+  uint32_t rlen;
+  if (!read_exact(c->fd, &rlen, 4)) return false;
+  out->assign(rlen, '\0');
+  if (rlen && !read_exact(c->fd, out->data(), rlen)) return false;
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+// ---- server ----------------------------------------------------------
+
+void* tpustore_server_start(int port) {
+  auto* srv = new Server();
+  srv->listen_fd = ::socket(AF_INET, SOCK_STREAM, 0);
+  if (srv->listen_fd < 0) {
+    delete srv;
+    return nullptr;
+  }
+  int one = 1;
+  setsockopt(srv->listen_fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_ANY);
+  addr.sin_port = htons(static_cast<uint16_t>(port));
+  if (::bind(srv->listen_fd, reinterpret_cast<sockaddr*>(&addr),
+             sizeof(addr)) != 0 ||
+      ::listen(srv->listen_fd, 128) != 0) {
+    ::close(srv->listen_fd);
+    delete srv;
+    return nullptr;
+  }
+  socklen_t len = sizeof(addr);
+  getsockname(srv->listen_fd, reinterpret_cast<sockaddr*>(&addr), &len);
+  srv->port = ntohs(addr.sin_port);
+  srv->accept_thread = std::thread(accept_loop, srv);
+  return srv;
+}
+
+int tpustore_server_port(void* handle) {
+  return handle ? static_cast<Server*>(handle)->port : -1;
+}
+
+void tpustore_server_stop(void* handle) {
+  if (!handle) return;
+  auto* srv = static_cast<Server*>(handle);
+  srv->stop.store(true);
+  srv->store.cv.notify_all();
+  ::shutdown(srv->listen_fd, SHUT_RDWR);
+  ::close(srv->listen_fd);
+  if (srv->accept_thread.joinable()) srv->accept_thread.join();
+  {
+    // Wake every handler blocked in read()/cv-wait, then JOIN them —
+    // they dereference srv->store, so srv must outlive them.
+    std::lock_guard<std::mutex> lk(srv->clients_mu);
+    for (int fd : srv->client_fds) ::shutdown(fd, SHUT_RDWR);
+    for (auto& t : srv->clients)
+      if (t.joinable()) t.join();
+  }
+  delete srv;
+}
+
+// ---- client ----------------------------------------------------------
+
+void* tpustore_connect(const char* host, int port, int timeout_ms) {
+  auto deadline = std::chrono::steady_clock::now() +
+                  std::chrono::milliseconds(timeout_ms);
+  for (;;) {
+    int fd = ::socket(AF_INET, SOCK_STREAM, 0);
+    if (fd < 0) return nullptr;
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(static_cast<uint16_t>(port));
+    if (::inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+      ::close(fd);
+      return nullptr;
+    }
+    if (::connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof(addr)) ==
+        0) {
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+      auto* c = new Client();
+      c->fd = fd;
+      return c;
+    }
+    ::close(fd);
+    if (std::chrono::steady_clock::now() >= deadline) return nullptr;
+    std::this_thread::sleep_for(std::chrono::milliseconds(50));
+  }
+}
+
+void tpustore_disconnect(void* handle) {
+  if (!handle) return;
+  auto* c = static_cast<Client*>(handle);
+  ::close(c->fd);
+  delete c;
+}
+
+int tpustore_set(void* handle, const char* key, const uint8_t* val,
+                 int vlen) {
+  uint8_t status;
+  std::string out;
+  std::string v(reinterpret_cast<const char*>(val),
+                static_cast<size_t>(vlen));
+  if (!client_request(static_cast<Client*>(handle), 1, key, v, &status,
+                      &out))
+    return -1;
+  return status == 0 ? 0 : -2;
+}
+
+// Blocking get. Returns value length (>=0), -1 on I/O error, -2 on
+// timeout. If the value is larger than cap, returns -3 (caller grows).
+int tpustore_get(void* handle, const char* key, uint8_t* buf, int cap,
+                 int64_t timeout_ms) {
+  uint8_t status;
+  std::string out;
+  std::string t(8, '\0');
+  std::memcpy(t.data(), &timeout_ms, 8);
+  if (!client_request(static_cast<Client*>(handle), 2, key, t, &status,
+                      &out))
+    return -1;
+  if (status != 0) return -2;
+  if (static_cast<int>(out.size()) > cap) return -3;
+  std::memcpy(buf, out.data(), out.size());
+  return static_cast<int>(out.size());
+}
+
+int64_t tpustore_add(void* handle, const char* key, int64_t delta) {
+  uint8_t status;
+  std::string out;
+  std::string v(8, '\0');
+  std::memcpy(v.data(), &delta, 8);
+  if (!client_request(static_cast<Client*>(handle), 3, key, v, &status,
+                      &out) ||
+      status != 0 || out.size() != 8)
+    return INT64_MIN;
+  int64_t result;
+  std::memcpy(&result, out.data(), 8);
+  return result;
+}
+
+int tpustore_check(void* handle, const char* key) {
+  uint8_t status;
+  std::string out;
+  if (!client_request(static_cast<Client*>(handle), 4, key, "", &status,
+                      &out))
+    return -1;
+  return status == 0 ? 1 : 0;
+}
+
+int tpustore_delete(void* handle, const char* key) {
+  uint8_t status;
+  std::string out;
+  if (!client_request(static_cast<Client*>(handle), 5, key, "", &status,
+                      &out))
+    return -1;
+  return 0;
+}
+
+}  // extern "C"

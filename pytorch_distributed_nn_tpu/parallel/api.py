@@ -73,4 +73,9 @@ def make_train_step(
         if model is None:
             raise ValueError("pipeline strategy needs the model instance")
         return pipeline.make_pipeline_train_step(cfg, mesh, loss_fn, model)
+    if strategy == "ps":
+        raise ValueError(
+            "the async parameter-server strategy is process-level, not a "
+            "jit step — run scripts/train_ps.py (see parallel/ps.py)"
+        )
     raise ValueError(f"unknown strategy {strategy!r}")

@@ -1,0 +1,193 @@
+"""Asynchronous parameter-server data parallelism.
+
+The reference's async strategy: rank 0 holds the parameters; workers
+``dist.send`` gradients to it and ``dist.recv`` fresh parameters back,
+with no step synchronization — classic async SGD with stale gradients
+(SURVEY.md §2a "Parameter-server / async trainer" row, §2c "Async /
+parameter-server DP").
+
+Async PS is deliberately NOT an SPMD program (XLA lockstep is the
+antithesis of asynchrony), so the TPU-native design runs it at the
+*process* level: the server applies updates host-side while each worker
+drives its own accelerator (or CPU) through a jit-compiled grad step.
+Transport is the framework's native rendezvous store
+(:mod:`runtime.native`, the c10d-TCPStore equivalent) — the same
+send/recv capability the reference gets from torch p2p:
+
+- server: ``grads`` arrive as a totally-ordered ticket queue
+  (store ADD gives the ticket; blocking GET drains it); each grad is
+  applied immediately and ``params/v{N}`` is republished;
+- workers: pull the freshest params (version counter), compute a grad
+  on their own batch shard, push it with their ticket — never waiting
+  for other workers. Staleness is bounded only by worker speed, exactly
+  the reference's semantics.
+"""
+
+from __future__ import annotations
+
+import io
+import logging
+import time
+from typing import Any, Callable
+
+import jax
+import numpy as np
+
+from pytorch_distributed_nn_tpu.runtime.native import StoreClient
+
+log = logging.getLogger(__name__)
+
+_PARAMS_VERSION = "ps/params/version"
+_PARAMS_KEY = "ps/params/v{v}"
+_GRAD_TICKET = "ps/grads/ticket"
+_GRAD_KEY = "ps/grads/{t}"
+_STOP_KEY = "ps/stop"
+
+
+def tree_to_bytes(tree: Any) -> bytes:
+    leaves, treedef = jax.tree.flatten(tree)
+    buf = io.BytesIO()
+    np.savez(buf, *[np.asarray(leaf) for leaf in leaves])
+    del treedef  # structure is carried by the template on the other side
+    return buf.getvalue()
+
+
+def tree_from_bytes(data: bytes, template: Any) -> Any:
+    leaves, treedef = jax.tree.flatten(template)
+    with np.load(io.BytesIO(data)) as z:
+        loaded = [z[f"arr_{i}"] for i in range(len(leaves))]
+    return jax.tree.unflatten(treedef, loaded)
+
+
+class ParameterServer:
+    """Rank 0 of the reference's PS strategy: owns params + optimizer,
+    applies each incoming (stale) gradient, republishes params."""
+
+    def __init__(self, store: StoreClient, params: Any, tx) -> None:
+        self.store = store
+        self.params = params
+        self.tx = tx
+        self.opt_state = tx.init(params)
+        self.version = 0
+        self.applied = 0
+        self._publish()
+
+    def _publish(self) -> None:
+        self.store.set(_PARAMS_KEY.format(v=self.version),
+                       tree_to_bytes(self.params))
+        self.store.set(_PARAMS_VERSION, str(self.version).encode())
+
+    def apply_one(self, grad_bytes: bytes) -> None:
+        import optax
+
+        grads = tree_from_bytes(grad_bytes, self.params)
+        updates, self.opt_state = self.tx.update(
+            grads, self.opt_state, self.params
+        )
+        self.params = optax.apply_updates(self.params, updates)
+        self.version += 1
+        self.applied += 1
+        self._publish()
+
+    def serve(self, total_grads: int, *, timeout_ms: int = 120_000) -> Any:
+        """Drain the ticket queue until ``total_grads`` gradients have
+        been applied; returns the final params."""
+        next_ticket = 1
+        while self.applied < total_grads:
+            data = self.store.get(_GRAD_KEY.format(t=next_ticket),
+                                  timeout_ms=timeout_ms)
+            self.apply_one(data)
+            self.store.delete(_GRAD_KEY.format(t=next_ticket))
+            next_ticket += 1
+        self.store.set(_STOP_KEY, b"1")
+        return self.params
+
+
+class PSWorker:
+    """One async worker: pull freshest params, grad on own shard, push.
+
+    ``max_staleness`` bounds how many tickets a worker may run ahead of
+    the server's applied count (stale-synchronous-parallel): unbounded
+    asynchrony lets fast workers push a burst of gradients all computed
+    at the initial params, which diverges; SSP keeps the reference's
+    async semantics with a convergence guarantee. ``None`` = fully async.
+    """
+
+    def __init__(self, store: StoreClient, grad_fn: Callable,
+                 params_template: Any, *,
+                 max_staleness: int | None = 8) -> None:
+        self.store = store
+        self.grad_fn = grad_fn  # (params, x, y) -> grads  (jit-compiled)
+        self.template = params_template
+        self.max_staleness = max_staleness
+        self._version_seen = -1
+        self._params = None
+        self._last_ticket = 0
+
+    def pull(self) -> Any:
+        v = int(self.store.get(_PARAMS_VERSION).decode())
+        if v != self._version_seen:
+            data = self.store.get(_PARAMS_KEY.format(v=v))
+            self._params = tree_from_bytes(data, self.template)
+            self._version_seen = v
+        return self._params
+
+    def step(self, x, y) -> int:
+        """One async step; returns the ticket this grad got."""
+        if self.max_staleness is not None:
+            # SSP gate: wait until the server has applied to within
+            # max_staleness of our last pushed ticket
+            target = self._last_ticket - self.max_staleness
+            while (target > 0 and
+                   int(self.store.get(_PARAMS_VERSION).decode()) < target):
+                time.sleep(0.002)
+        params = self.pull()
+        grads = self.grad_fn(params, x, y)
+        grads = jax.device_get(grads)
+        ticket = self.store.add(_GRAD_TICKET, 1)
+        self.store.set(_GRAD_KEY.format(t=ticket), tree_to_bytes(grads))
+        self._last_ticket = ticket
+        return ticket
+
+    def run(self, batches, *, poll_stop_every: int = 4) -> int:
+        """Push gradients for ``batches`` until exhausted or the server
+        says stop; returns how many grads this worker contributed."""
+        pushed = 0
+        for i, (x, y) in enumerate(batches):
+            if i % poll_stop_every == 0 and self.store.check(_STOP_KEY):
+                break
+            self.step(x, y)
+            pushed += 1
+        return pushed
+
+
+def run_ps_local(params, tx, grad_fn, worker_batches,
+                 *, server_port: int = 0) -> tuple[Any, int]:
+    """Single-process reference harness: threads play the server and
+    workers (the multi-process form just runs the same classes from
+    different OS processes against one StoreServer)."""
+    import threading
+
+    from pytorch_distributed_nn_tpu.runtime.native import StoreServer
+
+    total = sum(len(b) for b in worker_batches)
+    with StoreServer(server_port) as srv:
+        server = ParameterServer(StoreClient(port=srv.port), params, tx)
+        result: dict = {}
+
+        def serve():
+            result["params"] = server.serve(total)
+
+        threads = [threading.Thread(target=serve)]
+        for batches in worker_batches:
+            worker = PSWorker(StoreClient(port=srv.port), grad_fn, params)
+            threads.append(threading.Thread(target=worker.run,
+                                            args=(batches,)))
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        log.info("ps: %d grads in %.3fs", server.applied,
+                 time.perf_counter() - t0)
+    return result["params"], server.applied

@@ -1,0 +1,148 @@
+"""Sharded checkpoint / resume (Orbax).
+
+The reference's checkpointing is at most ``torch.save``/``torch.load`` of
+a state dict on rank 0 (SURVEY.md §5 "Checkpoint / resume" row). The
+TPU-native design is strictly stronger:
+
+- **sharded**: every host writes only the array shards it owns (Orbax
+  OCDBT); no rank-0 gather, no single-file bottleneck — a Llama-8B
+  checkpoint never materialises on one host;
+- **async**: the save runs on a background thread against a snapshot of
+  device buffers, so the train loop keeps stepping (the analogue of
+  DDP's "checkpoint off the critical path" practice);
+- **topology-flexible resume**: restore takes the *target* TrainState
+  (with its shardings) as the template, so a checkpoint written on one
+  mesh restores onto another — Orbax reshards on read. This covers the
+  elastic-restart story (SURVEY.md §5 "Failure detection" row): restart
+  on fewer/more chips and resume from the last step.
+
+Layout: ``<dir>/<step>/`` per step, plus Orbax metadata. The data-stream
+position is restored from the saved ``data_step`` so no batch is replayed
+or skipped on resume (the dataset is deterministic by (seed, step) —
+datasets.py determinism contract).
+"""
+
+from __future__ import annotations
+
+import logging
+from pathlib import Path
+from typing import Any
+
+import jax
+import numpy as np
+import orbax.checkpoint as ocp
+
+from pytorch_distributed_nn_tpu.train.state import TrainState
+
+log = logging.getLogger(__name__)
+
+_ARRAYS = "arrays"  # TrainState array leaves
+_META = "meta"  # small host-side json (data_step, preset, ...)
+
+
+class CheckpointManager:
+    """Thin policy wrapper over ``ocp.CheckpointManager``.
+
+    ``save`` is async by default; ``close`` drains the writer. The
+    manager keeps ``max_to_keep`` newest steps.
+    """
+
+    def __init__(self, directory: str | Path, *, max_to_keep: int = 3,
+                 async_save: bool = True) -> None:
+        self.directory = Path(directory).absolute()
+        self.directory.mkdir(parents=True, exist_ok=True)
+        options = ocp.CheckpointManagerOptions(
+            max_to_keep=max_to_keep,
+            enable_async_checkpointing=async_save,
+        )
+        self._mgr = ocp.CheckpointManager(self.directory, options=options)
+
+    # -- save ------------------------------------------------------------
+
+    def save(self, state: TrainState, *, data_step: int,
+             extra_meta: dict[str, Any] | None = None,
+             force: bool = False) -> bool:
+        """Queue an async save of ``state`` at its current step."""
+        step = int(jax.device_get(state.step))
+        meta = {"data_step": int(data_step), "step": step}
+        if extra_meta:
+            meta.update(extra_meta)
+        saved = self._mgr.save(
+            step,
+            args=ocp.args.Composite(**{
+                _ARRAYS: ocp.args.StandardSave(_array_tree(state)),
+                _META: ocp.args.JsonSave(meta),
+            }),
+            force=force,
+        )
+        if saved:
+            log.info("queued checkpoint save at step %d -> %s", step,
+                     self.directory)
+        return saved
+
+    # -- restore ---------------------------------------------------------
+
+    def latest_step(self) -> int | None:
+        return self._mgr.latest_step()
+
+    def restore(self, template: TrainState,
+                step: int | None = None) -> tuple[TrainState, dict]:
+        """Restore into the layout of ``template`` (its shardings define
+        the target placement — resume works across topology changes).
+        Returns ``(state, meta)``."""
+        step = self.latest_step() if step is None else step
+        if step is None:
+            raise FileNotFoundError(
+                f"no checkpoint found under {self.directory}"
+            )
+        abstract = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+            if isinstance(x, jax.Array) else x,
+            _array_tree(template),
+        )
+        restored = self._mgr.restore(
+            step,
+            args=ocp.args.Composite(**{
+                _ARRAYS: ocp.args.StandardRestore(abstract),
+                _META: ocp.args.JsonRestore(),
+            }),
+        )
+        state = _merge_array_tree(template, restored[_ARRAYS])
+        return state, dict(restored[_META])
+
+    # -- lifecycle -------------------------------------------------------
+
+    def wait(self) -> None:
+        self._mgr.wait_until_finished()
+
+    def close(self) -> None:
+        self._mgr.wait_until_finished()
+        self._mgr.close()
+
+    def all_steps(self) -> list[int]:
+        return sorted(self._mgr.all_steps())
+
+
+def _array_tree(state: TrainState) -> dict:
+    """The checkpointable slice of TrainState: array leaves only (tx and
+    apply_fn are code, rebuilt from config on restore)."""
+    return {
+        "step": state.step,
+        "params": state.params,
+        "model_state": state.model_state,
+        "opt_state": state.opt_state,
+        "rng": jax.random.key_data(state.rng),
+    }
+
+
+def _merge_array_tree(template: TrainState, tree: dict) -> TrainState:
+    rng = tree["rng"]
+    if not jax.dtypes.issubdtype(np.asarray(rng).dtype, jax.dtypes.prng_key):
+        rng = jax.random.wrap_key_data(np.asarray(jax.device_get(rng)))
+    return template.replace(
+        step=tree["step"],
+        params=tree["params"],
+        model_state=tree["model_state"],
+        opt_state=tree["opt_state"],
+        rng=rng,
+    )

@@ -59,6 +59,18 @@ class Trainer:
         self.state = place_fn(self.state)
         self.history: list[StepRecord] = []
         self.data_step = 0  # next dataset step to consume (resume-aware)
+        self.ckpt = None
+        if cfg.checkpoint_dir:
+            from pytorch_distributed_nn_tpu.train.checkpoint import (
+                CheckpointManager,
+            )
+
+            self.ckpt = CheckpointManager(cfg.checkpoint_dir)
+            if cfg.resume and self.ckpt.latest_step() is not None:
+                self.state, meta = self.ckpt.restore(self.state)
+                self.data_step = meta["data_step"]
+                log.info("resumed from step %d (data_step %d)",
+                         meta["step"], self.data_step)
 
     def _init_state(self) -> TrainState:
         cfg = self.cfg
@@ -81,26 +93,46 @@ class Trainer:
 
     def train(self, steps: int | None = None) -> list[StepRecord]:
         cfg = self.cfg
-        steps = steps if steps is not None else cfg.steps
+        if steps is None:
+            # default = the REMAINING budget: a resumed run finishes at
+            # cfg.steps total, it doesn't run cfg.steps more (the LR
+            # schedule was built for cfg.steps)
+            steps = max(cfg.steps - self.data_step, 0)
         self.loader.start_step = self.data_step  # don't replay batches
         it = iter(self.loader)
         t_last = time.perf_counter()
         for i in range(steps):
             x, y = next(it)
             self.data_step += 1
+            g = self.data_step  # 1-based global step just dispatched
             self.state, metrics = self.step_fn(self.state, x, y)
-            if cfg.log_every and (i % cfg.log_every == 0 or i == steps - 1):
+            if (self.ckpt is not None and cfg.checkpoint_every
+                    and g % cfg.checkpoint_every == 0):
+                self.ckpt.save(self.state, data_step=self.data_step)
+            if cfg.log_every and ((g - 1) % cfg.log_every == 0
+                                  or i == steps - 1):
                 loss = float(jax.device_get(metrics["loss"]))
                 now = time.perf_counter()
-                rec = StepRecord(step=i, loss=loss, seconds=now - t_last)
+                rec = StepRecord(step=g - 1, loss=loss,
+                                 seconds=now - t_last)
                 t_last = now
                 self.history.append(rec)
                 if jax.process_index() == 0:
-                    log.info("step %d loss %.4f (%.3fs)", i, loss,
+                    log.info("step %d loss %.4f (%.3fs)", g - 1, loss,
                              rec.seconds)
         # sync before returning so wall-clock timings are honest
         jax.block_until_ready(self.state.params)
         return self.history
+
+    def save_checkpoint(self, *, force: bool = True) -> bool:
+        if self.ckpt is None:
+            raise RuntimeError("no checkpoint_dir configured")
+        return self.ckpt.save(self.state, data_step=self.data_step,
+                              force=force)
+
+    def close(self) -> None:
+        if self.ckpt is not None:
+            self.ckpt.close()
 
     def losses(self) -> list[float]:
         return [r.loss for r in self.history]

@@ -1,0 +1,9 @@
+from pytorch_distributed_nn_tpu.utils.profiling import (  # noqa: F401
+    StepTimer,
+    bus_bandwidth,
+    time_steps,
+    xprof_trace,
+)
+from pytorch_distributed_nn_tpu.utils.metrics import (  # noqa: F401
+    MetricsLogger,
+)

@@ -1,0 +1,110 @@
+"""Tracing / profiling hooks.
+
+The reference has none of its own — the ecosystem answer is
+``torch.profiler`` + NCCL debug counters (SURVEY.md §5 "Tracing/profiling"
+row). TPU-native equivalents:
+
+- :func:`xprof_trace` — ``jax.profiler`` capture to a TensorBoard/XProf
+  log dir (set ``TrainConfig.profile_dir``);
+- :class:`StepTimer` / :func:`time_steps` — honest per-step wall timing
+  (``block_until_ready`` fencing, so async dispatch can't flatter the
+  numbers);
+- :func:`bus_bandwidth` — the BASELINE "grad-allreduce bus-bw" metric:
+  trace-time wire-byte accounting from :mod:`ops.collectives` divided by
+  measured step time.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import time
+from typing import Callable, Sequence
+
+import jax
+import numpy as np
+
+from pytorch_distributed_nn_tpu.ops import collectives as cc
+
+
+@contextlib.contextmanager
+def xprof_trace(log_dir: str):
+    """Capture an XProf/TensorBoard trace of the enclosed steps."""
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+class StepTimer:
+    """Wall-clock per-step timer with device fencing."""
+
+    def __init__(self) -> None:
+        self.times: list[float] = []
+        self._t0: float | None = None
+
+    def start(self) -> None:
+        self._t0 = time.perf_counter()
+
+    def stop(self, *fence) -> float:
+        """Record one step; ``fence`` arrays are blocked on first."""
+        if fence:
+            jax.block_until_ready(fence)
+        dt = time.perf_counter() - self._t0
+        self.times.append(dt)
+        return dt
+
+    def summary(self) -> dict[str, float]:
+        ts = np.array(self.times)
+        return {
+            "steps": len(ts),
+            "mean_s": float(ts.mean()),
+            "p50_s": float(np.percentile(ts, 50)),
+            "p95_s": float(np.percentile(ts, 95)),
+            "total_s": float(ts.sum()),
+        }
+
+
+def time_steps(step_fn: Callable, args_fn: Callable[[int], tuple], *,
+               iters: int, warmup: int = 3,
+               carry_state: bool = True) -> StepTimer:
+    """Time ``iters`` executions of ``step_fn``. ``args_fn(i)`` yields the
+    per-step ``(state, *batch)`` args; when ``carry_state`` the returned
+    state threads into the next call (the real training pattern)."""
+    state, *batch = args_fn(0)
+    for i in range(warmup):
+        out = step_fn(state, *batch)
+        state = out[0] if carry_state else state
+        _, *batch = args_fn(i + 1)
+    jax.block_until_ready(state)
+    timer = StepTimer()
+    for i in range(iters):
+        timer.start()
+        out = step_fn(state, *batch)
+        new_state = out[0] if carry_state else state
+        timer.stop(new_state)
+        state = new_state
+        _, *batch = args_fn(warmup + i + 1)
+    return timer
+
+
+@dataclasses.dataclass
+class BusBandwidth:
+    wire_gbps: float  # GB/s of link traffic per device
+    wire_bytes_per_step: float
+    step_s: float
+    records: int
+
+
+def bus_bandwidth(records: Sequence[cc.CommRecord],
+                  step_s: float) -> BusBandwidth:
+    """Ring-accounted wire bytes per device / measured step time — the
+    comparable of NCCL's busbw (nccl-tests definition)."""
+    wire = cc.wire_bytes(records)
+    return BusBandwidth(
+        wire_gbps=wire / step_s / 1e9 if step_s > 0 else 0.0,
+        wire_bytes_per_step=wire,
+        step_s=step_s,
+        records=len(records),
+    )

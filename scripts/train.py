@@ -39,7 +39,10 @@ def main(argv: list[str]) -> int:
     info = bootstrap.initialize()
     cfg = get_config(preset, **overrides)
     trainer = Trainer(cfg)
-    history = trainer.train()
+    try:
+        history = trainer.train()
+    finally:
+        trainer.close()  # drain async checkpoint writes
     if info.is_coordinator and history:
         final = history[-1]
         print(f"final: step={final.step} loss={final.loss:.4f}")

@@ -1,0 +1,169 @@
+"""Native runtime (C++ store + datagen via ctypes): build, KV semantics,
+blocking waits, barriers across real processes, datagen determinism
+(SURVEY.md §2b c10d-TCPStore / DataLoader rows)."""
+
+import multiprocessing as mp
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_nn_tpu.runtime import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native library not buildable"
+)
+
+
+@pytest.fixture()
+def server():
+    srv = native.StoreServer()
+    yield srv
+    srv.stop()
+
+
+def test_set_get_roundtrip(server):
+    with native.StoreClient(port=server.port) as c:
+        c.set("alpha", b"hello")
+        assert c.get("alpha") == b"hello"
+        assert c.check("alpha")
+        assert not c.check("missing")
+        c.delete("alpha")
+        assert not c.check("alpha")
+
+
+def test_get_timeout(server):
+    with native.StoreClient(port=server.port) as c:
+        t0 = time.perf_counter()
+        with pytest.raises(TimeoutError):
+            c.get("never", timeout_ms=200)
+        assert time.perf_counter() - t0 >= 0.15
+
+
+def test_blocking_get_wakes_on_set(server):
+    got = {}
+
+    def waiter():
+        with native.StoreClient(port=server.port) as c:
+            got["value"] = c.get("later", timeout_ms=5000)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.1)
+    with native.StoreClient(port=server.port) as c:
+        c.set("later", b"woken")
+    t.join(timeout=5)
+    assert got["value"] == b"woken"
+
+
+def test_add_counter(server):
+    with native.StoreClient(port=server.port) as c:
+        assert c.add("n", 1) == 1
+        assert c.add("n", 5) == 6
+        assert c.add("n", -2) == 4
+
+
+def _barrier_worker(port, rank, out_q):
+    with native.StoreClient(port=port) as c:
+        c.set(f"rank{rank}/here", b"1")
+        c.barrier("start", 3)
+        # after the barrier every rank's key must be visible
+        ok = all(c.check(f"rank{r}/here") for r in range(3))
+        out_q.put((rank, ok))
+
+
+def test_barrier_across_processes(server):
+    """The rendezvous pattern: N OS processes meet at a store barrier
+    (the reference's init_process_group TCPStore handshake)."""
+    ctx = mp.get_context("spawn")
+    q = ctx.Queue()
+    procs = [ctx.Process(target=_barrier_worker,
+                         args=(server.port, r, q)) for r in range(3)]
+    for p in procs:
+        p.start()
+    results = [q.get(timeout=30) for _ in range(3)]
+    for p in procs:
+        p.join(timeout=10)
+    assert all(ok for _, ok in results)
+
+
+def test_barrier_reusable_same_name(server):
+    """Two rounds under one name must both actually synchronize (stale
+    round-1 flags must not satisfy round 2)."""
+    def worker(rank, q):
+        with native.StoreClient(port=server.port) as c:
+            for rnd in range(2):
+                c.barrier("loop", 2)
+            q.put(rank)
+
+    import queue as queue_mod
+    q = queue_mod.Queue()
+    threads = [threading.Thread(target=worker, args=(r, q))
+               for r in range(2)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10)
+    assert q.qsize() == 2
+
+
+def test_get_grows_past_default_cap(server):
+    big = b"x" * (3 << 20)  # 3 MiB > the 1 MiB default cap
+    with native.StoreClient(port=server.port) as c:
+        c.set("big", big)
+        assert c.get("big", max_bytes=1 << 20) == big
+
+
+def test_server_stop_with_connected_client():
+    """Shutdown while a client is mid-wait must not crash (the handler
+    threads are joined, not detached, before the server is freed)."""
+    srv = native.StoreServer()
+    c = native.StoreClient(port=srv.port)
+    waiter = threading.Thread(
+        target=lambda: pytest.raises(Exception, c.get, "nothing"),
+    )
+    waiter.start()
+    time.sleep(0.1)
+    srv.stop()  # must return promptly and not corrupt the heap
+    waiter.join(timeout=5)
+    assert not waiter.is_alive()
+    c.close()
+
+
+def test_datagen_images_deterministic():
+    tmpl = native.gen_templates(7, 10, (8, 8))
+    assert tmpl.shape == (10, 8, 8)
+    x1, y1 = native.gen_images(7, 3, 16, tmpl, 0.35)
+    x2, y2 = native.gen_images(7, 3, 16, tmpl, 0.35, threads=2)
+    np.testing.assert_array_equal(x1, x2)  # thread-count independent
+    np.testing.assert_array_equal(y1, y2)
+    x3, _ = native.gen_images(7, 4, 16, tmpl, 0.35)
+    assert not np.array_equal(x1, x3)  # different step, different batch
+    # structure: x ≈ template[y] + noise
+    resid = x1 - tmpl[y1]
+    assert abs(float(resid.mean())) < 0.1
+    assert 0.2 < float(resid.std()) < 0.5
+
+
+def test_datagen_lm_recurrence():
+    toks = native.gen_lm(11, 0, 8, 32, 101, a=31337 % 101, c=7919 % 101,
+                         noise_frac=0.0)
+    assert toks.shape == (8, 33)
+    assert toks.min() >= 0 and toks.max() < 101
+    # zero noise: exact affine recurrence
+    a, c = 31337 % 101, 7919 % 101
+    np.testing.assert_array_equal(
+        toks[:, 1:], (a * toks[:, :-1].astype(np.int64) + c) % 101
+    )
+    # reproducible
+    np.testing.assert_array_equal(
+        toks, native.gen_lm(11, 0, 8, 32, 101, a=a, c=c, noise_frac=0.0,
+                            threads=4)
+    )
+
+
+def test_templates_stats():
+    tmpl = native.gen_templates(3, 50, (16, 16))
+    assert abs(float(tmpl.mean())) < 0.05
+    assert 0.9 < float(tmpl.std()) < 1.1

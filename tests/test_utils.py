@@ -1,0 +1,73 @@
+"""Profiling/metrics utilities: timer fencing, bus-bw accounting math,
+JSONL metric schema (SURVEY.md §5 tracing + metrics rows)."""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+from pytorch_distributed_nn_tpu.ops import collectives as cc
+from pytorch_distributed_nn_tpu.runtime.mesh import MeshSpec, make_mesh
+from pytorch_distributed_nn_tpu.utils.metrics import MetricsLogger
+from pytorch_distributed_nn_tpu.utils.profiling import (
+    StepTimer,
+    bus_bandwidth,
+    time_steps,
+)
+
+
+def test_step_timer_summary():
+    t = StepTimer()
+    for _ in range(5):
+        t.start()
+        t.stop(jnp.ones(8))
+    s = t.summary()
+    assert s["steps"] == 5
+    assert s["total_s"] >= s["p50_s"]
+
+
+def test_time_steps_carries_state():
+    calls = []
+
+    def step(state, x):
+        calls.append(int(state))
+        return state + 1, x
+
+    timer = time_steps(step, lambda i: (0, jnp.ones(2)), iters=4, warmup=2)
+    assert len(timer.times) == 4
+    # warmup carried: 0,1 then timed from 2
+    assert calls[:3] == [0, 1, 2]
+
+
+def test_bus_bandwidth_allreduce_accounting(mesh8):
+    """all_reduce over 8 devices: wire bytes = 2(n-1)/n × payload."""
+    x = jnp.ones((1024,), jnp.float32)  # 4096 B payload
+
+    def f(x):
+        return cc.all_reduce_sum(x, "data")
+
+    with cc.recording() as records:
+        jax.jit(jax.shard_map(
+            f, mesh=mesh8, in_specs=P("data"), out_specs=P("data"),
+            check_vma=False,
+        )).lower(jnp.ones((8 * 1024,)))
+    bw = bus_bandwidth(records, step_s=1e-3)
+    expected_wire = 2 * (8 - 1) / 8 * 4096
+    assert bw.wire_bytes_per_step == expected_wire
+    np.testing.assert_allclose(bw.wire_gbps, expected_wire / 1e-3 / 1e9)
+
+
+def test_metrics_logger_jsonl(tmp_path):
+    path = tmp_path / "metrics.jsonl"
+    m = MetricsLogger(path)
+    m.emit("step", loss=1.5, step=3)
+    rec = m.emit_benchmark("samples/sec/chip", 123.4, "samples/sec/chip",
+                           vs_baseline=1.1)
+    m.close()
+    assert rec["value"] == 123.4
+    lines = [json.loads(line) for line in path.read_text().splitlines()]
+    assert lines[0]["event"] == "step" and lines[0]["loss"] == 1.5
+    assert lines[1]["metric"] == "samples/sec/chip"
+    assert lines[1]["vs_baseline"] == 1.1
