@@ -28,6 +28,7 @@ NOMINAL = {
     "mlp_mnist": None,
     "transformer_lm_pp": None,
     "llama3_8b_zero": None,
+    "moe_lm_ep": None,
 }
 
 # Per-chip batch sizes tuned for one v5e chip (16 GB HBM).
@@ -37,6 +38,7 @@ PER_CHIP_BATCH = {
     "mlp_mnist": 1024,
     "transformer_lm_pp": 8,
     "llama3_8b_zero": 1,
+    "moe_lm_ep": 8,
 }
 
 

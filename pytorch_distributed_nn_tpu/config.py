@@ -205,8 +205,25 @@ def _llama3_8b_zero() -> TrainConfig:
     )
 
 
+def _moe_lm_ep() -> TrainConfig:
+    # Beyond the reference (SURVEY.md §2c EP row): mixture-of-experts LM,
+    # experts sharded over the `expert` mesh axis, token dispatch via the
+    # XLA all-to-all the SPMD partitioner derives from the layout.
+    return TrainConfig(
+        preset="moe_lm_ep",
+        steps=50,
+        mesh=MeshSpec(expert=-1, data=1),
+        optim=OptimConfig(name="adamw", lr=3e-4, weight_decay=0.1,
+                          warmup_steps=10, schedule="cosine"),
+        data=DataConfig(dataset="lm_synthetic", batch_size=32, seq_len=1024),
+        model=ModelConfig(name="moe_lm", remat=True),
+        parallel=ParallelConfig(strategy="zero", zero_stage=3),
+    )
+
+
 PRESETS = {
     "mlp_mnist": _mlp_mnist,
+    "moe_lm_ep": _moe_lm_ep,
     "resnet50_dp": _resnet50_dp,
     "bert_base_buckets": _bert_base_buckets,
     "transformer_lm_pp": _transformer_lm_pp,

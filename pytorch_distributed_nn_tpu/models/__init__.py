@@ -28,6 +28,7 @@ def get_model(cfg: ModelConfig):
         lenet,
         llama,
         mlp,
+        moe_lm,
         resnet,
         transformer_lm,
     )
@@ -45,6 +46,7 @@ def available_models() -> list[str]:
         lenet,
         llama,
         mlp,
+        moe_lm,
         resnet,
         transformer_lm,
     )

@@ -1,0 +1,70 @@
+"""Mixture-of-experts decoder LM — the model behind the EP strategy row
+(SURVEY.md §2c "EP (expert/MoE)").
+
+The reference has no MoE model; this extends the Transformer-LM family
+(models/transformer_lm.py) through its per-layer FFN hook: dense FFNs are
+swapped for :class:`~pytorch_distributed_nn_tpu.parallel.expert.MoEMLP`
+on a configurable cadence (``moe_every``, Mixtral-style = every layer,
+GShard-style = every other). Attention, norms, and embeddings are
+inherited unchanged, so TP/fsdp layout rules apply to them verbatim while
+the expert weights pick up the ``expert`` axis.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+from pytorch_distributed_nn_tpu.config import ModelConfig
+from pytorch_distributed_nn_tpu.models import register
+from pytorch_distributed_nn_tpu.models.transformer_lm import TransformerLM
+from pytorch_distributed_nn_tpu.nn.dtypes import get_policy
+from pytorch_distributed_nn_tpu.parallel.expert import MoEMLP
+
+
+class MoETransformerLM(TransformerLM):
+    num_experts: int = 8
+    k: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+    group_size: int = 1024  # routing group (see MoEMLP)
+    moe_every: int = 1  # 1 = every layer (Mixtral), 2 = every other (GShard)
+
+    def layer_ffn(self, i: int) -> Optional[Callable]:
+        if i % self.moe_every != self.moe_every - 1:
+            return None
+
+        def moe_ffn(block, y, train):
+            return MoEMLP(
+                num_experts=self.num_experts, mlp_dim=block.mlp_dim,
+                k=self.k, capacity_factor=self.capacity_factor,
+                aux_loss_weight=self.aux_loss_weight,
+                group_size=self.group_size, dtype=block.dtype,
+                param_dtype=block.param_dtype, name="moe",
+            )(y, train=train)
+
+        return moe_ffn
+
+
+@register("moe_lm")
+def build_moe_lm(cfg: ModelConfig) -> MoETransformerLM:
+    policy = get_policy(cfg.dtype, cfg.compute_dtype)
+    e = cfg.extra
+    return MoETransformerLM(
+        vocab_size=e.get("vocab_size", 32000),
+        num_layers=e.get("num_layers", 12),
+        d_model=e.get("d_model", 768),
+        num_heads=e.get("num_heads", 12),
+        mlp_dim=e.get("mlp_dim", 3072),
+        num_experts=e.get("num_experts", 8),
+        k=e.get("k", 2),
+        capacity_factor=e.get("capacity_factor", 1.25),
+        aux_loss_weight=e.get("aux_loss_weight", 0.01),
+        group_size=e.get("group_size", 1024),
+        moe_every=e.get("moe_every", 1),
+        max_len=e.get("max_len", 2048),
+        dropout=e.get("dropout", 0.0),
+        remat=cfg.remat,
+        attn_impl=e.get("attn_impl", "xla"),
+        dtype=policy.compute_dtype,
+        param_dtype=policy.param_dtype,
+    )

@@ -9,7 +9,7 @@ pipeline strategy instead slices the stack into per-stage segments.
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Callable, Optional
 
 import jax.numpy as jnp
 from flax import linen as nn
@@ -25,6 +25,11 @@ class DecoderBlock(nn.Module):
     mlp_dim: int
     dropout: float = 0.0
     attn_impl: str = "xla"
+    # FFN override hook: (block, y, train) -> y, creating its submodules in
+    # the block's scope. None = the standard dense MLP. This is how the MoE
+    # family (models/moe_lm.py) swaps in expert layers without duplicating
+    # the block.
+    ffn: Optional[Callable] = None
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
 
@@ -43,11 +48,14 @@ class DecoderBlock(nn.Module):
         x = x + y
         y = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
                          name="ln2")(x)
-        y = nn.Dense(self.mlp_dim, dtype=self.dtype,
-                     param_dtype=self.param_dtype, name="mlp_in")(y)
-        y = nn.gelu(y)
-        y = nn.Dense(d, dtype=self.dtype, param_dtype=self.param_dtype,
-                     name="mlp_out")(y)
+        if self.ffn is not None:
+            y = self.ffn(self, y, train)
+        else:
+            y = nn.Dense(self.mlp_dim, dtype=self.dtype,
+                         param_dtype=self.param_dtype, name="mlp_in")(y)
+            y = nn.gelu(y)
+            y = nn.Dense(d, dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="mlp_out")(y)
         if self.dropout:
             y = nn.Dropout(self.dropout, deterministic=not train)(y)
         return x + y
@@ -71,6 +79,12 @@ class TransformerLM(nn.Module):
                     dropout=self.dropout, attn_impl=self.attn_impl,
                     dtype=self.dtype, param_dtype=self.param_dtype)
 
+    def layer_ffn(self, i: int) -> Optional[Callable]:
+        """Per-layer FFN override for block i (see DecoderBlock.ffn).
+        The base LM uses the dense MLP everywhere; the MoE subclass
+        returns expert layers on its cadence."""
+        return None
+
     @nn.compact
     def __call__(self, tokens, *, train: bool = False,
                  positions: Optional[jnp.ndarray] = None):
@@ -93,9 +107,8 @@ class TransformerLM(nn.Module):
             # static or `deterministic=not train` fails on a tracer
             block_cls = nn.remat(DecoderBlock, static_argnums=(2,))
         for i in range(self.num_layers):
-            x = block_cls(**self.block_kwargs(), name=f"block{i}")(
-                x, train
-            )
+            x = block_cls(**self.block_kwargs(), ffn=self.layer_ffn(i),
+                          name=f"block{i}")(x, train)
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
                          name="ln_f")(x)
         return nn.Dense(self.vocab_size, use_bias=False, dtype=jnp.float32,

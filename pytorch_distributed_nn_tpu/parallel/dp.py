@@ -41,27 +41,35 @@ DATA_AXES = (AXIS_DATA, AXIS_FSDP)
 
 def forward(state: TrainState, params, x, *, train: bool):
     """Run the model, threading mutable collections (BatchNorm stats) and
-    a per-step dropout PRNG. Returns (logits, new_model_state)."""
+    a per-step dropout PRNG. Returns (logits, new_model_state, aux_losses)
+    where ``aux_losses`` are scalars sown into the "losses" collection
+    (MoE load-balance terms — parallel/expert.py) to be *added to the
+    task loss*; they are never carried in model_state."""
     variables = {"params": params, **state.model_state}
     # deterministic per-step dropout stream seeded from the TrainState's
     # base key (cfg.seed); under jit-sharding the mask generation
     # partitions with the batch (threefry is partitionable)
     rngs = {"dropout": jax.random.fold_in(state.rng, state.step)}
-    if train and state.model_state:
+    if train:
         logits, updated = state.apply_fn(
-            variables, x, train=True, mutable=list(state.model_state),
+            variables, x, train=True,
+            mutable=list(state.model_state) + ["losses"],
             rngs=rngs,
         )
-        return logits, dict(updated)
-    logits = state.apply_fn(variables, x, train=train,
-                            rngs=rngs if train else None)
-    return logits, state.model_state
+        updated = dict(updated)
+        aux = jax.tree.leaves(updated.pop("losses", {}))
+        return logits, updated, aux
+    logits = state.apply_fn(variables, x, train=train)
+    return logits, state.model_state, []
 
 
 def _loss_and_grads(state, x, y, loss_fn):
     def compute(params):
-        logits, new_model_state = forward(state, params, x, train=True)
+        logits, new_model_state, aux = forward(state, params, x,
+                                               train=True)
         loss = loss_fn(logits, y)
+        for term in aux:  # sown losses (MoE load balance)
+            loss = loss + term
         return loss, new_model_state
 
     (loss, new_model_state), grads = jax.value_and_grad(

@@ -1,0 +1,230 @@
+"""Expert parallelism (MoE) — the EP row of SURVEY.md §2c.
+
+The reference has no MoE support; the task mandates the complete
+parallelism inventory, so expert parallelism is first-class here. The
+design is the GShard/Switch capacity-based formulation, which is the
+TPU-idiomatic one:
+
+- **Routing as einsums, not gather/scatter.** Token→expert assignment is
+  expressed with dense one-hot ``dispatch``/``combine`` tensors and
+  ``einsum`` contractions. Every op is a static-shape matmul — it lands
+  on the MXU and XLA can fuse/partition it; there is no data-dependent
+  control flow anywhere (SURVEY's "no dynamic shapes under jit" rule).
+- **EP as a layout, not a protocol.** Expert weights are stacked
+  ``(E, d, ff)`` and sharded over the ``expert`` mesh axis by
+  :mod:`~pytorch_distributed_nn_tpu.parallel.sharding_rules`; tokens stay
+  sharded over the data axes. XLA's SPMD partitioner then inserts the
+  token all-to-all (dispatch) and its reverse (combine) over ICI — the
+  same way the ZeRO strategy gets its all-gather/reduce-scatter for free
+  (parallel/zero.py). The explicit ``shard_map`` form of the dispatch is
+  :func:`ep_dispatch` / :func:`ep_combine`, the pedagogical analogue of
+  ``dp_explicit``.
+- **Capacity, not queues.** Each expert processes a fixed ``capacity``
+  of tokens per step; overflow tokens are dropped (their combine weight
+  is zero, so they pass through the residual unchanged) — the standard
+  static-shape trade the Switch/GShard papers make.
+
+The auxiliary load-balance loss is sown into the ``"losses"`` collection;
+the shared train-step path (parallel/dp.py ``forward``) collects and adds
+it to the task loss.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from pytorch_distributed_nn_tpu.ops import collectives as cc
+from pytorch_distributed_nn_tpu.runtime.mesh import AXIS_EXPERT
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class Routing:
+    """Result of :func:`top_k_routing` for one group of N tokens."""
+
+    dispatch: jnp.ndarray  # (N, E, C) 0/1 — token n → slot c of expert e
+    combine: jnp.ndarray  # (N, E, C) float — gate weights for the return trip
+    aux_loss: jnp.ndarray  # scalar load-balance loss (Switch formulation)
+    fraction_dropped: jnp.ndarray  # scalar, tokens over capacity
+
+
+def expert_capacity(num_tokens: int, num_experts: int, k: int,
+                    capacity_factor: float) -> int:
+    """Static per-expert slot count: ceil(k·N/E · factor), floored at 1."""
+    return max(1, math.ceil(num_tokens * k * capacity_factor / num_experts))
+
+
+def top_k_routing(router_logits: jnp.ndarray, *, k: int,
+                  capacity: int) -> Routing:
+    """Capacity-based top-k routing (GShard §3.2 scheme, vectorised).
+
+    ``router_logits``: (N, E) float32. Tokens claim expert slots in token
+    order (position-in-expert via cumulative sum); a token whose chosen
+    expert is already at capacity is dropped for that expert. Gates are
+    the softmax probabilities of the chosen experts, renormalised over
+    the k choices (Mixtral convention) *before* capacity dropping.
+    """
+    N, E = router_logits.shape
+    probs = jax.nn.softmax(router_logits.astype(jnp.float32), axis=-1)
+    gate_vals, expert_idx = jax.lax.top_k(probs, k)  # (N, k) each
+    gate_vals = gate_vals / jnp.maximum(
+        gate_vals.sum(-1, keepdims=True), 1e-9
+    )
+
+    # one-hot expert choice per (choice, token): (k, N, E)
+    choice_mask = jax.nn.one_hot(expert_idx.T, E, dtype=jnp.float32)
+
+    # Position of each (choice, token) in its expert's queue. Choices are
+    # ranked choice-major then token-major: all first choices claim slots
+    # before any second choice (GShard's priority rule), so within one
+    # choice level positions are a per-token cumsum, offset by every
+    # earlier level's total claim count.
+    pos_within = jnp.cumsum(choice_mask, axis=1) - choice_mask  # (k, N, E)
+    prior_counts = jnp.cumsum(choice_mask.sum(axis=1), axis=0) \
+        - choice_mask.sum(axis=1)  # (k, E): claims from earlier levels
+    position = pos_within + prior_counts[:, None, :]  # (k, N, E)
+    position = (position * choice_mask).sum(-1)  # (k, N) scalar slot idx
+
+    fits = position < capacity  # (k, N)
+    kept = fits.T * (gate_vals > 0)  # (N, k)
+
+    # combine[n, e, c] = gate weight of token n at slot c of expert e
+    slot_onehot = jax.nn.one_hot(position.T.astype(jnp.int32), capacity,
+                                 dtype=jnp.float32)  # (N, k, C)
+    expert_onehot = jax.nn.one_hot(expert_idx, E, dtype=jnp.float32)
+    combine = jnp.einsum(
+        "nk,nke,nkc->nec",
+        gate_vals * kept.astype(jnp.float32), expert_onehot, slot_onehot,
+    )
+    dispatch = (combine > 0.0).astype(router_logits.dtype)
+
+    # Switch load-balance loss: E · Σ_e f_e·P_e, where f_e is the fraction
+    # of (token, choice) assignments routed to e and P_e the mean router
+    # probability. Minimised (=1) at uniform routing.
+    f = choice_mask.sum(axis=(0, 1)) / (N * k)  # fraction of assignments
+    p = probs.mean(axis=0)  # (E,)
+    aux = E * jnp.sum(f * p)
+
+    dropped = 1.0 - kept.sum() / jnp.asarray(N * k, jnp.float32)
+    return Routing(dispatch=dispatch, combine=combine.astype(
+        router_logits.dtype), aux_loss=aux, fraction_dropped=dropped)
+
+
+class MoEMLP(nn.Module):
+    """Mixture-of-experts FFN block (drop-in for a dense MLP).
+
+    Expert weights are stacked on a leading E dim — ``wi (E, d, ff)``,
+    ``wo (E, ff, d)`` — which the layout rules shard over the ``expert``
+    mesh axis (sharding_rules.EP_RULES). All compute is batched einsum.
+
+    Routing is **grouped** (GShard §3.1): tokens are split into groups of
+    at most ``group_size`` (never crossing a sequence boundary) and each
+    group is routed independently with capacity ``ceil(k·g·cf/E)``. The
+    dispatch/combine tensors are then (G, g, E, C) — O(N·g·k·cf) memory
+    instead of the O(N²·k·cf) a single global group would cost, which is
+    what keeps batch 32 × seq 1024 runnable on a 16 GB chip.
+    """
+
+    num_experts: int = 8
+    mlp_dim: int = 3072
+    k: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+    group_size: int = 1024  # max tokens per routing group
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        B, S, d = x.shape
+        E = self.num_experts
+        g = min(self.group_size, S)
+        if S % g:
+            raise ValueError(
+                f"seq_len {S} not divisible by routing group size {g}"
+            )
+        G = B * (S // g)
+        tokens = x.reshape(G, g, d)
+
+        # Router in fp32: small matmul, numerically load-bearing.
+        router_logits = nn.Dense(
+            E, use_bias=False, dtype=jnp.float32,
+            param_dtype=self.param_dtype, name="router",
+        )(tokens.astype(jnp.float32))  # (G, g, E)
+        C = expert_capacity(g, E, self.k, self.capacity_factor)
+        routing = jax.vmap(
+            partial(top_k_routing, k=self.k, capacity=C)
+        )(router_logits)  # fields batched over G
+
+        wi = self.param(
+            "wi", nn.initializers.lecun_normal(batch_axis=(0,)),
+            (E, d, self.mlp_dim), self.param_dtype,
+        )
+        wo = self.param(
+            "wo", nn.initializers.lecun_normal(batch_axis=(0,)),
+            (E, self.mlp_dim, d), self.param_dtype,
+        )
+
+        # dispatch: (G,g,E,C)×(G,g,d) → (E, G·C, d). Under EP sharding
+        # this einsum is where XLA inserts the token all-to-all.
+        expert_in = jnp.einsum(
+            "gnec,gnd->egcd", routing.dispatch.astype(self.dtype),
+            tokens.astype(self.dtype),
+        ).reshape(E, G * C, d)
+        h = jnp.einsum("esd,edf->esf", expert_in, wi.astype(self.dtype))
+        h = nn.gelu(h)
+        expert_out = jnp.einsum(
+            "esf,efd->esd", h, wo.astype(self.dtype)
+        ).reshape(E, G, C, d)
+        out = jnp.einsum(
+            "gnec,egcd->gnd", routing.combine.astype(self.dtype), expert_out
+        )
+
+        # Collected by parallel/dp.forward into the train loss; a no-op
+        # when the collection isn't mutable (eval / non-MoE callers).
+        # Per-step drop diagnostics live on the Routing value
+        # (fraction_dropped) for direct-layer users; they are not sown.
+        self.sow("losses", "moe_aux",
+                 self.aux_loss_weight * routing.aux_loss.mean(),
+                 reduce_fn=lambda a, b: a + b, init_fn=lambda: jnp.float32(0))
+        return out.reshape(B, S, d)
+
+
+# ---------------------------------------------------------------------------
+# Explicit shard_map EP transport (pedagogical parity with dp_explicit):
+# the hand-rolled all-to-all the compiler path does implicitly.
+# ---------------------------------------------------------------------------
+
+def ep_dispatch(expert_in, *, axis: str = AXIS_EXPERT):
+    """(E, C, d) with E global → (E/n, n·C, d) local expert view.
+
+    Inside ``shard_map`` each device holds its tokens' contributions to
+    *all* E experts; this all-to-all re-partitions so each device holds
+    *its* E/n experts' slots from all n peers — ``dist.all_to_all`` in
+    the reference's vocabulary (SURVEY.md §2c EP row).
+    """
+    n = cc.axis_size(axis)
+    E, C, d = expert_in.shape
+    if E % n:
+        raise ValueError(f"experts {E} not divisible by axis size {n}")
+    out = cc.all_to_all(expert_in, axis, split_axis=0, concat_axis=0)
+    # (E, C, d) → rows grouped as n blocks of E/n experts: reorder to
+    # (E/n, n·C, d) so each local expert sees one contiguous slot buffer.
+    return out.reshape(n, E // n, C, d).transpose(1, 0, 2, 3) \
+        .reshape(E // n, n * C, d)
+
+
+def ep_combine(expert_out, *, axis: str = AXIS_EXPERT):
+    """Inverse of :func:`ep_dispatch`: (E/n, n·C, d) → (E, C, d)."""
+    n = cc.axis_size(axis)
+    El, nC, d = expert_out.shape
+    C = nC // n
+    x = expert_out.reshape(El, n, C, d).transpose(1, 0, 2, 3) \
+        .reshape(n * El, C, d)
+    return cc.all_to_all(x, axis, split_axis=0, concat_axis=0)

@@ -24,7 +24,11 @@ import re
 import numpy as np
 from jax.sharding import PartitionSpec as P
 
-from pytorch_distributed_nn_tpu.runtime.mesh import AXIS_FSDP, AXIS_TENSOR
+from pytorch_distributed_nn_tpu.runtime.mesh import (
+    AXIS_EXPERT,
+    AXIS_FSDP,
+    AXIS_TENSOR,
+)
 
 # Leaves smaller than this stay replicated under fsdp (a gather of a bias
 # costs more than it saves).
@@ -40,19 +44,35 @@ TP_RULES: list[tuple[re.Pattern, int]] = [
     (re.compile(r"(mlp_out|down_proj)/kernel$"), 0),  # (ff, d): row-par
     (re.compile(r"(tok_embed|pos_embed|type_embed)/embedding$"), 0),
     (re.compile(r"(lm_head|mlm_decoder|head)/kernel$"), 1),  # (d, V)
+    (re.compile(r"moe/wi$"), 2),  # (E, d, ff): shard ff (column-parallel)
+    (re.compile(r"moe/wo$"), 1),  # (E, ff, d): shard ff (row-parallel)
+]
+
+# Stacked-expert leaves: leading E dim shards over `expert` (EP row of
+# SURVEY.md §2c). The router stays replicated (it is tiny and every token
+# needs it).
+EP_RULES: list[tuple[re.Pattern, int]] = [
+    (re.compile(r"moe/(wi|wo)$"), 0),
 ]
 
 
 def spec_for(path: str, shape: tuple[int, ...], *, tensor: int = 1,
-             fsdp: int = 1, min_elems: int = MIN_SHARD_ELEMS) -> P:
+             fsdp: int = 1, expert: int = 1,
+             min_elems: int = MIN_SHARD_ELEMS) -> P:
     """The layout rule. ``path`` is the '/'-joined tree path of the leaf
     (params or optimizer state); ``shape`` its shape."""
     ndim = len(shape)
     axes: list = [None] * ndim
+    if expert > 1:
+        for pattern, dim in EP_RULES:
+            if pattern.search(path) and dim < ndim \
+                    and shape[dim] % expert == 0:
+                axes[dim] = AXIS_EXPERT
+                break
     if tensor > 1:
         for pattern, dim in TP_RULES:
             if pattern.search(path) and dim < ndim \
-                    and shape[dim] % tensor == 0:
+                    and shape[dim] % tensor == 0 and axes[dim] is None:
                 axes[dim] = AXIS_TENSOR
                 break
     if fsdp > 1 and int(np.prod(shape or (1,))) >= min_elems:

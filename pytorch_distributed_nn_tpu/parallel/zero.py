@@ -34,6 +34,7 @@ from pytorch_distributed_nn_tpu.parallel.sharding_rules import (
     spec_for,
 )
 from pytorch_distributed_nn_tpu.runtime.mesh import (
+    AXIS_EXPERT,
     AXIS_FSDP,
     AXIS_TENSOR,
     batch_pspec,
@@ -50,13 +51,14 @@ def state_shardings(state: TrainState, mesh: Mesh, *, stage: int = 3):
     """
     tensor = mesh.shape[AXIS_TENSOR]
     fsdp = mesh.shape[AXIS_FSDP]
+    expert = mesh.shape[AXIS_EXPERT]
 
     def shard_tree(tree, *, use_fsdp: bool):
         return jax.tree_util.tree_map_with_path(
             lambda kp, x: NamedSharding(
                 mesh,
                 spec_for(path_str(kp), tuple(x.shape), tensor=tensor,
-                         fsdp=fsdp if use_fsdp else 1),
+                         fsdp=fsdp if use_fsdp else 1, expert=expert),
             ),
             tree,
         )

@@ -81,6 +81,10 @@ class Trainer:
             variables = self.model.init(rng, x0[:1], train=False)
         params = variables.pop("params")
         model_state = dict(variables)
+        # per-step transients (MoE aux losses / router diagnostics), not
+        # state to carry — forward() re-collects them every step
+        model_state.pop("losses", None)
+        model_state.pop("diagnostics", None)
         tx = make_optimizer(cfg.optim, total_steps=cfg.steps)
         state = TrainState.create(
             apply_fn=self.model.apply, params=params, tx=tx,

@@ -8,8 +8,9 @@ from pytorch_distributed_nn_tpu.config import (
 
 
 def test_all_five_presets_exist():
-    # The five benchmark configs from BASELINE.json:6-12.
-    assert set(PRESETS) == {
+    # The five benchmark configs from BASELINE.json:6-12 must all exist
+    # (extra presets beyond the reference are allowed, e.g. moe_lm_ep).
+    assert set(PRESETS) >= {
         "mlp_mnist",
         "resnet50_dp",
         "bert_base_buckets",

@@ -20,6 +20,8 @@ TINY = {
                            mlp_dim=64, vocab_size=101, max_len=64),
     "llama3_8b": dict(num_layers=2, d_model=32, num_heads=4, num_kv_heads=2,
                       mlp_dim=64, vocab_size=101),
+    "moe_lm": dict(num_layers=2, d_model=32, num_heads=2, mlp_dim=64,
+                   num_experts=4, k=2, vocab_size=101, max_len=64),
 }
 
 IMAGE_INPUT = {
