@@ -1,0 +1,198 @@
+"""Fused ring-attention block kernel (context parallelism, SURVEY.md §2c
+"CP / context parallel" row and §7 hard-part (c)).
+
+Ring attention splits the sequence across the ``seq`` mesh axis: Q stays
+resident, K/V shards rotate around the ICI ring (``ppermute``), and an
+online-softmax accumulates each visiting block's contribution. The ring
+*schedule* (scan + ppermute) lives at the shard_map level in
+``parallel/sequence.py`` so XLA can overlap the permute with compute;
+this module fuses the per-block *math* — the flash-attention update
+
+    m' = max(m, rowmax(s));  p = exp(s - m')
+    l' = l·exp(m - m') + rowsum(p);  acc' = acc·exp(m - m') + p·V
+
+— into one Pallas kernel so the (Tl, Tl) score block never touches HBM.
+bf16 operands hit the MXU; carries (m, l, acc) stay f32.
+
+Carry layout: the per-row stats m, l ride between ring steps in HBM as
+``(BH, Tl, STAT_LANES)`` with the scalar broadcast across STAT_LANES=8
+lanes — Mosaic requires block minor dims divisible by (8, 128) or equal
+to the array's, and an 8-wide minor dim keeps the overhead at 32 B/row
+(the official flash kernel burns 128 lanes for the same reason).
+
+Masking: the kernel receives the *global* offsets of its Q and K shards
+(SMEM scalars — they change every ring step) and rebuilds the causal mask
+locally, clamping the K-block loop so fully-future blocks cost nothing.
+The ring order (own block first, then increasingly older blocks) also
+guarantees every causal row sees at least one unmasked key on step 0, so
+the -inf running-max never produces a spurious ``exp(0)`` on later
+fully-masked blocks.
+
+Differentiation: ``pallas_call`` has no automatic VJP, so callers wrap
+the whole ring in ``jax.custom_vjp`` with a recompute backward through
+the jnp schedule (parallel/sequence.py) — flash-attention-style
+recomputation, trading one extra forward for not materialising scores.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+STAT_LANES = 8  # minor dim of the m/l carries (min f32 sublane tile)
+
+
+def _ring_block_kernel(offs_ref, q_ref, k_ref, v_ref, m_ref, l_ref,
+                       acc_ref, mo_ref, lo_ref, acco_ref, *, causal: bool,
+                       block_q: int, block_k: int, kv_len: int):
+    """One KV block's contribution to the running (m, l, acc) carry."""
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * (q_ref.shape[-1] ** -0.5)
+    m = m_ref[0][:, 0:1]  # (block_q, 1) — lanes are broadcast copies
+    l = l_ref[0][:, 0:1]
+    acc = acc_ref[0]
+    q_off = offs_ref[0] + qi * block_q  # global position of my first row
+    k_off = offs_ref[1]  # global position of this KV shard's first key
+
+    num_k = pl.cdiv(kv_len, block_k)
+    if causal:
+        # highest key index any of my rows may attend to is
+        # q_off + block_q - 1; clamp the K loop there (traced bound —
+        # fully-future KV shards cost zero iterations)
+        k_limit = jnp.clip(
+            (q_off + block_q - k_off + block_k - 1) // block_k, 0, num_k
+        )
+    else:
+        k_limit = num_k
+
+    def body(j, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k)].astype(jnp.float32)
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k)].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = q_off + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = k_off + j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, s.max(-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + p.sum(-1, keepdims=True)
+        acc_new = acc * corr + jnp.dot(
+            p, v_blk, preferred_element_type=jnp.float32
+        )
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, k_limit, body, (m, l, acc))
+    mo_ref[0] = jnp.broadcast_to(m, (block_q, STAT_LANES))
+    lo_ref[0] = jnp.broadcast_to(l, (block_q, STAT_LANES))
+    acco_ref[0] = acc
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "block_q", "block_k", "interpret"),
+)
+def _ring_block_pallas(q, k_blk, v_blk, m, l, acc, offs, *, causal: bool,
+                       block_q: int, block_k: int, interpret: bool):
+    """(BH, Tl, D) block update via pallas_call. offs = int32[2] global
+    (q, k) offsets of the local Q shard and the visiting KV shard; m, l
+    are (BH, Tl, STAT_LANES) broadcast carries."""
+    BH, Tl, D = q.shape
+    kv_len = k_blk.shape[1]
+    grid = (BH, Tl // block_q)
+    kernel = functools.partial(
+        _ring_block_kernel, causal=causal, block_q=block_q,
+        block_k=block_k, kv_len=kv_len,
+    )
+    qspec = pl.BlockSpec((1, block_q, D), lambda bh, qi: (bh, qi, 0),
+                         memory_space=pltpu.VMEM)
+    kvspec = pl.BlockSpec((1, kv_len, D), lambda bh, qi: (bh, 0, 0),
+                          memory_space=pltpu.VMEM)
+    mlspec = pl.BlockSpec((1, block_q, STAT_LANES),
+                          lambda bh, qi: (bh, qi, 0),
+                          memory_space=pltpu.VMEM)
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),  # offs
+            qspec, kvspec, kvspec, mlspec, mlspec, qspec,
+        ],
+        out_specs=[mlspec, mlspec, qspec],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Tl, STAT_LANES), jnp.float32),
+            jax.ShapeDtypeStruct((BH, Tl, STAT_LANES), jnp.float32),
+            jax.ShapeDtypeStruct((BH, Tl, D), jnp.float32),
+        ],
+        input_output_aliases={4: 0, 5: 1, 6: 2},  # m, l, acc in-place
+        cost_estimate=pl.CostEstimate(
+            flops=4 * BH * Tl * kv_len * D,
+            bytes_accessed=(3 * BH * Tl * D * q.dtype.itemsize
+                            + 2 * BH * Tl * D * 4),
+            transcendentals=BH * Tl * kv_len,
+        ),
+        interpret=interpret,
+    )(offs, q, k_blk, v_blk, m, l, acc)
+
+
+def _ring_block_reference(q, k_blk, v_blk, m, l, acc, offs, *,
+                          causal: bool):
+    """jnp oracle for the block update, same shapes/layout as the
+    kernel (m, l broadcast over STAT_LANES)."""
+    qf = q.astype(jnp.float32) * (q.shape[-1] ** -0.5)
+    s = jnp.einsum("btd,bsd->bts", qf, k_blk.astype(jnp.float32))
+    if causal:
+        Tl, S = q.shape[1], k_blk.shape[1]
+        q_pos = offs[0] + jax.lax.broadcasted_iota(jnp.int32, (Tl, S), 0)
+        k_pos = offs[1] + jax.lax.broadcasted_iota(jnp.int32, (Tl, S), 1)
+        s = jnp.where((q_pos >= k_pos)[None], s, NEG_INF)
+    m_in = m[..., 0]
+    l_in = l[..., 0]
+    m_new = jnp.maximum(m_in, s.max(-1))
+    p = jnp.exp(s - m_new[..., None])
+    corr = jnp.exp(m_in - m_new)
+    l_new = l_in * corr + p.sum(-1)
+    acc_new = acc * corr[..., None] + jnp.einsum(
+        "bts,bsd->btd", p, v_blk.astype(jnp.float32)
+    )
+    bcast = lambda x: jnp.broadcast_to(  # noqa: E731
+        x[..., None], (*x.shape, STAT_LANES)
+    )
+    return bcast(m_new), bcast(l_new), acc_new
+
+
+def ring_block_update(q, k_blk, v_blk, m, l, acc, offs, *, causal: bool,
+                      block_q: int = 128, block_k: int = 128,
+                      interpret: bool = False):
+    """Dispatch one ring step's block update: Pallas on TPU (or interpret
+    mode for CPU correctness runs), jnp oracle otherwise.
+
+    q/k_blk/v_blk: (BH, Tl, D); m/l: (BH, Tl, STAT_LANES) f32 broadcast
+    carries; acc: (BH, Tl, D) f32; offs: int32[2] = [global q offset,
+    global k offset].
+    """
+    Tl, D = q.shape[1], q.shape[2]
+    on_tpu = jax.default_backend() == "tpu"
+    use_pallas = on_tpu or interpret
+    block_q = min(block_q, Tl)
+    block_k = min(block_k, k_blk.shape[1])
+    if Tl % block_q or k_blk.shape[1] % block_k:
+        use_pallas = False
+    if not use_pallas:
+        return _ring_block_reference(q, k_blk, v_blk, m, l, acc, offs,
+                                     causal=causal)
+    return _ring_block_pallas(
+        q, k_blk, v_blk, m, l, acc, offs.astype(jnp.int32),
+        causal=causal, block_q=block_q, block_k=block_k,
+        interpret=bool(interpret and not on_tpu),
+    )

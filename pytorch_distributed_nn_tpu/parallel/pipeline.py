@@ -64,6 +64,16 @@ def partition_for(model) -> StagePartition:
         TransformerLM,
     )
 
+    from pytorch_distributed_nn_tpu.models.moe_lm import MoETransformerLM
+
+    if isinstance(model, MoETransformerLM):
+        # MoE blocks carry an expert-parallel FFN the dense DecoderBlock
+        # rebuild below can't represent; reject clearly rather than fail
+        # deep inside Flax param matching.
+        raise ValueError(
+            "pipeline strategy does not support MoE models yet; use the "
+            "expert-parallel mesh (strategy='dp' + expert axis) instead"
+        )
     if isinstance(model, TransformerLM):
         block_mod = DecoderBlock(**model.block_kwargs())
         tok = nn.Embed(model.vocab_size, model.d_model,

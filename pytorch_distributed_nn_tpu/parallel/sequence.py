@@ -8,9 +8,10 @@ axis, both exact (not approximations):
   rotate around the ICI ring via ``ppermute`` while a numerically-stable
   online-softmax accumulates (flash-attention math, blockwise over
   devices). O(T/s) memory per device; comm fully overlappable with the
-  per-block matmuls. The Pallas fused kernel (ops/pallas/ring_attention)
-  shares this schedule; this jnp version is its reference and the CPU
-  test path.
+  per-block matmuls. ``impl='pallas'`` fuses each block update into the
+  ops/pallas/ring_attention kernel (the TPU path — scores never touch
+  HBM; backward recomputes through the jnp schedule via custom_vjp);
+  ``impl='xla'`` is the jnp reference and the CPU test path.
 
 - :func:`ulysses_attention` — head-scatter: two ``all_to_all``s reshard
   seq↔heads around an ordinary full-sequence attention, so each device
@@ -23,6 +24,8 @@ the sequence dim.
 
 from __future__ import annotations
 
+import functools
+
 import jax
 import jax.numpy as jnp
 from jax import lax
@@ -33,10 +36,31 @@ from pytorch_distributed_nn_tpu.runtime.mesh import AXIS_SEQ
 _NEG_INF = -1e30
 
 
-def ring_attention(q, k, v, *, axis: str = AXIS_SEQ, causal: bool = True):
+def ring_attention(q, k, v, *, axis: str = AXIS_SEQ, causal: bool = True,
+                   impl: str = "auto"):
     """Exact blockwise attention with rotating KV. q,k,v: local shards
     (B, Tl, H, D) of a (B, T, H, D) sequence-sharded tensor; returns the
-    local (B, Tl, H, D) output shard."""
+    local (B, Tl, H, D) output shard.
+
+    impl: 'xla' (jnp blockwise math), 'pallas' (fused block kernel, TPU),
+    'pallas_interpret' (the Pallas kernel under the interpreter — CPU
+    correctness runs), or 'auto' (pallas on TPU, xla elsewhere).
+    """
+    if impl == "auto":
+        impl = "pallas" if jax.default_backend() == "tpu" else "xla"
+    if impl == "xla":
+        return _ring_attention_xla(q, k, v, axis=axis, causal=causal)
+    if impl in ("pallas", "pallas_interpret"):
+        return _ring_attention_fused(
+            q, k, v, axis, causal, impl == "pallas_interpret"
+        )
+    raise ValueError(f"unknown ring attention impl {impl!r}")
+
+
+def _ring_attention_xla(q, k, v, *, axis: str = AXIS_SEQ,
+                        causal: bool = True):
+    """jnp reference schedule — autodiff-friendly; also the recompute
+    path for the fused kernel's backward."""
     s = lax.axis_size(axis)
     idx = lax.axis_index(axis)
     B, Tl, H, D = q.shape
@@ -90,6 +114,74 @@ def ring_attention(q, k, v, *, axis: str = AXIS_SEQ, causal: bool = True):
     denom = l.transpose(0, 2, 1, 3)
     out = acc / jnp.maximum(denom, 1e-30)
     return out.astype(q.dtype)
+
+
+def _ring_fused_impl(q, k, v, axis: str, causal: bool, interpret: bool):
+    """Forward ring schedule with the fused Pallas block kernel
+    (ops/pallas/ring_attention): same math as :func:`_ring_attention_xla`
+    but each block update runs in one kernel, (BH, Tl, D) layout."""
+    from pytorch_distributed_nn_tpu.ops.pallas.ring_attention import (
+        STAT_LANES,
+        ring_block_update,
+    )
+
+    s = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    B, Tl, H, D = q.shape
+    Hkv = k.shape[2]
+    if H != Hkv:
+        k = jnp.repeat(k, H // Hkv, axis=2)
+        v = jnp.repeat(v, H // Hkv, axis=2)
+
+    def to_bh(x):
+        return x.transpose(0, 2, 1, 3).reshape(B * H, Tl, D)
+
+    qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
+    m0 = jnp.full((B * H, Tl, STAT_LANES), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B * H, Tl, STAT_LANES), jnp.float32)
+    acc0 = jnp.zeros((B * H, Tl, D), jnp.float32)
+
+    def step(carry, i):
+        k_blk, v_blk, m, l, acc = carry
+        src_block = (idx - i) % s
+        offs = jnp.stack([idx * Tl, src_block * Tl]).astype(jnp.int32)
+        m, l, acc = ring_block_update(
+            qb, k_blk, v_blk, m, l, acc, offs, causal=causal,
+            interpret=interpret,
+        )
+        k_blk = cc.shift_right(k_blk, axis)
+        v_blk = cc.shift_right(v_blk, axis)
+        return (k_blk, v_blk, m, l, acc), None
+
+    (kb, vb, m, l, acc), _ = lax.scan(
+        step, (kb, vb, m0, l0, acc0), jnp.arange(s)
+    )
+    out = acc / jnp.maximum(l[..., 0:1], 1e-30)
+    return out.reshape(B, H, Tl, D).transpose(0, 2, 1, 3).astype(q.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _ring_attention_fused(q, k, v, axis, causal, interpret):
+    return _ring_fused_impl(q, k, v, axis, causal, interpret)
+
+
+def _ring_fused_fwd(q, k, v, axis, causal, interpret):
+    return _ring_fused_impl(q, k, v, axis, causal, interpret), (q, k, v)
+
+
+def _ring_fused_bwd(axis, causal, interpret, res, g):
+    # flash-style recompute: rerun the (differentiable) jnp schedule and
+    # pull its VJP — no (T, T) scores or per-block residuals ever stored
+    q, k, v = res
+    _, vjp = jax.vjp(
+        lambda a, b, c: _ring_attention_xla(a, b, c, axis=axis,
+                                            causal=causal),
+        q, k, v,
+    )
+    return vjp(g.astype(q.dtype))
+
+
+_ring_attention_fused.defvjp(_ring_fused_fwd, _ring_fused_bwd)
 
 
 def ulysses_attention(q, k, v, *, axis: str = AXIS_SEQ,

@@ -74,6 +74,48 @@ def test_ulysses_matches_full(seq_mesh, causal):
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_ring_fused_kernel_matches_full(seq_mesh, causal):
+    """Runs the REAL Pallas block kernel (interpret mode on CPU) through
+    the ring schedule — the kernel's math, masking, and SMEM-offset
+    plumbing are all exercised, not the jnp fallback."""
+    q, k, v = _qkv()
+    want = np.asarray(dot_product_attention(q, k, v, causal=causal))
+    got = _run(
+        seq_mesh,
+        lambda a, b, c: ring_attention(a, b, c, causal=causal,
+                                       impl="pallas_interpret"),
+        q, k, v,
+    )
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-5)
+
+
+def test_ring_fused_gradients_match_xla(seq_mesh):
+    """custom_vjp wiring: grads through the fused path == grads through
+    the jnp schedule (which autodiff handles directly)."""
+    q, k, v = _qkv()
+
+    def loss(impl):
+        def f(a, b, c):
+            out = ring_attention(a, b, c, causal=True, impl=impl)
+            return (out ** 2).sum()
+
+        mapped = jax.shard_map(
+            lambda a, b, c: jax.grad(f, argnums=(0, 1, 2))(a, b, c),
+            mesh=seq_mesh,
+            in_specs=(SEQ_SPEC,) * 3,
+            out_specs=(SEQ_SPEC,) * 3,
+            check_vma=False,
+        )
+        return jax.jit(mapped)(q, k, v)
+
+    want = loss("xla")
+    got = loss("pallas_interpret")
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=2e-4, atol=2e-5)
+
+
 def test_ulysses_rejects_indivisible_heads(seq_mesh):
     q, k, v = _qkv(hkv=2)  # 2 kv heads not divisible by seq=8
     with pytest.raises(ValueError):
