@@ -1,0 +1,303 @@
+"""``tpu-launch`` — the ``torchrun`` replacement (elastic agent).
+
+The reference is launched as ``torchrun --nproc-per-node N train.py``:
+an agent process spawns N workers with the ``RANK``/``WORLD_SIZE``/
+``MASTER_ADDR``/``MASTER_PORT`` env contract, watches them, and on a
+worker failure tears the gang down and restarts it up to
+``--max-restarts`` times (SURVEY.md §1 Launch row, §2b torchrun row,
+§5 Failure-detection row). This module is the TPU-native equivalent:
+
+- spawns N local worker processes with both the JAX-native
+  (``PROCESS_ID``/``NUM_PROCESSES``/``COORDINATOR_ADDRESS``) and the
+  torch-style (``RANK``/``WORLD_SIZE``/``MASTER_ADDR``/``MASTER_PORT``)
+  env contracts, so either convention works in the worker
+  (:mod:`runtime.bootstrap` reads both);
+- monitors worker liveness two ways: exit codes (crash) and — when
+  ``--heartbeat-timeout`` is set — heartbeats into a node-local C++
+  store (native/store.cpp) it hosts (hang — a deadlocked collective
+  never exits, so exit codes are not enough); each node's agent watches
+  only the ranks it spawned;
+- on failure, kills the whole gang and relaunches it with an
+  incremented ``TPUNN_RESTART`` incarnation. Recovery of *progress* is
+  the worker's job: resume from the latest checkpoint
+  (``train.checkpoint.CheckpointManager.restore``), the standard TPU
+  fail-fast + restart-from-checkpoint practice.
+
+CLI::
+
+    python -m pytorch_distributed_nn_tpu.launch \
+        --nprocs 4 --max-restarts 2 -- script.py --flag ...
+
+On a real multi-host pod each host runs one agent with
+``--node-rank``/``--nnodes`` so rank offsets and the coordinator
+address line up; workers then hold the hosts' chips via PJRT.
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import logging
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+from .runtime import failure, native
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass
+class LaunchConfig:
+    nprocs: int
+    max_restarts: int = 0
+    heartbeat_timeout_s: float | None = None  # None → exit-code-only watch
+    heartbeat_interval_s: float = 1.0
+    progress_timeout_s: float | None = None  # step-progress watchdog window
+    poll_interval_s: float = 0.2
+    kill_grace_s: float = 5.0
+    nnodes: int = 1
+    node_rank: int = 0
+    master_addr: str = "127.0.0.1"
+    master_port: int | None = None  # None → pick a free port per incarnation
+    env: dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class LaunchResult:
+    exit_code: int
+    restarts: int  # incarnations actually consumed (0 = clean first run)
+    reason: str = "ok"  # "ok" | "crash" | "hang"
+
+
+def _clamp_code(code: int) -> int:
+    """Exit codes a shell can see: signal-killed workers (poll() < 0)
+    map to the 128+N convention instead of aliasing the hang sentinel
+    or being masked to an arbitrary byte by sys.exit."""
+    if code < 0:
+        return 128 - code
+    return code if 0 < code < 256 else 1
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class ElasticAgent:
+    """One incarnation loop: spawn gang → watch → (maybe) restart."""
+
+    def __init__(self, argv: list[str], cfg: LaunchConfig) -> None:
+        if not argv:
+            raise ValueError("no worker command given")
+        if cfg.nprocs < 1:
+            # An empty gang would vacuously "succeed" in _watch.
+            raise ValueError(f"nprocs must be >= 1, got {cfg.nprocs}")
+        if (cfg.progress_timeout_s is not None
+                and cfg.heartbeat_timeout_s is None):
+            raise ValueError(
+                "progress_timeout_s needs heartbeat_timeout_s: the "
+                "watchdog signals a hang by going silent, and only the "
+                "heartbeat monitor listens for silence"
+            )
+        if (cfg.heartbeat_timeout_s is not None
+                and cfg.heartbeat_timeout_s < 2 * cfg.heartbeat_interval_s):
+            # A timeout inside the beat period would condemn healthy
+            # workers between beats.
+            raise ValueError(
+                f"heartbeat_timeout_s ({cfg.heartbeat_timeout_s}) must be "
+                f">= 2x heartbeat_interval_s ({cfg.heartbeat_interval_s})"
+            )
+        self.argv = argv
+        self.cfg = cfg
+        self._procs: list[subprocess.Popen] = []
+
+    # -- gang lifecycle ----------------------------------------------------
+
+    def _spawn(self, incarnation: int, store_port: int | None) -> None:
+        cfg = self.cfg
+        if cfg.master_port is None and cfg.nnodes > 1:
+            # Each node runs its own agent; a per-agent random port would
+            # hand every node a different COORDINATOR_ADDRESS.
+            raise ValueError("--master-port is required when nnodes > 1")
+        port = cfg.master_port or _free_port()
+        world = cfg.nprocs * cfg.nnodes
+        base = cfg.nprocs * cfg.node_rank
+        for local_rank in range(cfg.nprocs):
+            rank = base + local_rank
+            env = dict(os.environ)
+            env.update(cfg.env)
+            env.update(
+                RANK=str(rank),
+                LOCAL_RANK=str(local_rank),
+                WORLD_SIZE=str(world),
+                MASTER_ADDR=cfg.master_addr,
+                MASTER_PORT=str(port),
+                PROCESS_ID=str(rank),
+                NUM_PROCESSES=str(world),
+                COORDINATOR_ADDRESS=f"{cfg.master_addr}:{port}",
+            )
+            env[failure.ENV_RESTART] = str(incarnation)
+            env[failure.ENV_HB_INTERVAL] = str(cfg.heartbeat_interval_s)
+            if cfg.progress_timeout_s is not None:
+                env[failure.ENV_PROGRESS_WINDOW] = str(cfg.progress_timeout_s)
+            if store_port is not None:
+                # Workers heartbeat into the store of the agent that
+                # spawned them (always this host) — node-local liveness.
+                env[failure.ENV_STORE_PORT] = str(store_port)
+                env[failure.ENV_STORE_HOST] = "127.0.0.1"
+            self._procs.append(subprocess.Popen(
+                [sys.executable, *self.argv], env=env
+            ))
+
+    def _kill_gang(self) -> None:
+        for p in self._procs:
+            if p.poll() is None:
+                p.terminate()
+        deadline = time.time() + self.cfg.kill_grace_s
+        for p in self._procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=max(0.05, deadline - time.time()))
+                except subprocess.TimeoutExpired:
+                    p.kill()
+                    p.wait()
+        self._procs.clear()
+
+    # -- one incarnation ---------------------------------------------------
+
+    def _watch(self, detector: failure.FailureDetector | None
+               ) -> tuple[str, int]:
+        """Poll until the gang succeeds, a worker fails, or a worker
+        hangs. Success requires *every* worker to exit 0. Returns
+        (reason, exit_code) with reason in {"ok", "crash", "hang"}."""
+        cfg = self.cfg
+        base = cfg.nprocs * cfg.node_rank
+        while True:
+            codes = [p.poll() for p in self._procs]
+            bad = [(i, c) for i, c in enumerate(codes) if c not in (None, 0)]
+            if bad:
+                rank, code = bad[0]
+                log.warning("worker local_rank=%d exited %d", rank, code)
+                return "crash", _clamp_code(code)
+            if all(c == 0 for c in codes):
+                return "ok", 0
+            if detector is not None:
+                alive = {base + i for i, c in enumerate(codes) if c is None}
+                stale = detector.stale_ranks(alive)
+                if stale:
+                    log.warning("heartbeat lost from ranks %s", stale)
+                    return "hang", 1
+            time.sleep(cfg.poll_interval_s)
+
+    def run(self) -> LaunchResult:
+        cfg = self.cfg
+        for incarnation in range(cfg.max_restarts + 1):
+            server = None
+            monitor = None
+            detector = None
+            try:
+                if cfg.heartbeat_timeout_s is not None:
+                    # The store (and the workers' heartbeat threads) only
+                    # exist when something will read the beats.
+                    try:
+                        server = native.StoreServer()
+                    except (native.NativeUnavailable, OSError) as e:
+                        raise RuntimeError(
+                            "heartbeat monitoring requires the native "
+                            f"store, which failed to load: {e}"
+                        ) from e
+                    monitor = native.StoreClient("127.0.0.1", server.port)
+                    base = cfg.nprocs * cfg.node_rank
+                    detector = failure.FailureDetector(
+                        monitor,
+                        ranks=list(range(base, base + cfg.nprocs)),
+                        incarnation=incarnation,
+                        timeout_s=cfg.heartbeat_timeout_s,
+                    )
+                self._spawn(incarnation,
+                            server.port if server is not None else None)
+                reason, code = self._watch(detector)
+            finally:
+                self._kill_gang()
+                if monitor is not None:
+                    monitor.close()
+                if server is not None:
+                    server.stop()
+            if reason == "ok":
+                return LaunchResult(exit_code=0, restarts=incarnation)
+            if incarnation < cfg.max_restarts:
+                log.warning("restarting gang (incarnation %d → %d)",
+                            incarnation, incarnation + 1)
+        return LaunchResult(exit_code=code, restarts=cfg.max_restarts,
+                            reason=reason)
+
+
+def launch(argv: list[str], cfg: LaunchConfig) -> LaunchResult:
+    """Run ``argv`` (a python script + args) as an ``nprocs`` gang."""
+    agent = ElasticAgent(argv, cfg)
+
+    def _sigterm(signum, frame):  # propagate an agent kill to the gang
+        agent._kill_gang()
+        signal.signal(signum, signal.SIG_DFL)
+        os.kill(os.getpid(), signum)
+
+    old = signal.signal(signal.SIGTERM, _sigterm)
+    try:
+        return agent.run()
+    finally:
+        signal.signal(signal.SIGTERM, old)
+
+
+def main(args: list[str] | None = None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m pytorch_distributed_nn_tpu.launch",
+        description=__doc__.split("\n\n")[0],
+    )
+    ap.add_argument("--nprocs", type=int, required=True,
+                    help="worker processes on this host "
+                         "(torchrun --nproc-per-node)")
+    ap.add_argument("--max-restarts", type=int, default=0)
+    ap.add_argument("--heartbeat-timeout", type=float, default=None,
+                    help="seconds without a heartbeat before a worker "
+                         "counts as hung (default: exit-code watch only)")
+    ap.add_argument("--progress-timeout", type=float, default=None,
+                    help="seconds without a completed training step "
+                         "before a worker stops heartbeating (catches "
+                         "deadlocked collectives; needs "
+                         "--heartbeat-timeout)")
+    ap.add_argument("--nnodes", type=int, default=1)
+    ap.add_argument("--node-rank", type=int, default=0)
+    ap.add_argument("--master-addr", default="127.0.0.1")
+    ap.add_argument("--master-port", type=int, default=None)
+    ap.add_argument("script", nargs=argparse.REMAINDER,
+                    help="worker script and its args (prefix with --)")
+    ns = ap.parse_args(args)
+    script = ns.script[1:] if ns.script[:1] == ["--"] else ns.script
+    if not script:
+        ap.error("missing worker script")
+    if ns.progress_timeout is not None and ns.heartbeat_timeout is None:
+        ap.error("--progress-timeout requires --heartbeat-timeout")
+    logging.basicConfig(level=logging.INFO,
+                        format="[tpu-launch] %(levelname)s %(message)s")
+    result = launch(script, LaunchConfig(
+        nprocs=ns.nprocs,
+        max_restarts=ns.max_restarts,
+        heartbeat_timeout_s=ns.heartbeat_timeout,
+        progress_timeout_s=ns.progress_timeout,
+        nnodes=ns.nnodes,
+        node_rank=ns.node_rank,
+        master_addr=ns.master_addr,
+        master_port=ns.master_port,
+    ))
+    if result.restarts:
+        log.info("job finished after %d restart(s)", result.restarts)
+    return result.exit_code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -37,11 +37,14 @@ recomputation, trading one extra forward for not materialising scores.
 from __future__ import annotations
 
 import functools
+import logging
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+log = logging.getLogger(__name__)
 
 NEG_INF = -1e30
 STAT_LANES = 8  # minor dim of the m/l carries (min f32 sublane tile)
@@ -189,6 +192,11 @@ def ring_block_update(q, k_blk, v_blk, m, l, acc, offs, *, causal: bool,
     if Tl % block_q or k_blk.shape[1] % block_k:
         use_pallas = False
     if not use_pallas:
+        log.warning(
+            "ring_block_update: jnp fallback, fused kernel NOT used "
+            "(backend=%s, Tl=%d, kv_len=%d, block_q=%d, block_k=%d)",
+            jax.default_backend(), Tl, k_blk.shape[1], block_q, block_k,
+        )
         return _ring_block_reference(q, k_blk, v_blk, m, l, acc, offs,
                                      causal=causal)
     return _ring_block_pallas(

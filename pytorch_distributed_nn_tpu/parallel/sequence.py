@@ -107,9 +107,13 @@ def _ring_attention_xla(q, k, v, *, axis: str = AXIS_SEQ,
     m0 = jnp.full((B, H, Tl, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((B, H, Tl, 1), jnp.float32)
     acc0 = jnp.zeros((B, Tl, H, D), jnp.float32)
+    # s-1 rotate-after-use rounds in the scan, then the last held block
+    # outside it: the final rotation's output is never read, so don't
+    # pay its 2 ppermutes of full KV shards.
     (k, v, m, l, acc), _ = lax.scan(
-        step, (k, v, m0, l0, acc0), jnp.arange(s)
+        step, (k, v, m0, l0, acc0), jnp.arange(s - 1)
     )
+    m, l, acc = block_contrib(k, v, (idx - (s - 1)) % s, m, l, acc)
     # l: (B, H, Tl, 1) → (B, Tl, H, 1)
     denom = l.transpose(0, 2, 1, 3)
     out = acc / jnp.maximum(denom, 1e-30)
@@ -153,8 +157,17 @@ def _ring_fused_impl(q, k, v, axis: str, causal: bool, interpret: bool):
         v_blk = cc.shift_right(v_blk, axis)
         return (k_blk, v_blk, m, l, acc), None
 
+    # As in _ring_attention_xla: last block handled outside the scan so
+    # the never-read final rotation is not issued.
     (kb, vb, m, l, acc), _ = lax.scan(
-        step, (kb, vb, m0, l0, acc0), jnp.arange(s)
+        step, (kb, vb, m0, l0, acc0), jnp.arange(s - 1)
+    )
+    last = s - 1
+    offs = jnp.stack(
+        [idx * Tl, ((idx - last) % s) * Tl]
+    ).astype(jnp.int32)
+    m, l, acc = ring_block_update(
+        qb, kb, vb, m, l, acc, offs, causal=causal, interpret=interpret,
     )
     out = acc / jnp.maximum(l[..., 0:1], 1e-30)
     return out.reshape(B, H, Tl, D).transpose(0, 2, 1, 3).astype(q.dtype)

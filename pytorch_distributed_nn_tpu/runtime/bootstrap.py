@@ -76,6 +76,12 @@ def initialize(
             process_id=process_id,
         )
 
+    # Under the elastic agent (launch.py) this starts the liveness
+    # heartbeat; a plain launch has no store env and it is a no-op.
+    from . import failure
+
+    failure.maybe_start_heartbeat(rank=process_id)
+
     return ProcessInfo(
         process_index=jax.process_index(),
         process_count=jax.process_count(),

@@ -1,0 +1,217 @@
+"""Failure detection — heartbeats over the native rendezvous store.
+
+The reference ecosystem's failure story is ``torchrun``'s elastic agent:
+a supervisor process watches workers and tears the job down (or restarts
+it) when one dies or hangs (SURVEY.md §5 "Failure detection" row; §2b
+"torchrun elastic agent / c10d TCPStore" row). The TPU-native equivalent
+here has two halves:
+
+- **Worker side** (:class:`HeartbeatReporter`): a daemon thread that
+  writes ``hb/<incarnation>/<rank> -> monotonic-ish wall time`` into the
+  job's store every ``interval`` seconds. :func:`maybe_start_heartbeat`
+  is called from :func:`runtime.bootstrap.initialize`, so any worker
+  launched by the elastic agent heartbeats automatically. Two modes:
+
+  - *liveness* (default): the thread beats as long as the process is
+    up — catches crashed-but-not-exited and SIGSTOP-frozen workers.
+  - *progress watchdog* (``progress_window_s`` set, from the agent's
+    ``--progress-timeout``): once armed by the first
+    :func:`notify_progress` call, the thread goes silent unless
+    application code has called :func:`notify_progress` within the
+    window (before that it beats as pure liveness, so an arbitrarily
+    long first-step trace+compile is not mistaken for a hang). The
+    training loop calls it once per completed step, so a worker whose
+    main thread is stuck inside a hung collective stops beating even
+    though the daemon thread itself is fine — this is what makes a
+    deadlocked ``psum`` detectable at all (the daemon thread alone
+    would happily beat forever under it).
+
+- **Supervisor side** (:class:`FailureDetector`): polls those keys and
+  reports still-running ranks whose last beat is older than
+  ``timeout`` — the hang detector that exit-code monitoring alone
+  cannot provide (a deadlocked collective never exits).
+
+Both halves speak to the C++ store (native/store.cpp) through the ctypes
+bindings in :mod:`runtime.native`; the store is the same one used for
+rank rendezvous, so no extra service is needed.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+
+from . import native
+
+log = logging.getLogger(__name__)
+
+# Environment contract between the elastic agent and its workers.
+ENV_STORE_PORT = "TPUNN_STORE_PORT"
+ENV_STORE_HOST = "TPUNN_STORE_HOST"
+ENV_RESTART = "TPUNN_RESTART"          # incarnation index (0 on first launch)
+ENV_HB_INTERVAL = "TPUNN_HEARTBEAT_INTERVAL"
+ENV_PROGRESS_WINDOW = "TPUNN_PROGRESS_WINDOW"
+
+
+def _hb_key(incarnation: int, rank: int) -> str:
+    return f"hb/{incarnation}/{rank}"
+
+
+class HeartbeatReporter:
+    """Worker-side daemon thread: periodic ``set(hb/<inc>/<rank>, now)``.
+
+    With ``progress_window_s`` set, beats are suppressed once
+    :meth:`notify_progress` has not been called for that long (progress
+    watchdog mode — see module docstring).
+    """
+
+    def __init__(self, client: native.StoreClient, *, rank: int,
+                 incarnation: int = 0, interval_s: float = 1.0,
+                 progress_window_s: float | None = None) -> None:
+        self._client = client
+        self._key = _hb_key(incarnation, rank)
+        self._interval = interval_s
+        self._window = progress_window_s
+        # None until the first notify_progress: the watchdog only arms
+        # once a step has completed, so an arbitrarily long first-step
+        # trace+compile can't read as a hang and livelock the restarts
+        # (until then, beats are pure process liveness).
+        self._last_progress: float | None = None
+        self._stop = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name=f"heartbeat-r{rank}", daemon=True
+        )
+        self.beat()  # one synchronous beat so the detector sees us at once
+        self._thread.start()
+
+    def beat(self) -> None:
+        self._client.set(self._key, repr(time.time()).encode())
+
+    def notify_progress(self) -> None:
+        """Application-level liveness: the step loop moved forward."""
+        self._last_progress = time.time()
+
+    def disarm(self) -> None:
+        """Back to liveness-only (training loop exited): post-loop work
+        of unbounded length — checkpoint drains, eval — must not read
+        as a hang."""
+        self._last_progress = None
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._interval):
+            if (self._window is not None
+                    and self._last_progress is not None
+                    and time.time() - self._last_progress > self._window):
+                continue  # main thread looks stuck: go silent, get flagged
+            try:
+                self.beat()
+            except OSError:  # store gone: supervisor is tearing us down
+                return
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=2 * self._interval)
+        if self._thread.is_alive():
+            # Beat thread is wedged inside a store call; closing now
+            # would free the C handle under it. Leak the connection —
+            # the process is exiting anyway.
+            return
+        self._client.close()
+
+
+_reporter: HeartbeatReporter | None = None
+
+
+def maybe_start_heartbeat(rank: int | None = None) -> HeartbeatReporter | None:
+    """Start heartbeating iff launched under the elastic agent.
+
+    Reads the agent's env contract; a plain (non-agent) launch has no
+    ``TPUNN_STORE_PORT`` and this is a no-op. Idempotent.
+    """
+    global _reporter
+    if _reporter is not None:
+        return _reporter
+    port = os.environ.get(ENV_STORE_PORT)
+    if not port:
+        return None
+    if rank is None:
+        rank = int(os.environ.get("PROCESS_ID", os.environ.get("RANK", "0")))
+    window = os.environ.get(ENV_PROGRESS_WINDOW)
+    try:
+        client = native.StoreClient(
+            os.environ.get(ENV_STORE_HOST, "127.0.0.1"), int(port)
+        )
+        # OSError can come from the constructor's first beat when the
+        # agent is tearing the store down at this very moment; a dying
+        # job must not gain a worker traceback on top.
+        _reporter = HeartbeatReporter(
+            client,
+            rank=rank,
+            incarnation=int(os.environ.get(ENV_RESTART, "0")),
+            interval_s=float(os.environ.get(ENV_HB_INTERVAL, "1.0")),
+            progress_window_s=float(window) if window else None,
+        )
+    except (native.NativeUnavailable, ConnectionError, OSError) as e:
+        log.warning("heartbeat disabled: %s", e)
+        return None
+    return _reporter
+
+
+def notify_progress() -> None:
+    """Per-step hook for training loops; no-op outside the agent."""
+    if _reporter is not None:
+        _reporter.notify_progress()
+
+
+def notify_done() -> None:
+    """Loop-exit hook: disarm the progress watchdog; no-op outside the
+    agent."""
+    if _reporter is not None:
+        _reporter.disarm()
+
+
+class FailureDetector:
+    """Supervisor-side staleness check over the workers' heartbeat keys.
+
+    Node-local by design: each elastic agent hosts its own store and
+    watches only the ranks it spawned (crashes/hangs on other nodes are
+    that node's agent's job; cross-node teardown rides the job-level
+    restart because a killed gang takes the JAX coordinator down with
+    it).
+    """
+
+    def __init__(self, client: native.StoreClient, *, ranks: list[int],
+                 incarnation: int, timeout_s: float) -> None:
+        self._client = client
+        self._ranks = list(ranks)
+        self._incarnation = incarnation
+        self._timeout = timeout_s
+        self._first_seen: dict[int, float] = {}
+
+    def stale_ranks(self, alive: set[int] | None = None) -> list[int]:
+        """Ranks whose heartbeat is older than the timeout.
+
+        ``alive`` — ranks whose process is still running; ranks not in
+        it have exited and are the exit-code watcher's business, not
+        ours (a worker that finished cleanly stops beating and must not
+        read as hung). A rank that has never beaten is only stale once
+        it has been up longer than the timeout (startup grace: workers
+        need time to import jax and connect).
+        """
+        now = time.time()
+        stale = []
+        for rank in self._ranks:
+            if alive is not None and rank not in alive:
+                continue
+            key = _hb_key(self._incarnation, rank)
+            if self._client.check(key):
+                last = float(self._client.get(key, timeout_ms=1000))
+                if now - last > self._timeout:
+                    stale.append(rank)
+            else:
+                first = self._first_seen.setdefault(rank, now)
+                if now - first > self._timeout:
+                    stale.append(rank)
+        return stale

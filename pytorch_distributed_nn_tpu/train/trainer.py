@@ -19,6 +19,7 @@ import numpy as np
 from pytorch_distributed_nn_tpu.config import TrainConfig
 from pytorch_distributed_nn_tpu.data import DataLoader, get_dataset
 from pytorch_distributed_nn_tpu.models import get_model
+from pytorch_distributed_nn_tpu.runtime import failure
 from pytorch_distributed_nn_tpu.parallel import make_train_step
 from pytorch_distributed_nn_tpu.runtime.mesh import make_mesh
 from pytorch_distributed_nn_tpu.train.losses import get_loss_fn
@@ -110,6 +111,11 @@ class Trainer:
             self.data_step += 1
             g = self.data_step  # 1-based global step just dispatched
             self.state, metrics = self.step_fn(self.state, x, y)
+            # Progress watchdog food (launch.py --progress-timeout).
+            # Dispatch is async, but a hung device op stalls this loop
+            # within a few iterations via dispatch-queue backpressure,
+            # so per-iteration notification tracks real device progress.
+            failure.notify_progress()
             if (self.ckpt is not None and cfg.checkpoint_every
                     and g % cfg.checkpoint_every == 0):
                 self.ckpt.save(self.state, data_step=self.data_step)
@@ -126,6 +132,9 @@ class Trainer:
                              rec.seconds)
         # sync before returning so wall-clock timings are honest
         jax.block_until_ready(self.state.params)
+        # Post-loop work (checkpoint drain, eval) is unbounded: back to
+        # liveness-only heartbeats so it can't read as a hang.
+        failure.notify_done()
         return self.history
 
     def save_checkpoint(self, *, force: bool = True) -> bool:

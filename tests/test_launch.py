@@ -1,0 +1,218 @@
+"""Elastic-agent tests — the torchrun-replacement contract.
+
+SURVEY.md §5 "Failure detection" row: fault injection = kill a worker in
+the multi-process harness; the agent must detect it (exit code or lost
+heartbeat), restart the gang, and the workers must resume from their
+checkpoint. Workers here are small generated scripts so each test stays
+subprocess-cheap (numpy-only workers; no jax import on the hot paths).
+"""
+
+import os
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_nn_tpu.launch import LaunchConfig, launch
+from pytorch_distributed_nn_tpu.runtime import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native store not built"
+)
+
+
+def _write(tmp_path, name, body):
+    path = tmp_path / name
+    path.write_text(textwrap.dedent(body))
+    return str(path)
+
+
+def test_gang_env_contract(tmp_path):
+    """Both env conventions (torch-style and JAX-native) reach workers."""
+    script = _write(tmp_path, "worker.py", """
+        import os, sys
+        out = sys.argv[1]
+        rank = os.environ["RANK"]
+        assert os.environ["PROCESS_ID"] == rank
+        assert os.environ["WORLD_SIZE"] == os.environ["NUM_PROCESSES"] == "2"
+        addr, port = os.environ["MASTER_ADDR"], os.environ["MASTER_PORT"]
+        assert os.environ["COORDINATOR_ADDRESS"] == f"{addr}:{port}"
+        with open(f"{out}/rank{rank}.txt", "w") as f:
+            f.write(port)
+    """)
+    result = launch([script, str(tmp_path)], LaunchConfig(nprocs=2))
+    assert result.exit_code == 0 and result.restarts == 0
+    ports = {(tmp_path / f"rank{r}.txt").read_text() for r in range(2)}
+    assert len(ports) == 1  # whole gang agreed on the coordinator port
+
+
+def test_crash_restart_resumes_from_checkpoint(tmp_path):
+    """Rank 1 dies at step 5 of 10; the restarted gang resumes at 5."""
+    script = _write(tmp_path, "worker.py", """
+        import os, sys
+        import numpy as np
+        ckpt = sys.argv[1] + "/state.npy"
+        rank = int(os.environ["RANK"])
+        incarnation = int(os.environ["TPUNN_RESTART"])
+        step = int(np.load(ckpt)) if os.path.exists(ckpt) else 0
+        first_step = step
+        while step < 10:
+            step += 1
+            if rank == 1 and incarnation == 0 and step == 5:
+                os._exit(17)  # injected fault
+            if rank == 0:
+                np.save(ckpt, np.int64(step))
+        with open(f"{sys.argv[1]}/done{rank}_{incarnation}", "w") as f:
+            f.write(str(first_step))
+    """)
+    result = launch([script, str(tmp_path)],
+                    LaunchConfig(nprocs=2, max_restarts=2))
+    assert result.exit_code == 0
+    assert result.restarts == 1
+    assert int(np.load(tmp_path / "state.npy")) == 10
+    # incarnation 1 resumed from the checkpoint, not from scratch
+    assert int((tmp_path / "done0_1").read_text()) >= 4
+
+
+def test_restart_budget_exhausted(tmp_path):
+    script = _write(tmp_path, "worker.py", "import os; os._exit(3)")
+    result = launch([script], LaunchConfig(nprocs=2, max_restarts=1))
+    assert result.exit_code == 3
+    assert result.restarts == 1
+
+
+def test_hang_detected_by_heartbeat(tmp_path):
+    """A worker that never heartbeats (deadlock stand-in) is detected
+    and the gang is restarted, even though no process exited."""
+    script = _write(tmp_path, "worker.py", """
+        import os, sys, time
+        from pytorch_distributed_nn_tpu.runtime import failure
+        rank = int(os.environ["RANK"])
+        incarnation = int(os.environ["TPUNN_RESTART"])
+        if rank == 1 and incarnation == 0:
+            time.sleep(600)  # hung: never connects, never beats
+        hb = failure.maybe_start_heartbeat()
+        assert hb is not None
+        time.sleep(0.5)
+        with open(f"{sys.argv[1]}/done{rank}_{incarnation}", "w") as f:
+            f.write("ok")
+        hb.stop()
+    """)
+    result = launch(
+        [script, str(tmp_path)],
+        LaunchConfig(nprocs=2, max_restarts=1, heartbeat_timeout_s=20.0,
+                     heartbeat_interval_s=0.2,
+                     env={"PYTHONPATH": os.path.dirname(os.path.dirname(
+                         os.path.abspath(__file__)))}),
+    )
+    assert result.exit_code == 0
+    assert result.restarts == 1
+    assert (tmp_path / "done0_1").exists()
+    assert (tmp_path / "done1_1").exists()
+
+
+def test_progress_watchdog_catches_live_but_stuck_worker(tmp_path):
+    """A worker whose heartbeat thread is alive but whose main thread
+    stops making progress (deadlocked-collective stand-in) must go
+    silent and get the gang restarted."""
+    script = _write(tmp_path, "worker.py", """
+        import os, sys, time
+        from pytorch_distributed_nn_tpu.runtime import failure
+        rank = int(os.environ["RANK"])
+        incarnation = int(os.environ["TPUNN_RESTART"])
+        hb = failure.maybe_start_heartbeat()
+        assert hb is not None and hb._window == 1.0
+        if rank == 1 and incarnation == 0:
+            failure.notify_progress()  # arm the watchdog (step 1 done)
+            time.sleep(600)  # "deadlock": daemon beats, no progress
+        for _ in range(5):
+            failure.notify_progress()
+            time.sleep(0.1)
+        with open(f"{sys.argv[1]}/done{rank}_{incarnation}", "w") as f:
+            f.write("ok")
+        hb.stop()
+    """)
+    result = launch(
+        [script, str(tmp_path)],
+        LaunchConfig(nprocs=2, max_restarts=1, heartbeat_timeout_s=15.0,
+                     heartbeat_interval_s=0.2, progress_timeout_s=1.0,
+                     env={"PYTHONPATH": os.path.dirname(os.path.dirname(
+                         os.path.abspath(__file__)))}),
+    )
+    assert result.exit_code == 0
+    assert result.restarts == 1
+    assert (tmp_path / "done0_1").exists()
+    assert (tmp_path / "done1_1").exists()
+
+
+def test_unarmed_watchdog_tolerates_long_first_step(tmp_path):
+    """Before the first notify_progress (think: first-step compile), the
+    watchdog must not arm — a long silent start is liveness-only, not a
+    hang, else every incarnation livelocks on the same compile wall."""
+    script = _write(tmp_path, "worker.py", """
+        import os, sys, time
+        from pytorch_distributed_nn_tpu.runtime import failure
+        hb = failure.maybe_start_heartbeat()
+        assert hb is not None
+        time.sleep(13)  # "compiling": no progress yet, well past window
+        failure.notify_progress()
+        with open(f"{sys.argv[1]}/done{os.environ['RANK']}", "w") as f:
+            f.write("ok")
+        hb.stop()
+    """)
+    result = launch(
+        [script, str(tmp_path)],
+        LaunchConfig(nprocs=2, max_restarts=1, heartbeat_timeout_s=10.0,
+                     heartbeat_interval_s=0.2, progress_timeout_s=1.0,
+                     env={"PYTHONPATH": os.path.dirname(os.path.dirname(
+                         os.path.abspath(__file__)))}),
+    )
+    assert result.exit_code == 0
+    assert result.restarts == 0
+    assert (tmp_path / "done0").exists() and (tmp_path / "done1").exists()
+
+
+def test_staggered_clean_finish_is_not_a_hang(tmp_path):
+    """A worker that exits 0 stops heartbeating; while its gang-mates
+    keep running past the timeout, that silence must not read as a
+    hang (the detector only judges still-running ranks)."""
+    script = _write(tmp_path, "worker.py", """
+        import os, sys, time
+        from pytorch_distributed_nn_tpu.runtime import failure
+        rank = int(os.environ["RANK"])
+        hb = failure.maybe_start_heartbeat()
+        assert hb is not None
+        if rank == 1:
+            time.sleep(12)  # keeps running well past the 8s timeout
+        with open(f"{sys.argv[1]}/done{rank}", "w") as f:
+            f.write("ok")
+        hb.stop()
+    """)
+    result = launch(
+        [script, str(tmp_path)],
+        LaunchConfig(nprocs=2, max_restarts=1, heartbeat_timeout_s=8.0,
+                     heartbeat_interval_s=0.2,
+                     env={"PYTHONPATH": os.path.dirname(os.path.dirname(
+                         os.path.abspath(__file__)))}),
+    )
+    assert result.exit_code == 0
+    assert result.restarts == 0  # no spurious restart
+    assert (tmp_path / "done0").exists() and (tmp_path / "done1").exists()
+
+
+def test_cli_entrypoint(tmp_path):
+    import subprocess
+
+    script = _write(tmp_path, "worker.py", """
+        import os, sys
+        open(sys.argv[1] + "/r" + os.environ["RANK"], "w").close()
+    """)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [sys.executable, "-m", "pytorch_distributed_nn_tpu.launch",
+         "--nprocs", "2", "--", script, str(tmp_path)],
+        cwd=repo, capture_output=True, text=True, timeout=120,
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert (tmp_path / "r0").exists() and (tmp_path / "r1").exists()

@@ -1,0 +1,79 @@
+"""Multi-process-without-a-cluster harness (SURVEY.md §4).
+
+The reference's analogue is c10d tests spawning N local processes with
+``torch.multiprocessing.spawn`` + gloo. Here: the elastic agent launches
+a 2-process gang; each worker runs ``jax.distributed.initialize`` via
+:mod:`runtime.bootstrap` (localhost coordinator), forces the CPU
+platform with 1 device per process, and executes a jitted ``psum``
+across the *global* 2-device mesh — a real cross-process XLA collective,
+no TPU required.
+"""
+
+import os
+import sys
+import textwrap
+
+import pytest
+
+from pytorch_distributed_nn_tpu.launch import LaunchConfig, launch
+from pytorch_distributed_nn_tpu.runtime import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native store not built"
+)
+
+WORKER = """
+    import sys
+
+    import jax
+    # One CPU device per process (the ambient env pins a TPU platform;
+    # config wins as long as no backend is initialized yet).
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 1)
+
+    import numpy as np
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from pytorch_distributed_nn_tpu.runtime import bootstrap
+
+    info = bootstrap.initialize()
+    assert info.process_count == 2, info
+    assert jax.device_count() == 2, jax.devices()
+    assert jax.local_device_count() == 1
+
+    mesh = jax.make_mesh((2,), ("data",))
+    sharding = NamedSharding(mesh, P("data"))
+    local = np.array([float(info.process_index + 1)], np.float32)
+    x = jax.make_array_from_single_device_arrays(
+        (2,), sharding,
+        [jax.device_put(local, jax.local_devices()[0])],
+    )
+
+    @jax.jit
+    def total(x):
+        return jax.shard_map(
+            lambda v: jax.lax.psum(v, "data"),
+            mesh=mesh, in_specs=P("data"), out_specs=P(),
+        )(x)
+
+    out = total(x)
+    got = float(np.asarray(out.addressable_data(0)))
+    assert got == 3.0, got  # (rank0+1) + (rank1+1)
+
+    with open(f"{sys.argv[1]}/ok{info.process_index}", "w") as f:
+        f.write(str(got))
+    bootstrap.shutdown()
+"""
+
+
+def test_two_process_psum(tmp_path):
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(WORKER))
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    result = launch(
+        [str(script), str(tmp_path)],
+        LaunchConfig(nprocs=2, env={"PYTHONPATH": repo}),
+    )
+    assert result.exit_code == 0
+    assert (tmp_path / "ok0").read_text() == "3.0"
+    assert (tmp_path / "ok1").read_text() == "3.0"
