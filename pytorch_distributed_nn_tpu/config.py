@@ -59,7 +59,10 @@ class ParallelConfig:
     overlap: bool = True
     zero_stage: int = 3  # 1 = optimizer-state shard; 3 = params too
     microbatches: int = 1  # pipeline microbatching
-    pipeline_schedule: str = "gpipe"  # gpipe | 1f1b
+    # Only "gpipe" exists: the backward schedule is AD-derived (the scan
+    # transpose IS the reverse fill-drain), so a manually interleaved
+    # 1F1B would be a different construction, not a flag.
+    pipeline_schedule: str = "gpipe"
     quantized_allreduce: str = ""  # "" | "bf16" | "int8" (EQuARX-style)
 
 
@@ -69,7 +72,8 @@ class TrainConfig:
     seed: int = 0
     steps: int = 100
     log_every: int = 10
-    eval_every: int = 0
+    eval_every: int = 0  # 0 = no eval; else eval every N steps
+    eval_batches: int = 8  # batches per eval pass (held-out seed stream)
     checkpoint_dir: str = ""
     checkpoint_every: int = 0
     resume: bool = True

@@ -184,6 +184,12 @@ def make_pipeline_train_step(cfg: TrainConfig, mesh: Mesh,
     M = max(cfg.parallel.microbatches, 1)
     if S < 2:
         raise ValueError("pipeline strategy needs mesh.pipe >= 2")
+    if cfg.parallel.pipeline_schedule != "gpipe":
+        raise ValueError(
+            f"unknown pipeline_schedule "
+            f"{cfg.parallel.pipeline_schedule!r}; only 'gpipe' exists "
+            "(the backward fill-drain is AD-derived from the forward scan)"
+        )
     if getattr(model, "dropout", 0.0):
         raise ValueError(
             "pipeline strategy does not support dropout yet; set "

@@ -36,8 +36,30 @@ class StepRecord:
     seconds: float
 
 
+@dataclasses.dataclass
+class EvalRecord:
+    step: int
+    loss: float
+    accuracy: float
+
+
+# Eval batches come from the SAME (seed, step)-keyed generator as
+# training — same class templates / token process, i.e. the same task —
+# but from a step range training can never reach, so the samples are
+# held out. (A different *seed* would change the templates themselves:
+# a different task, on which no trained model can score.)
+_EVAL_STEP_OFFSET = 1 << 30
+
+
 class Trainer:
     def __init__(self, cfg: TrainConfig, mesh=None) -> None:
+        if cfg.eval_every and cfg.parallel.strategy == "pipeline":
+            # knowable from cfg alone: fail at construction, not at the
+            # first eval tick mid-run
+            raise ValueError(
+                "eval_every is not supported under the pipeline strategy "
+                "(stage params are stacked); evaluate with strategy='dp'"
+            )
         self.cfg = cfg
         self.mesh = mesh if mesh is not None else make_mesh(
             cfg.mesh.resolve(len(jax.devices()))
@@ -59,6 +81,8 @@ class Trainer:
         self.step_fn = step_fn
         self.state = place_fn(self.state)
         self.history: list[StepRecord] = []
+        self.eval_history: list[EvalRecord] = []
+        self._eval_step = None  # built lazily on first evaluate()
         self.data_step = 0  # next dataset step to consume (resume-aware)
         self.ckpt = None
         if cfg.checkpoint_dir:
@@ -119,6 +143,8 @@ class Trainer:
             if (self.ckpt is not None and cfg.checkpoint_every
                     and g % cfg.checkpoint_every == 0):
                 self.ckpt.save(self.state, data_step=self.data_step)
+            if cfg.eval_every and g % cfg.eval_every == 0:
+                self.evaluate()
             if cfg.log_every and ((g - 1) % cfg.log_every == 0
                                   or i == steps - 1):
                 loss = float(jax.device_get(metrics["loss"]))
@@ -136,6 +162,62 @@ class Trainer:
         # liveness-only heartbeats so it can't read as a hang.
         failure.notify_done()
         return self.history
+
+    def _build_eval(self) -> None:
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        if cfg.parallel.strategy == "pipeline":
+            raise RuntimeError(
+                "evaluate() is not supported under the pipeline strategy "
+                "(stage params are stacked); evaluate with strategy='dp' "
+                "on unstacked params instead"
+            )
+        from pytorch_distributed_nn_tpu.parallel.dp import forward
+
+        loss_fn = self.loss_fn
+
+        def eval_step(state, x, y):
+            # dp.forward is the one place that knows how to assemble
+            # variables/mutable collections; eval must not fork it
+            logits, _, _ = forward(state, state.params, x, train=False)
+            loss = loss_fn(logits, y)
+            # masked accuracy: labels < 0 mean "ignore" (BERT MLM)
+            valid = y >= 0
+            hit = jnp.logical_and(logits.argmax(-1) == y, valid)
+            acc = hit.sum() / jnp.maximum(valid.sum(), 1)
+            return loss.astype(jnp.float32), acc.astype(jnp.float32)
+
+        self._eval_step = jax.jit(eval_step)
+
+    def evaluate(self, num_batches: int | None = None) -> EvalRecord:
+        """Forward-only pass over the held-out stream; returns (and
+        records) mean loss and masked accuracy. ``EvalRecord.step`` uses
+        the same 0-based convention as ``StepRecord`` (-1 = before any
+        training)."""
+        n = self.cfg.eval_batches if num_batches is None else num_batches
+        if n <= 0:
+            raise ValueError(f"evaluate needs >= 1 batches, got {n}")
+        # Disarm the progress watchdog across the (unbounded) eval-step
+        # compile; per-batch completions below re-arm and feed it.
+        failure.notify_done()
+        if self._eval_step is None:
+            self._build_eval()
+        losses, accs = [], []
+        for i in range(n):
+            x, y = self.loader.batch_at(_EVAL_STEP_OFFSET + i)
+            loss, acc = self._eval_step(self.state, x, y)
+            losses.append(float(jax.device_get(loss)))
+            accs.append(float(jax.device_get(acc)))
+            failure.notify_progress()  # eval batches are progress too
+        rec = EvalRecord(step=self.data_step - 1,
+                         loss=float(np.mean(losses)),
+                         accuracy=float(np.mean(accs)))
+        self.eval_history.append(rec)
+        if jax.process_index() == 0:
+            log.info("eval @ step %d: loss %.4f acc %.4f",
+                     rec.step, rec.loss, rec.accuracy)
+        return rec
 
     def save_checkpoint(self, *, force: bool = True) -> bool:
         if self.ckpt is None:
