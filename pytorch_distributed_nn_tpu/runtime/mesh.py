@@ -100,30 +100,93 @@ class MeshSpec:
         return math.prod(self.shape)
 
 
+def slice_count(devices: Sequence[jax.Device]) -> int:
+    """Number of distinct TPU slices (pods connected by DCN) among
+    ``devices``. CPU/single-slice devices report 1."""
+    ids = set()
+    for d in devices:
+        idx = getattr(d, "slice_index", None)
+        ids.add(0 if idx is None else idx)
+    return max(len(ids), 1)
+
+
+def dcn_factors(spec: MeshSpec, n_slices: int) -> dict[str, int]:
+    """Split each logical axis into (DCN, ICI) degrees for a multi-slice
+    job: the product of the returned per-axis DCN factors equals
+    ``n_slices``, and factors are peeled onto the outermost axes first
+    (``pipe``, then ``data``, …) — those tolerate DCN bandwidth, while
+    inner axes (tensor/seq/fsdp) want to stay inside a slice on ICI.
+
+    Raises when the slice count cannot be factored onto the mesh at
+    all; when the only possible placement puts a factor on an
+    ICI-hungry inner axis (e.g. tensor parallelism wider than a slice),
+    the mesh still builds but a warning flags the bandwidth hit.
+    """
+    sizes = spec.sizes()
+    remaining = n_slices
+    factors = {name: 1 for name in AXES}
+    for name in AXES:  # outermost first
+        f = math.gcd(sizes[name], remaining)
+        factors[name] = f
+        remaining //= f
+        if remaining == 1:
+            break
+    if remaining != 1:
+        raise ValueError(
+            f"cannot place {n_slices} slices on mesh {sizes}: outer-axis "
+            f"sizes don't factor the slice count (residual {remaining})"
+        )
+    dcn_inner = {k: v for k, v in factors.items()
+                 if v > 1 and k in (AXIS_FSDP, AXIS_EXPERT, AXIS_SEQ,
+                                    AXIS_TENSOR)}
+    if dcn_inner:
+        logging.getLogger(__name__).warning(
+            "DCN factors landed on ICI-hungry axes %s — expect degraded "
+            "collective bandwidth; prefer putting pipe/data across slices",
+            dcn_inner,
+        )
+    return factors
+
+
 def make_mesh(
     spec: MeshSpec | None = None,
     devices: Sequence[jax.Device] | None = None,
 ) -> Mesh:
     """Build a named Mesh over ``devices`` (default: all).
 
-    Uses ``jax.experimental.mesh_utils`` device assignment when available so
-    inner axes land on physically adjacent chips (ICI rings); falls back to
-    row-major reshape (fine for CPU test meshes).
+    Single slice: ``mesh_utils.create_device_mesh`` assignment so inner
+    axes land on physically adjacent chips (ICI rings). Multi-slice
+    (devices spanning DCN): ``create_hybrid_device_mesh`` with the DCN
+    degrees peeled onto the outermost axes (:func:`dcn_factors`), so
+    cross-slice traffic is only pipe edges / DP gradient allreduce.
+    Falls back to row-major reshape (fine for CPU test meshes).
     """
     if devices is None:
         devices = jax.devices()
     spec = (spec or MeshSpec()).resolve(len(devices))
+    n_slices = slice_count(devices)
+    if n_slices > 1:
+        # Outside the try: an unplaceable multi-slice spec must raise,
+        # not silently fall back to slice-unaware row-major placement.
+        dcn = dcn_factors(spec, n_slices)
+        ici_shape = tuple(s // dcn[a] for a, s in zip(AXES, spec.shape))
     try:
         from jax.experimental import mesh_utils
 
-        dev_array = mesh_utils.create_device_mesh(
-            spec.shape, devices=list(devices)
-        )
+        if n_slices > 1:
+            dev_array = mesh_utils.create_hybrid_device_mesh(
+                ici_shape, tuple(dcn[a] for a in AXES),
+                devices=list(devices),
+            )
+        else:
+            dev_array = mesh_utils.create_device_mesh(
+                spec.shape, devices=list(devices)
+            )
     except ImportError:
         dev_array = np.asarray(devices, dtype=object).reshape(spec.shape)
     except Exception as e:  # topology assigner rejected the shape
         logging.getLogger(__name__).warning(
-            "mesh_utils.create_device_mesh failed (%s); falling back to "
+            "mesh_utils device assignment failed (%s); falling back to "
             "row-major placement — inner axes may not be ICI-adjacent", e
         )
         dev_array = np.asarray(devices, dtype=object).reshape(spec.shape)

@@ -83,6 +83,7 @@ class Trainer:
         self.history: list[StepRecord] = []
         self.eval_history: list[EvalRecord] = []
         self._eval_step = None  # built lazily on first evaluate()
+        self._eval_batches: dict[int, tuple] = {}  # device-resident cache
         self.data_step = 0  # next dataset step to consume (resume-aware)
         self.ckpt = None
         if cfg.checkpoint_dir:
@@ -205,7 +206,13 @@ class Trainer:
             self._build_eval()
         losses, accs = [], []
         for i in range(n):
-            x, y = self.loader.batch_at(_EVAL_STEP_OFFSET + i)
+            if i not in self._eval_batches:
+                # the stream is deterministic, so each batch is generated
+                # and transferred once and reused by every eval pass
+                self._eval_batches[i] = self.loader.batch_at(
+                    _EVAL_STEP_OFFSET + i
+                )
+            x, y = self._eval_batches[i]
             loss, acc = self._eval_step(self.state, x, y)
             losses.append(float(jax.device_get(loss)))
             accs.append(float(jax.device_get(acc)))

@@ -61,3 +61,47 @@ def test_sharded_array_roundtrip(mesh8):
     gx = jax.device_put(x, sharding)
     assert gx.sharding.is_equivalent_to(sharding, ndim=2)
     np.testing.assert_array_equal(np.asarray(gx), x)
+
+
+def test_slice_count_cpu_is_one(devices):
+    from pytorch_distributed_nn_tpu.runtime.mesh import slice_count
+
+    assert slice_count(devices) == 1
+
+
+def test_dcn_factors_peel_outer_axes_first():
+    from pytorch_distributed_nn_tpu.runtime.mesh import MeshSpec, dcn_factors
+
+    # 2 slices over a pure-DP mesh: data axis carries DCN
+    f = dcn_factors(MeshSpec(data=16).resolve(16), 2)
+    assert f["data"] == 2 and all(v == 1 for k, v in f.items() if k != "data")
+
+    # pipe outermost wins when it can host the slices
+    f = dcn_factors(MeshSpec(pipe=4, data=8).resolve(32), 4)
+    assert f["pipe"] == 4 and f["data"] == 1
+
+    # slices spill pipe -> data when pipe alone is too small
+    f = dcn_factors(MeshSpec(pipe=2, data=8).resolve(16), 4)
+    assert f["pipe"] == 2 and f["data"] == 2
+
+
+def test_dcn_factors_reject_unplaceable():
+    import pytest
+
+    from pytorch_distributed_nn_tpu.runtime.mesh import MeshSpec, dcn_factors
+
+    # 3 slices cannot factor into power-of-two outer axes
+    with pytest.raises(ValueError, match="slices"):
+        dcn_factors(MeshSpec(data=8).resolve(8), 3)
+
+
+def test_dcn_factors_warn_on_inner_axis(caplog):
+    import logging
+
+    from pytorch_distributed_nn_tpu.runtime.mesh import MeshSpec, dcn_factors
+
+    # only tensor can host the slices -> factors land there, with a warning
+    with caplog.at_level(logging.WARNING):
+        f = dcn_factors(MeshSpec(data=1, tensor=8).resolve(8), 2)
+    assert f["tensor"] == 2
+    assert any("ICI-hungry" in r.message for r in caplog.records)
