@@ -41,7 +41,7 @@ class LlamaBlock(nn.Module):
     num_kv_heads: int
     mlp_dim: int
     rope_theta: float = 500000.0
-    attn_impl: str = "xla"
+    attn_impl: str = "auto"
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
 
@@ -79,7 +79,7 @@ class Llama(nn.Module):
     mlp_dim: int = 14336
     rope_theta: float = 500000.0
     remat: bool = False
-    attn_impl: str = "xla"
+    attn_impl: str = "auto"
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
 
@@ -116,7 +116,7 @@ def build_llama3_8b(cfg: ModelConfig) -> Llama:
         mlp_dim=e.get("mlp_dim", 14336),
         rope_theta=e.get("rope_theta", 500000.0),
         remat=cfg.remat,
-        attn_impl=e.get("attn_impl", "xla"),
+        attn_impl=e.get("attn_impl", "auto"),
         dtype=policy.compute_dtype,
         param_dtype=policy.param_dtype,
     )

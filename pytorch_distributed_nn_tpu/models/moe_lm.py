@@ -64,7 +64,7 @@ def build_moe_lm(cfg: ModelConfig) -> MoETransformerLM:
         max_len=e.get("max_len", 2048),
         dropout=e.get("dropout", 0.0),
         remat=cfg.remat,
-        attn_impl=e.get("attn_impl", "xla"),
+        attn_impl=e.get("attn_impl", "auto"),
         dtype=policy.compute_dtype,
         param_dtype=policy.param_dtype,
     )

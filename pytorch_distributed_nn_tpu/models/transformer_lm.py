@@ -24,7 +24,7 @@ class DecoderBlock(nn.Module):
     num_heads: int
     mlp_dim: int
     dropout: float = 0.0
-    attn_impl: str = "xla"
+    attn_impl: str = "auto"
     # FFN override hook: (block, y, train) -> y, creating its submodules in
     # the block's scope. None = the standard dense MLP. This is how the MoE
     # family (models/moe_lm.py) swaps in expert layers without duplicating
@@ -70,7 +70,7 @@ class TransformerLM(nn.Module):
     max_len: int = 2048
     dropout: float = 0.0
     remat: bool = False
-    attn_impl: str = "xla"
+    attn_impl: str = "auto"
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
 
@@ -128,7 +128,7 @@ def build_transformer_lm(cfg: ModelConfig) -> TransformerLM:
         max_len=e.get("max_len", 2048),
         dropout=e.get("dropout", 0.0),
         remat=cfg.remat,
-        attn_impl=e.get("attn_impl", "xla"),
+        attn_impl=e.get("attn_impl", "auto"),
         dtype=policy.compute_dtype,
         param_dtype=policy.param_dtype,
     )

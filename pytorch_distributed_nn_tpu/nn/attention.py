@@ -47,7 +47,19 @@ def dot_product_attention(
 
     Returns (B, T, H, D). f32 softmax accumulation regardless of input
     dtype (MXU-friendly: bf16 operands, f32 accumulate).
+
+    impl: 'xla' (fused by the compiler; required for padding masks and
+    cross-length kv), 'flash' (Pallas blockwise kernel on TPU with
+    blockwise-recompute backward), or 'auto'. Measured on v5e
+    (llama-shaped blocks, fwd+bwd): xla wins at T=1k (19.9 vs 20.4 ms),
+    flash from T=2k up (1.17x at 2k, 1.7x at 4k, 15.6x at 8k where
+    xla's (T, T) scores thrash HBM) — so 'auto' picks flash on TPU for
+    self-attention at T >= 2048 with no padding mask.
     """
+    if impl == "auto":
+        impl = ("flash" if jax.default_backend() == "tpu"
+                and mask is None and q.shape[1] >= 2048
+                and k.shape[1] == q.shape[1] else "xla")
     if impl not in ("xla", "flash"):
         raise ValueError(f"unknown attention impl {impl!r}")
     B, T, H, D = q.shape

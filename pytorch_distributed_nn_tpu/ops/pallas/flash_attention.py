@@ -114,16 +114,102 @@ def _flash_bhtd(q, k, v, *, causal: bool, block_q: int, block_k: int):
     )(q, k, v)
 
 
+def _flash_bwd_blockwise(q, k, v, o, g, *, causal: bool,
+                         block_q: int = 128):
+    """Flash-attention backward, blockwise over Q: the standard
+    recompute recurrence (dv = pᵀ·dO; ds = p∘(dO·vᵀ − Δ); dq = ds·k;
+    dk = dsᵀ·q with Δ = rowsum(dO∘O)) as a ``lax.scan`` over Q blocks.
+    Peak live memory is O(block_q × T) per (B·H) slice — never the
+    (T, T) score matrix. Inputs (BH, T, D); returns (dq, dk, dv) in the
+    input dtypes. Pure jnp, so it runs (and is tested) on CPU."""
+    BH, T, D = q.shape
+    scale = D ** -0.5
+    qf = q.astype(jnp.float32)
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    delta = jnp.sum(o.astype(jnp.float32) * g.astype(jnp.float32), -1)
+
+    nb = T // block_q
+
+    def body(carry, i):
+        dk, dv = carry
+        row = i * block_q
+        qb = jax.lax.dynamic_slice_in_dim(qf, row, block_q, 1)
+        gb = jax.lax.dynamic_slice_in_dim(
+            g.astype(jnp.float32), row, block_q, 1
+        )
+        db = jax.lax.dynamic_slice_in_dim(delta, row, block_q, 1)
+        s = jnp.einsum("btd,bsd->bts", qb, kf,
+                       preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = row + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, T), 0
+            )
+            k_pos = jax.lax.broadcasted_iota(jnp.int32, (block_q, T), 1)
+            s = jnp.where((q_pos >= k_pos)[None], s, NEG_INF)
+        m = s.max(-1, keepdims=True)
+        p = jnp.exp(s - m)
+        p = p / p.sum(-1, keepdims=True)  # (BH, block_q, T)
+        dv = dv + jnp.einsum("bts,btd->bsd", p, gb,
+                             preferred_element_type=jnp.float32)
+        dp = jnp.einsum("btd,bsd->bts", gb, vf,
+                        preferred_element_type=jnp.float32)
+        ds = p * (dp - db[..., None]) * scale
+        dqb = jnp.einsum("bts,bsd->btd", ds, kf,
+                         preferred_element_type=jnp.float32)
+        dk = dk + jnp.einsum("bts,btd->bsd", ds, qb,
+                             preferred_element_type=jnp.float32)
+        return (dk, dv), dqb
+
+    dk0 = jnp.zeros_like(kf)
+    dv0 = jnp.zeros_like(vf)
+    (dk, dv), dq_blocks = jax.lax.scan(body, (dk0, dv0), jnp.arange(nb))
+    # (nb, BH, block_q, D) -> (BH, T, D)
+    dq = dq_blocks.transpose(1, 0, 2, 3).reshape(BH, T, D)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def _flash_diff(qb, kb, vb, causal, block_q, block_k):
+    """Differentiable wrapper: Pallas forward, blockwise-recompute
+    backward (:func:`_flash_bwd_blockwise`) — neither direction ever
+    materializes the (T, T) score matrix, and AD never touches the
+    pallas_call."""
+    return _flash_bhtd(qb, kb, vb, causal=causal, block_q=block_q,
+                       block_k=block_k)
+
+
+def _flash_diff_fwd(qb, kb, vb, causal, block_q, block_k):
+    out = _flash_bhtd(qb, kb, vb, causal=causal, block_q=block_q,
+                      block_k=block_k)
+    return out, (qb, kb, vb, out)
+
+
+def _flash_diff_bwd(causal, block_q, block_k, res, g):
+    qb, kb, vb, out = res
+    return _flash_bwd_blockwise(qb, kb, vb, out, g, causal=causal,
+                                block_q=block_q)
+
+
+_flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
+
+
 def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 128,
                     block_k: int = 128):
     """(B, T, H, D) attention. KV heads must already be expanded to match
     Q heads (the caller handles GQA). Falls back to the jnp reference off
-    TPU."""
+    TPU. Differentiable: backward is flash-style recompute through the
+    jnp schedule."""
     B, T, H, D = q.shape
     if k.shape[2] != H:
         raise ValueError(
             f"flash_attention expects expanded kv heads ({k.shape[2]} vs "
             f"{H}); repeat kv before calling"
+        )
+    if k.shape[1] != T:
+        raise ValueError(
+            f"flash_attention is self-attention only (kv len "
+            f"{k.shape[1]} != q len {T}); use impl='xla' for cross-length"
         )
 
     def to_bh(x):
@@ -140,6 +226,5 @@ def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 128,
     if T % block_q or T % block_k:
         return from_bh(_attention_reference(qb, kb, vb, causal=causal))
     return from_bh(
-        _flash_bhtd(qb, kb, vb, causal=causal, block_q=block_q,
-                    block_k=block_k)
+        _flash_diff(qb, kb, vb, causal, block_q, block_k)
     )

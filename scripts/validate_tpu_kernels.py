@@ -45,6 +45,39 @@ def check_flash() -> bool:
     return ok
 
 
+def check_flash_grad() -> bool:
+    """Gradients through the full custom_vjp path (Pallas forward +
+    blockwise recompute backward) vs autodiff of the dense reference."""
+    ok = True
+    rng = np.random.RandomState(4)
+    B, T, H, D = 2, 512, 4, 64
+    q = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32)) * 0.3
+    k = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32)) * 0.3
+    v = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+
+    to_bh = lambda x: x.transpose(0, 2, 1, 3).reshape(B * H, T, D)  # noqa: E731
+
+    for causal in (True, False):
+        def f_flash(q, k, v):
+            return (flash_attention(q, k, v, causal=causal)
+                    .astype(jnp.float32).sum())
+
+        def f_ref(q, k, v):
+            return (_attention_reference(
+                to_bh(q), to_bh(k), to_bh(v), causal=causal,
+            ).astype(jnp.float32).sum())
+
+        got = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+        want = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+        for gg, ww, name in zip(got, want, ("dq", "dk", "dv")):
+            err = float(jnp.abs(gg - ww).max())
+            line_ok = err < 2e-2
+            ok &= line_ok
+            print(f"flash-grad {name} causal={causal}: max_err={err:.2e} "
+                  f"{'OK' if line_ok else 'FAIL'}")
+    return ok
+
+
 def check_quantize() -> bool:
     rng = np.random.RandomState(1)
     x = rng.randn(8, 1024).astype(np.float32)
@@ -108,7 +141,8 @@ def main() -> int:
     print(f"backend: {jax.default_backend()} devices: {jax.devices()}")
     if jax.default_backend() != "tpu":
         print("WARNING: not on TPU — validating fallbacks only")
-    ok = check_flash() & check_quantize() & check_ring_block()
+    ok = (check_flash() & check_flash_grad() & check_quantize()
+          & check_ring_block())
     print("ALL OK" if ok else "FAILURES")
     return 0 if ok else 1
 
