@@ -29,6 +29,7 @@ NOMINAL = {
     "transformer_lm_pp": None,
     "llama3_8b_zero": None,
     "moe_lm_ep": None,
+    "llama3_longcontext": None,
 }
 
 # Per-chip batch sizes tuned for one v5e chip (16 GB HBM).
@@ -39,6 +40,7 @@ PER_CHIP_BATCH = {
     "transformer_lm_pp": 8,
     "llama3_8b_zero": 1,
     "moe_lm_ep": 8,
+    "llama3_longcontext": 1,  # 32k tokens per sample
 }
 
 

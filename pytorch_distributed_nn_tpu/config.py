@@ -209,6 +209,31 @@ def _llama3_8b_zero() -> TrainConfig:
     )
 
 
+def _llama3_longcontext() -> TrainConfig:
+    # Beyond the reference (SURVEY.md §5 "Long-context" row): 32k-token
+    # causal-LM training. Single chip: Pallas flash attention (blockwise
+    # fwd + bwd, never materializing the (T, T) scores) + remat; on a
+    # pod, add mesh.seq for ring-attention context parallelism.
+    return TrainConfig(
+        preset="llama3_longcontext",
+        steps=10,
+        mesh=MeshSpec(seq=1, data=-1),
+        optim=OptimConfig(name="adamw", lr=1e-4, weight_decay=0.1,
+                          grad_clip_norm=1.0, warmup_steps=2,
+                          schedule="cosine"),
+        # vocab 8k, not 128k: at T=32k the (T, vocab) logits + grads are
+        # the HBM limiter, and vocabulary size is orthogonal to what
+        # this preset measures (long-context attention throughput)
+        data=DataConfig(dataset="lm_synthetic", batch_size=1,
+                        seq_len=32768, vocab_size=8192),
+        model=ModelConfig(name="llama3_8b", remat=True,
+                          extra=dict(num_layers=8, d_model=1024,
+                                     num_heads=16, num_kv_heads=8,
+                                     mlp_dim=3584, vocab_size=8192)),
+        parallel=ParallelConfig(strategy="dp"),
+    )
+
+
 def _moe_lm_ep() -> TrainConfig:
     # Beyond the reference (SURVEY.md §2c EP row): mixture-of-experts LM,
     # experts sharded over the `expert` mesh axis, token dispatch via the
@@ -228,6 +253,7 @@ def _moe_lm_ep() -> TrainConfig:
 PRESETS = {
     "mlp_mnist": _mlp_mnist,
     "moe_lm_ep": _moe_lm_ep,
+    "llama3_longcontext": _llama3_longcontext,
     "resnet50_dp": _resnet50_dp,
     "bert_base_buckets": _bert_base_buckets,
     "transformer_lm_pp": _transformer_lm_pp,
