@@ -1,0 +1,4 @@
+from pytorch_distributed_nn_tpu.inference.generate import (  # noqa: F401
+    generate,
+    init_cache,
+)

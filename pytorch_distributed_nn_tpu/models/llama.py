@@ -46,7 +46,7 @@ class LlamaBlock(nn.Module):
     param_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x, train: bool = False):
+    def __call__(self, x, train: bool = False, decode: bool = False):
         d = x.shape[-1]
         y = RMSNorm(dtype=self.dtype, param_dtype=self.param_dtype,
                     name="attn_norm")(x)
@@ -56,7 +56,7 @@ class LlamaBlock(nn.Module):
             rope_theta=self.rope_theta, impl=self.attn_impl,
             use_bias=False, dtype=self.dtype,
             param_dtype=self.param_dtype, name="attn",
-        )(y)
+        )(y, decode=decode)
         x = x + y
         y = RMSNorm(dtype=self.dtype, param_dtype=self.param_dtype,
                     name="mlp_norm")(x)
@@ -84,11 +84,12 @@ class Llama(nn.Module):
     param_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, tokens, *, train: bool = False):
+    def __call__(self, tokens, *, train: bool = False,
+                 decode: bool = False):
         x = nn.Embed(self.vocab_size, self.d_model,
                      param_dtype=self.param_dtype,
                      name="tok_embed")(tokens).astype(self.dtype)
-        block_cls = (nn.remat(LlamaBlock, static_argnums=(2,))
+        block_cls = (nn.remat(LlamaBlock, static_argnums=(2, 3))
                      if self.remat else LlamaBlock)
         for i in range(self.num_layers):
             x = block_cls(
@@ -96,7 +97,7 @@ class Llama(nn.Module):
                 mlp_dim=self.mlp_dim, rope_theta=self.rope_theta,
                 attn_impl=self.attn_impl, dtype=self.dtype,
                 param_dtype=self.param_dtype, name=f"layer{i}",
-            )(x, train)
+            )(x, train, decode)
         x = RMSNorm(dtype=self.dtype, param_dtype=self.param_dtype,
                     name="final_norm")(x)
         return nn.Dense(self.vocab_size, use_bias=False, dtype=jnp.float32,

@@ -84,8 +84,11 @@ def dot_product_attention(
     if causal:
         causal_mask = jnp.tril(jnp.ones((T, S), dtype=bool))
         logits = jnp.where(causal_mask[None, None], logits, -1e30)
-    if mask is not None:  # (B, S) padding mask
-        logits = jnp.where(mask[:, None, None, :], logits, -1e30)
+    if mask is not None:
+        if mask.ndim == 2:  # (B, S) padding mask
+            logits = jnp.where(mask[:, None, None, :], logits, -1e30)
+        else:  # (B|1, T, S) position mask (decode: causal-by-index)
+            logits = jnp.where(mask[:, None, :, :], logits, -1e30)
     probs = jax.nn.softmax(logits, axis=-1).astype(q.dtype)
     return jnp.einsum("bhts,bshd->bthd", probs, v,
                       preferred_element_type=jnp.float32).astype(q.dtype)
@@ -104,7 +107,15 @@ class MultiHeadAttention(nn.Module):
     use_bias: bool = True
 
     @nn.compact
-    def __call__(self, x, mask: Optional[jax.Array] = None):
+    def __call__(self, x, mask: Optional[jax.Array] = None,
+                 decode: bool = False):
+        """``decode=True`` enables the autoregressive KV cache (flax
+        "cache" collection): initialize by calling ``model.init`` with a
+        (B, max_len) input and ``decode=True`` — that sizes the cache —
+        then apply with ``mutable=["cache"]`` feeding (B, 1) (or a
+        (B, P) prefill chunk); keys/values land at ``cache_index``,
+        rotary positions are absolute, and attention masks to the
+        filled prefix. Causal-only (the cache is a running prefix)."""
         kv_heads = self.num_kv_heads or self.num_heads
         dense = lambda heads, name: nn.DenseGeneral(  # noqa: E731
             (heads, self.head_dim), axis=-1, name=name, dtype=self.dtype,
@@ -113,11 +124,62 @@ class MultiHeadAttention(nn.Module):
         q = dense(self.num_heads, "query")(x)
         k = dense(kv_heads, "key")(x)
         v = dense(kv_heads, "value")(x)
-        if self.rotary:
-            q, k = rotary_embedding(q, k, theta=self.rope_theta)
-            q, k = q.astype(self.dtype), k.astype(self.dtype)
-        out = dot_product_attention(q, k, v, causal=self.causal,
-                                    impl=self.impl, mask=mask)
+        if decode and not self.causal:
+            raise ValueError("decode cache requires causal attention")
+        if decode and mask is not None:
+            raise ValueError(
+                "decode mode ignores padding masks; strip padding (or "
+                "left-trim) before prefill"
+            )
+        if decode:
+            B, T = x.shape[0], x.shape[1]
+            init_k = nn.initializers.zeros
+            cached_k = self.variable(
+                "cache", "cached_key", init_k, None,
+                (B, T, kv_heads, self.head_dim), k.dtype,
+            )
+            cached_v = self.variable(
+                "cache", "cached_value", init_k, None,
+                (B, T, kv_heads, self.head_dim), v.dtype,
+            )
+            cache_index = self.variable(
+                "cache", "cache_index",
+                lambda: jnp.zeros((), jnp.int32),
+            )
+            if self.is_initializing():
+                # init only sizes the cache from the (B, max_len) input;
+                # the out-projection just needs a correctly-shaped
+                # activation, so skip the attention math entirely
+                out = jnp.zeros_like(q)
+            else:
+                S = cached_k.value.shape[1]
+                idx = cache_index.value
+                positions = idx + jnp.arange(T)[None]  # absolute
+                if self.rotary:
+                    q, k = rotary_embedding(q, k, theta=self.rope_theta,
+                                            positions=positions)
+                    q, k = q.astype(self.dtype), k.astype(self.dtype)
+                cached_k.value = jax.lax.dynamic_update_slice(
+                    cached_k.value, k, (0, idx, 0, 0)
+                )
+                cached_v.value = jax.lax.dynamic_update_slice(
+                    cached_v.value, v, (0, idx, 0, 0)
+                )
+                cache_index.value = idx + T
+                # attend to the filled prefix: k_pos <= this row's q_pos
+                k_pos = jnp.arange(S)[None, None, :]
+                q_pos = positions[:, :, None]
+                pos_mask = k_pos <= q_pos  # (1, T, S)
+                out = dot_product_attention(
+                    q, cached_k.value, cached_v.value, causal=False,
+                    impl="xla", mask=pos_mask,
+                )
+        else:
+            if self.rotary:
+                q, k = rotary_embedding(q, k, theta=self.rope_theta)
+                q, k = q.astype(self.dtype), k.astype(self.dtype)
+            out = dot_product_attention(q, k, v, causal=self.causal,
+                                        impl=self.impl, mask=mask)
         return nn.DenseGeneral(
             x.shape[-1], axis=(-2, -1), name="out", dtype=self.dtype,
             param_dtype=self.param_dtype, use_bias=self.use_bias,
