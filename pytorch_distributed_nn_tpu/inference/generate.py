@@ -36,13 +36,18 @@ def init_cache(model, batch_size: int, max_len: int):
     runs (an 8B model would otherwise allocate and discard the full
     param set here on every generate() call).
     """
-    shapes = jax.eval_shape(
-        lambda: model.init(
-            jax.random.key(0),
-            jnp.zeros((batch_size, max_len), jnp.int32),
-            train=False, decode=True,
+    try:
+        shapes = jax.eval_shape(
+            lambda: model.init(
+                jax.random.key(0),
+                jnp.zeros((batch_size, max_len), jnp.int32),
+                train=False, decode=True,
+            )
         )
-    )
+    except TypeError as e:  # no `decode` kwarg on this model family
+        raise ValueError(
+            f"{type(model).__name__} has no decode cache support"
+        ) from e
     if "cache" not in shapes:
         raise ValueError(
             f"{type(model).__name__} has no decode cache support"
@@ -53,12 +58,14 @@ def init_cache(model, batch_size: int, max_len: int):
 
 @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
 def _decode_step(model, params, cache, tokens):
-    """One (B, T) decode chunk: returns (logits, updated cache)."""
+    """One (B, T) decode chunk: returns ((B, V) next-token logits,
+    updated cache). last_only skips the vocab projection for all but
+    the final position (the only row generation consumes)."""
     logits, mutated = model.apply(
         {"params": params, "cache": cache}, tokens,
-        train=False, decode=True, mutable=["cache"],
+        train=False, decode=True, last_only=True, mutable=["cache"],
     )
-    return logits, mutated["cache"]
+    return logits[:, -1, :], mutated["cache"]
 
 
 def _sample(logits, *, temperature: float, top_k: int, rng):
@@ -67,7 +74,8 @@ def _sample(logits, *, temperature: float, top_k: int, rng):
         return jnp.argmax(logits, axis=-1)
     logits = logits / temperature
     if top_k > 0:
-        kth = jax.lax.top_k(logits, top_k)[0][..., -1:]
+        k = min(top_k, logits.shape[-1])
+        kth = jax.lax.top_k(logits, k)[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
     return jax.random.categorical(rng, logits, axis=-1)
 
@@ -84,6 +92,10 @@ def generate(model, params, prompt, max_new_tokens: int, *,
     prompt = jnp.asarray(prompt, jnp.int32)
     if prompt.ndim != 2 or prompt.shape[1] < 1:
         raise ValueError(f"prompt must be (B, P>=1), got {prompt.shape}")
+    if max_new_tokens < 0:
+        raise ValueError(
+            f"max_new_tokens must be >= 0, got {max_new_tokens}"
+        )
     if temperature < 0.0:
         raise ValueError(f"temperature must be >= 0, got {temperature}")
     if temperature > 0.0 and rng is None:
@@ -93,8 +105,7 @@ def generate(model, params, prompt, max_new_tokens: int, *,
     cache = init_cache(model, B, total)
 
     # prefill: the whole prompt in one chunk
-    logits, cache = _decode_step(model, params, cache, prompt)
-    next_logits = logits[:, -1, :]
+    next_logits, cache = _decode_step(model, params, cache, prompt)
 
     tokens = [prompt]
     done = jnp.zeros((B,), bool)
@@ -110,7 +121,7 @@ def generate(model, params, prompt, max_new_tokens: int, *,
             done = done | (tok == eos_token)
         tokens.append(tok[:, None].astype(jnp.int32))
         if i + 1 < max_new_tokens:
-            logits, cache = _decode_step(model, params, cache,
-                                         tok[:, None].astype(jnp.int32))
-            next_logits = logits[:, -1, :]
+            next_logits, cache = _decode_step(
+                model, params, cache, tok[:, None].astype(jnp.int32)
+            )
     return jnp.concatenate(tokens, axis=1)

@@ -85,7 +85,11 @@ class Llama(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, *, train: bool = False,
-                 decode: bool = False):
+                 decode: bool = False, last_only: bool = False):
+        """``last_only`` returns logits for the final position only
+        (B, 1, V) — decode prefill needs just the next-token row, and
+        at real vocab sizes the (P-1) unused head projections dominate
+        prefill cost."""
         x = nn.Embed(self.vocab_size, self.d_model,
                      param_dtype=self.param_dtype,
                      name="tok_embed")(tokens).astype(self.dtype)
@@ -98,6 +102,8 @@ class Llama(nn.Module):
                 attn_impl=self.attn_impl, dtype=self.dtype,
                 param_dtype=self.param_dtype, name=f"layer{i}",
             )(x, train, decode)
+        if last_only:
+            x = x[:, -1:]
         x = RMSNorm(dtype=self.dtype, param_dtype=self.param_dtype,
                     name="final_norm")(x)
         return nn.Dense(self.vocab_size, use_bias=False, dtype=jnp.float32,

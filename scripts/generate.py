@@ -1,0 +1,83 @@
+#!/usr/bin/env python
+"""Generation entrypoint: checkpoint -> KV-cache decode.
+
+Usage:
+    python scripts/generate.py --preset llama3_longcontext \
+        [--checkpoint-dir runs/ckpt] [--prompt "5 17 42"] \
+        [--max-new 32] [--temperature 0.8] [--top-k 40] [--seed 0]
+
+Prompts are space-separated token ids (the synthetic datasets have no
+tokenizer; a real deployment plugs one in front of this). Without
+--checkpoint-dir the model is randomly initialized — useful only for
+smoke-testing the decode path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+sys.path.insert(0, ".")  # run from repo root without install
+
+from pytorch_distributed_nn_tpu.runtime.platform import (
+    apply_platform_overrides,
+)
+
+apply_platform_overrides()
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--preset", default="llama3_longcontext")
+    ap.add_argument("--checkpoint-dir", default="")
+    ap.add_argument("--prompt", default="1 2 3 4")
+    ap.add_argument("--max-new", type=int, default=32)
+    ap.add_argument("--temperature", type=float, default=0.0)
+    ap.add_argument("--top-k", type=int, default=0)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args(argv)
+
+    from pytorch_distributed_nn_tpu.config import get_config
+    from pytorch_distributed_nn_tpu.inference import generate
+    from pytorch_distributed_nn_tpu.models import get_model
+
+    cfg = get_config(args.preset)
+    model = get_model(cfg.model)
+    prompt = jnp.asarray(
+        [[int(t) for t in args.prompt.split()]], jnp.int32
+    )
+
+    if args.checkpoint_dir:
+        cfg.checkpoint_dir = args.checkpoint_dir
+        cfg.steps = 0  # Trainer restores; no training
+        from pytorch_distributed_nn_tpu.train.trainer import Trainer
+
+        trainer = Trainer(cfg)
+        if trainer.ckpt is None or trainer.ckpt.latest_step() is None:
+            print(f"no checkpoint found in {args.checkpoint_dir}",
+                  file=sys.stderr)
+            return 1
+        params = jax.device_get(trainer.state.params)
+        trainer.close()
+    else:
+        print("[generate] no --checkpoint-dir: random init (smoke test)",
+              file=sys.stderr)
+        params = model.init(
+            jax.random.key(cfg.seed), prompt, train=False
+        )["params"]
+
+    rng = (jax.random.key(args.seed)
+           if args.temperature > 0 else None)
+    out = generate(model, params, prompt, args.max_new,
+                   temperature=args.temperature, top_k=args.top_k,
+                   rng=rng)
+    print(" ".join(str(t) for t in np.asarray(out)[0]))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -78,3 +78,44 @@ def test_sampling_requires_rng(tiny_llama):
     with pytest.raises(ValueError, match="rng"):
         generate(model, params, jnp.zeros((1, 2), jnp.int32), 2,
                  temperature=1.0)
+
+
+def test_generate_from_restored_checkpoint(tmp_path):
+    """Train-checkpoint-restore-generate integration (the scripts/
+    generate.py flow): restored params must drive the decode path."""
+    from pytorch_distributed_nn_tpu.config import (
+        DataConfig,
+        MeshSpec,
+        ModelConfig,
+        OptimConfig,
+        ParallelConfig,
+        TrainConfig,
+    )
+    from pytorch_distributed_nn_tpu.train.trainer import Trainer
+
+    cfg = TrainConfig(
+        steps=2, log_every=0,
+        checkpoint_dir=str(tmp_path), checkpoint_every=1,
+        mesh=MeshSpec(data=-1),
+        optim=OptimConfig(name="adam", lr=1e-3),
+        data=DataConfig(dataset="lm_synthetic", batch_size=8, seq_len=32,
+                        vocab_size=97),
+        model=ModelConfig(
+            name="llama3_8b", compute_dtype="float32", dtype="float32",
+            extra=dict(num_layers=2, d_model=64, num_heads=4,
+                       num_kv_heads=2, mlp_dim=128, vocab_size=97),
+        ),
+        parallel=ParallelConfig(strategy="dp"),
+    )
+    t1 = Trainer(cfg)
+    t1.train()
+    t1.close()
+
+    t2 = Trainer(cfg)  # restores from tmp_path
+    assert t2.data_step == 2
+    params = jax.device_get(t2.state.params)
+    out = generate(t2.model, params,
+                   jnp.asarray([[5, 7]], jnp.int32), 4)
+    assert out.shape == (1, 6)
+    assert int(out.max()) < 97
+    t2.close()
