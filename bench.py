@@ -44,10 +44,94 @@ PER_CHIP_BATCH = {
 }
 
 
+def bench_bus_bw(args) -> int:
+    """The second BASELINE metric: grad-allreduce bus bandwidth for
+    BERT-base fused buckets.
+
+    Wire bytes come from the real bucket partitioner + the standard
+    ring-allreduce accounting (2*p*(w-1)/w per bucket — nccl-tests
+    busbw convention, ops/collectives._WIRE). With one chip there is no
+    link to time, so the single-chip number is wire GB/step at the
+    nominal 8-way world; on a pod (n_chips > 1) the dp_explicit step is
+    timed and the metric becomes GB/s of realized bus bandwidth.
+    """
+    import jax
+
+    from pytorch_distributed_nn_tpu.config import get_config
+    from pytorch_distributed_nn_tpu.data import get_dataset
+    from pytorch_distributed_nn_tpu.models import get_model
+    from pytorch_distributed_nn_tpu.ops.buckets import partition_buckets
+    from pytorch_distributed_nn_tpu.utils.metrics import MetricsLogger
+
+    cfg = get_config(args.preset)
+    n_chips = len(jax.devices())
+    world = n_chips if n_chips > 1 else 8
+    model = get_model(cfg.model)
+    x, _ = get_dataset(
+        cfg.data.dataset, seed=0, batch_size=1,
+        seq_len=cfg.data.seq_len, vocab_size=cfg.data.vocab_size,
+    ).batch(0)
+    shapes = jax.eval_shape(
+        lambda: model.init(jax.random.key(0), x[:1], train=False)
+    )["params"]
+    leaves = jax.tree.leaves(shapes)
+    bucket_bytes = int(cfg.parallel.bucket_mb * 1024 * 1024)
+    sizes = [s.size * s.dtype.itemsize for s in leaves]
+    buckets = partition_buckets(sizes, bucket_bytes)
+    payload = float(sum(sizes))
+    wire = 2.0 * payload * (world - 1) / world  # ring allreduce, all buckets
+
+    if n_chips > 1:
+        # measured: time the real dp_explicit bucketed step
+        from pytorch_distributed_nn_tpu.train.trainer import Trainer
+
+        cfg.parallel.strategy = "dp_explicit"
+        cfg.steps = args.warmup + args.steps
+        cfg.log_every = 0
+        cfg.data.batch_size = (args.per_chip_batch
+                               or PER_CHIP_BATCH[args.preset]) * n_chips
+        trainer = Trainer(cfg)
+        batch = trainer.loader.batch_at(0)
+        state = trainer.state
+        # same fence discipline as main(): a scalar device_get is the
+        # only reliable execution fence through the transfer tunnel
+        for _ in range(max(args.warmup, 1)):
+            state, m = trainer.step_fn(state, *batch)
+        float(jax.device_get(m["loss"]))
+        steps = max(args.steps, 1)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            state, m = trainer.step_fn(state, *batch)
+        loss = float(jax.device_get(m["loss"]))
+        step_s = (time.perf_counter() - t0) / steps
+        if not (loss == loss):
+            raise RuntimeError(f"non-finite loss {loss} in bus-bw loop")
+        value, unit = wire / step_s / 1e9, "GB/s"
+        metric = (f"grad-allreduce bus-bw ({args.preset}, "
+                  f"{n_chips}-way DP, {len(buckets)} buckets)")
+    else:
+        value, unit = wire / 1e9, "GB/step"
+        metric = (f"grad-allreduce wire traffic ({args.preset}, nominal "
+                  f"8-way DP, {len(buckets)} x {cfg.parallel.bucket_mb:g}MB "
+                  "buckets)")
+
+    with open(os.devnull, "w") as sink:
+        rec = MetricsLogger(stream=sink).emit_benchmark(
+            metric=metric, value=round(value, 3), unit=unit,
+            vs_baseline=None,
+        )
+    print(json.dumps(rec))
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--preset", default="resnet50_dp",
                     choices=sorted(PER_CHIP_BATCH))
+    ap.add_argument("--metric", default="throughput",
+                    choices=("throughput", "bus_bw"),
+                    help="bus_bw: BASELINE's grad-allreduce bus-bandwidth "
+                         "metric (use with --preset bert_base_buckets)")
     ap.add_argument("--steps", type=int, default=30,
                     help="timed steps (after warmup)")
     ap.add_argument("--warmup", type=int, default=5,
@@ -55,6 +139,8 @@ def main(argv=None) -> int:
     ap.add_argument("--per-chip-batch", type=int, default=0,
                     help="override per-chip batch size")
     args = ap.parse_args(argv)
+    if args.metric == "bus_bw":
+        return bench_bus_bw(args)
 
     import jax
 
