@@ -15,8 +15,9 @@ a reference user switches to needs an inference path. Design:
   device via ``jax.random.categorical``.
 
 Supported models: the Llama family (rotary positions are absolute via
-the cache index). Token-identical to full-context argmax decoding — the
-oracle in tests/test_generate.py.
+the cache index) and TransformerLM (learned positional table offset by
+a model-level cache counter). Token-identical to full-context argmax
+decoding — the oracle in tests/test_generate.py.
 """
 
 from __future__ import annotations

@@ -28,6 +28,9 @@ class MoETransformerLM(TransformerLM):
     aux_loss_weight: float = 0.01
     group_size: int = 1024  # routing group (see MoEMLP)
     moe_every: int = 1  # 1 = every layer (Mixtral), 2 = every other (GShard)
+    # routing is chunk-global (capacity + prior-claim counts span the
+    # group), so cached decode would diverge from full-context recompute
+    supports_decode: bool = False
 
     def layer_ffn(self, i: int) -> Optional[Callable]:
         if i % self.moe_every != self.moe_every - 1:

@@ -34,7 +34,7 @@ class DecoderBlock(nn.Module):
     param_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x, train: bool = False):
+    def __call__(self, x, train: bool = False, decode: bool = False):
         d = x.shape[-1]
         y = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
                          name="ln1")(x)
@@ -42,7 +42,7 @@ class DecoderBlock(nn.Module):
             num_heads=self.num_heads, head_dim=d // self.num_heads,
             causal=True, impl=self.attn_impl, dtype=self.dtype,
             param_dtype=self.param_dtype, name="attn",
-        )(y)
+        )(y, decode=decode)
         if self.dropout:
             y = nn.Dropout(self.dropout, deterministic=not train)(y)
         x = x + y
@@ -73,6 +73,8 @@ class TransformerLM(nn.Module):
     attn_impl: str = "auto"
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
+    # subclasses whose routing is chunk-global (MoE) turn this off
+    supports_decode: bool = True
 
     def block_kwargs(self) -> dict:
         return dict(num_heads=self.num_heads, mlp_dim=self.mlp_dim,
@@ -87,7 +89,8 @@ class TransformerLM(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, *, train: bool = False,
-                 positions: Optional[jnp.ndarray] = None):
+                 positions: Optional[jnp.ndarray] = None,
+                 decode: bool = False, last_only: bool = False):
         T = tokens.shape[1]
         if T > self.max_len:
             raise ValueError(
@@ -95,6 +98,29 @@ class TransformerLM(nn.Module):
             )
         x = nn.Embed(self.vocab_size, self.d_model,
                      param_dtype=self.param_dtype, name="tok_embed")(tokens)
+        if decode and not self.supports_decode:
+            # MoE routing is group-global (capacity and prior-claim
+            # counts depend on every token in the chunk), so cached
+            # decode would silently break generate()'s token-identity
+            # contract — reject like pipeline.py does.
+            raise ValueError(
+                f"{type(self).__name__} does not support decode caching"
+            )
+        if decode and positions is not None:
+            raise ValueError(
+                "decode mode derives positions from the cache counter; "
+                "an explicit `positions` argument would be ignored"
+            )
+        if decode:
+            # the learned positional table needs absolute positions, so
+            # the model keeps its own running index next to the
+            # attention layers' KV cache_index vars
+            pos_index = self.variable(
+                "cache", "pos_index", lambda: jnp.zeros((), jnp.int32)
+            )
+            if not self.is_initializing():
+                positions = pos_index.value + jnp.arange(T)[None]
+                pos_index.value = pos_index.value + T
         if positions is None:
             positions = jnp.arange(T)[None]
         pos = nn.Embed(self.max_len, self.d_model,
@@ -103,12 +129,14 @@ class TransformerLM(nn.Module):
         x = (x + pos).astype(self.dtype)
         block_cls = DecoderBlock
         if self.remat:
-            # static_argnums counts (self, x, train) — train must be
-            # static or `deterministic=not train` fails on a tracer
-            block_cls = nn.remat(DecoderBlock, static_argnums=(2,))
+            # static_argnums counts (self, x, train, decode) — train must
+            # be static or `deterministic=not train` fails on a tracer
+            block_cls = nn.remat(DecoderBlock, static_argnums=(2, 3))
         for i in range(self.num_layers):
             x = block_cls(**self.block_kwargs(), ffn=self.layer_ffn(i),
-                          name=f"block{i}")(x, train)
+                          name=f"block{i}")(x, train, decode)
+        if last_only:
+            x = x[:, -1:]
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
                          name="ln_f")(x)
         return nn.Dense(self.vocab_size, use_bias=False, dtype=jnp.float32,

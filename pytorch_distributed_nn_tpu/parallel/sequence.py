@@ -198,7 +198,7 @@ _ring_attention_fused.defvjp(_ring_fused_fwd, _ring_fused_bwd)
 
 
 def ulysses_attention(q, k, v, *, axis: str = AXIS_SEQ,
-                      causal: bool = True, impl: str = "xla"):
+                      causal: bool = True, impl: str = "auto"):
     """All-to-all head-scatter attention (DeepSpeed-Ulysses scheme,
     SURVEY.md §2c). Local shards (B, Tl, H, D) → full-seq per-head-group
     attention → back."""

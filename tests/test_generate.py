@@ -22,19 +22,40 @@ def tiny_llama():
     return model, params
 
 
-def test_greedy_matches_full_context_recompute(tiny_llama):
+@pytest.fixture(scope="module")
+def tiny_transformer_lm():
+    model = get_model(ModelConfig(
+        name="transformer_lm", compute_dtype="float32", dtype="float32",
+        extra=dict(num_layers=2, d_model=64, num_heads=4, mlp_dim=128,
+                   vocab_size=97, max_len=32),
+    ))
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.key(2), tokens, train=False)["params"]
+    return model, params
+
+
+def _assert_greedy_matches_recompute(model, params, n_new=6):
     """The strongest oracle: cached decode must produce exactly the
     tokens that brute-force argmax over the growing full context does."""
-    model, params = tiny_llama
     prompt = jnp.asarray([[5, 17, 42], [96, 1, 3]], jnp.int32)
-    out = generate(model, params, prompt, max_new_tokens=6)
+    out = generate(model, params, prompt, max_new_tokens=n_new)
 
     seq = prompt
-    for _ in range(6):
+    for _ in range(n_new):
         logits = model.apply({"params": params}, seq, train=False)
         tok = jnp.argmax(logits[:, -1, :], axis=-1)
         seq = jnp.concatenate([seq, tok[:, None].astype(jnp.int32)], 1)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
+
+
+def test_greedy_matches_full_context_recompute(tiny_llama):
+    model, params = tiny_llama
+    _assert_greedy_matches_recompute(model, params)
+
+
+def test_greedy_matches_recompute_transformer_lm(tiny_transformer_lm):
+    model, params = tiny_transformer_lm
+    _assert_greedy_matches_recompute(model, params)
 
 
 def test_prefill_logits_match_full_forward(tiny_llama):
@@ -119,3 +140,23 @@ def test_generate_from_restored_checkpoint(tmp_path):
     assert out.shape == (1, 6)
     assert int(out.max()) < 97
     t2.close()
+
+
+def test_moe_decode_rejected():
+    model = get_model(ModelConfig(
+        name="moe_lm", compute_dtype="float32", dtype="float32",
+        extra=dict(num_layers=2, d_model=64, num_heads=4, mlp_dim=128,
+                   vocab_size=97, max_len=32, num_experts=2),
+    ))
+    with pytest.raises(ValueError, match="decode"):
+        init_cache(model, 1, 8)
+
+
+def test_decode_rejects_explicit_positions(tiny_transformer_lm):
+    model, params = tiny_transformer_lm
+    cache = init_cache(model, 1, 4)
+    with pytest.raises(ValueError, match="positions"):
+        model.apply({"params": params, "cache": cache},
+                    jnp.zeros((1, 2), jnp.int32), decode=True,
+                    positions=jnp.zeros((1, 2), jnp.int32),
+                    mutable=["cache"])
