@@ -74,6 +74,10 @@ class TrainConfig:
     log_every: int = 10
     eval_every: int = 0  # 0 = no eval; else eval every N steps
     eval_batches: int = 8  # batches per eval pass (held-out seed stream)
+    # chunk the LM softmax-xent over T (tokens per chunk; 0 = dense
+    # logits). At long context the (B, T, V) logits are the HBM
+    # limiter; chunking keeps one (B, chunk, V) block live instead.
+    xent_chunk: int = 0
     checkpoint_dir: str = ""
     checkpoint_every: int = 0
     resume: bool = True
@@ -221,16 +225,17 @@ def _llama3_longcontext() -> TrainConfig:
         optim=OptimConfig(name="adamw", lr=1e-4, weight_decay=0.1,
                           grad_clip_norm=1.0, warmup_steps=2,
                           schedule="cosine"),
-        # vocab 8k, not 128k: at T=32k the (T, vocab) logits + grads are
-        # the HBM limiter, and vocabulary size is orthogonal to what
-        # this preset measures (long-context attention throughput)
         data=DataConfig(dataset="lm_synthetic", batch_size=1,
-                        seq_len=32768, vocab_size=8192),
+                        seq_len=32768, vocab_size=32000),
         model=ModelConfig(name="llama3_8b", remat=True,
                           extra=dict(num_layers=8, d_model=1024,
                                      num_heads=16, num_kv_heads=8,
-                                     mlp_dim=3584, vocab_size=8192)),
+                                     mlp_dim=3584, vocab_size=32000)),
         parallel=ParallelConfig(strategy="dp"),
+        # at T=32k the (T, vocab) logits are the HBM limiter (dense
+        # f32 logits + grads OOM a 16 GB chip at vocab 32k); the
+        # chunked xent keeps one (B, 2048, V) block live instead
+        xent_chunk=2048,
     )
 
 

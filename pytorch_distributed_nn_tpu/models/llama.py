@@ -85,11 +85,15 @@ class Llama(nn.Module):
 
     @nn.compact
     def __call__(self, tokens, *, train: bool = False,
-                 decode: bool = False, last_only: bool = False):
+                 decode: bool = False, last_only: bool = False,
+                 return_hidden: bool = False):
         """``last_only`` returns logits for the final position only
         (B, 1, V) — decode prefill needs just the next-token row, and
         at real vocab sizes the (P-1) unused head projections dominate
-        prefill cost."""
+        prefill cost. ``return_hidden`` skips the lm_head and returns
+        the final-norm'd (B, T, D) trunk output — the chunked-xent path
+        (train/losses.py) applies the head blockwise so full logits
+        never materialize."""
         x = nn.Embed(self.vocab_size, self.d_model,
                      param_dtype=self.param_dtype,
                      name="tok_embed")(tokens).astype(self.dtype)
@@ -106,6 +110,8 @@ class Llama(nn.Module):
             x = x[:, -1:]
         x = RMSNorm(dtype=self.dtype, param_dtype=self.param_dtype,
                     name="final_norm")(x)
+        if return_hidden:
+            return x
         return nn.Dense(self.vocab_size, use_bias=False, dtype=jnp.float32,
                         param_dtype=self.param_dtype, name="lm_head")(x)
 

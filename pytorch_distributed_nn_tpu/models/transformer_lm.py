@@ -90,7 +90,8 @@ class TransformerLM(nn.Module):
     @nn.compact
     def __call__(self, tokens, *, train: bool = False,
                  positions: Optional[jnp.ndarray] = None,
-                 decode: bool = False, last_only: bool = False):
+                 decode: bool = False, last_only: bool = False,
+                 return_hidden: bool = False):
         T = tokens.shape[1]
         if T > self.max_len:
             raise ValueError(
@@ -139,6 +140,8 @@ class TransformerLM(nn.Module):
             x = x[:, -1:]
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
                          name="ln_f")(x)
+        if return_hidden:
+            return x
         return nn.Dense(self.vocab_size, use_bias=False, dtype=jnp.float32,
                         param_dtype=self.param_dtype, name="lm_head")(x)
 

@@ -21,6 +21,24 @@ from pytorch_distributed_nn_tpu.config import TrainConfig
 from pytorch_distributed_nn_tpu.train.state import TrainState
 
 
+def make_chunked_loss(chunk: int) -> Callable:
+    """LM loss that never materializes (B, T, V) logits: the model
+    returns trunk hidden (``apply_kwargs``), the head kernel is pulled
+    from the live params (``needs_params``), and
+    losses.chunked_lm_xent projects + cross-entropies per T-chunk
+    (rematerialized in backward). See dp._loss_and_grads for the
+    marker-attribute contract."""
+    from pytorch_distributed_nn_tpu.train.losses import chunked_lm_xent
+
+    def loss_fn(hidden, targets, params):
+        kernel = params["lm_head"]["kernel"]
+        return chunked_lm_xent(hidden, kernel, targets, chunk=chunk)
+
+    loss_fn.needs_params = True
+    loss_fn.apply_kwargs = {"return_hidden": True}
+    return loss_fn
+
+
 def make_train_step(
     cfg: TrainConfig, mesh: Mesh, loss_fn: Callable, model=None
 ) -> tuple[Callable, Callable[[TrainState], TrainState]]:
@@ -30,6 +48,24 @@ def make_train_step(
     from pytorch_distributed_nn_tpu.parallel import dp
 
     strategy = cfg.parallel.strategy
+    if cfg.xent_chunk:
+        if strategy not in ("single", "dp", "dp_explicit", "zero"):
+            raise ValueError(
+                f"xent_chunk is not supported under strategy "
+                f"{strategy!r} (needs the shared dp/zero step)"
+            )
+        if cfg.data.dataset != "lm_synthetic":
+            raise ValueError(
+                "xent_chunk is a causal-LM loss option "
+                f"(dataset lm_synthetic), got {cfg.data.dataset!r}"
+            )
+        if cfg.data.seq_len % cfg.xent_chunk:
+            raise ValueError(
+                f"seq_len {cfg.data.seq_len} not divisible by "
+                f"xent_chunk {cfg.xent_chunk} — the dense fallback "
+                "would defeat the memory bound"
+            )
+        loss_fn = make_chunked_loss(cfg.xent_chunk)
     if strategy in ("single", "dp"):
         if cfg.parallel.quantized_allreduce:
             logging.getLogger(__name__).warning(

@@ -39,13 +39,19 @@ from pytorch_distributed_nn_tpu.train.state import TrainState
 DATA_AXES = (AXIS_DATA, AXIS_FSDP)
 
 
-def forward(state: TrainState, params, x, *, train: bool):
+def forward(state: TrainState, params, x, *, train: bool,
+            apply_kwargs: dict | None = None):
     """Run the model, threading mutable collections (BatchNorm stats) and
     a per-step dropout PRNG. Returns (logits, new_model_state, aux_losses)
     where ``aux_losses`` are scalars sown into the "losses" collection
     (MoE load-balance terms — parallel/expert.py) to be *added to the
-    task loss*; they are never carried in model_state."""
+    task loss*; they are never carried in model_state.
+
+    ``apply_kwargs`` are forwarded to the model (e.g.
+    ``return_hidden=True`` for the chunked-xent path, in which case the
+    first return is the trunk hidden, not logits)."""
     variables = {"params": params, **state.model_state}
+    extra = apply_kwargs or {}
     # deterministic per-step dropout stream seeded from the TrainState's
     # base key (cfg.seed); under jit-sharding the mask generation
     # partitions with the batch (threefry is partitionable)
@@ -54,20 +60,30 @@ def forward(state: TrainState, params, x, *, train: bool):
         logits, updated = state.apply_fn(
             variables, x, train=True,
             mutable=list(state.model_state) + ["losses"],
-            rngs=rngs,
+            rngs=rngs, **extra,
         )
         updated = dict(updated)
         aux = jax.tree.leaves(updated.pop("losses", {}))
         return logits, updated, aux
-    logits = state.apply_fn(variables, x, train=train)
+    logits = state.apply_fn(variables, x, train=train, **extra)
     return logits, state.model_state, []
 
 
 def _loss_and_grads(state, x, y, loss_fn):
+    """``loss_fn(out, y)`` by default. A loss_fn carrying the marker
+    attributes set by api.make_chunked_loss gets the model output it
+    asked for (``loss_fn.apply_kwargs``) plus the live params
+    (``loss_fn.needs_params``) — the chunked-xent path needs the head
+    kernel to project blockwise."""
+    apply_kwargs = getattr(loss_fn, "apply_kwargs", None)
+    needs_params = getattr(loss_fn, "needs_params", False)
+
     def compute(params):
-        logits, new_model_state, aux = forward(state, params, x,
-                                               train=True)
-        loss = loss_fn(logits, y)
+        out, new_model_state, aux = forward(
+            state, params, x, train=True, apply_kwargs=apply_kwargs
+        )
+        loss = (loss_fn(out, y, params) if needs_params
+                else loss_fn(out, y))
         for term in aux:  # sown losses (MoE load balance)
             loss = loss + term
         return loss, new_model_state

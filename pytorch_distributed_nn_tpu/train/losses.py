@@ -4,6 +4,7 @@ directly comparable to single-device ones (SURVEY.md §4)."""
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 import optax
 
@@ -39,6 +40,89 @@ def masked_lm_xent(logits, labels) -> jnp.ndarray:
     )
     per_tok = jnp.where(valid, per_tok, 0.0)
     return per_tok.sum() / jnp.maximum(valid.sum(), 1)
+
+
+def chunked_lm_xent(hidden, kernel, targets, *, chunk: int = 2048
+                    ) -> jnp.ndarray:
+    """Causal-LM xent without ever materializing the (B, T, V) logits.
+
+    At long context the logits — not attention — are the HBM limiter
+    (B=1, T=32k, V=128k f32 is 16 GB before gradients). This computes
+    the head projection + cross-entropy per T-chunk inside a
+    ``lax.scan`` whose body is ``jax.checkpoint``-ed, so forward AND
+    backward keep only one (B, chunk, V) logits block live.
+
+    hidden: (B, T, D) final-norm'd trunk output (model ``return_hidden``
+    path); kernel: (D, V) lm_head weight; targets: (B, T) int.
+    Numerically identical to ``lm_xent(hidden @ kernel, targets)``.
+    """
+    B, T, D = hidden.shape
+    if T % chunk:
+        # api.make_train_step validates divisibility at config time;
+        # this runtime fallback covers direct callers, loudly (a silent
+        # dense fallback would OOM exactly where chunking was wanted)
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "chunked_lm_xent: T=%d %% chunk=%d != 0 — dense fallback, "
+            "(B, T, V) logits WILL materialize", T, chunk,
+        )
+        return lm_xent(
+            jnp.einsum("btd,dv->btv", hidden, kernel), targets
+        )
+    nb = T // chunk
+    h = hidden.reshape(B, nb, chunk, D).transpose(1, 0, 2, 3)
+    t = targets.reshape(B, nb, chunk).transpose(1, 0, 2)
+
+    @jax.checkpoint
+    def body(acc, ht):
+        h_blk, t_blk = ht
+        logits = jnp.einsum(
+            "bcd,dv->bcv", h_blk, kernel,
+            preferred_element_type=jnp.float32,
+        )
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), t_blk
+        ).sum()
+        return acc + loss, None
+
+    total, _ = jax.lax.scan(body, jnp.zeros((), jnp.float32), (h, t))
+    return total / (B * T)
+
+
+def chunked_lm_eval(hidden, kernel, targets, *, chunk: int = 2048
+                    ) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Eval twin of :func:`chunked_lm_xent`: (mean loss, accuracy)
+    per T-chunk, still never materializing full logits (an eval pass at
+    long context would otherwise OOM exactly like training did)."""
+    B, T, D = hidden.shape
+    if T % chunk:
+        logits = jnp.einsum("btd,dv->btv", hidden, kernel)
+        return lm_xent(logits, targets), accuracy(logits, targets)
+    nb = T // chunk
+    h = hidden.reshape(B, nb, chunk, D).transpose(1, 0, 2, 3)
+    t = targets.reshape(B, nb, chunk).transpose(1, 0, 2)
+
+    def body(carry, ht):
+        loss_acc, hit_acc = carry
+        h_blk, t_blk = ht
+        logits = jnp.einsum(
+            "bcd,dv->bcv", h_blk, kernel,
+            preferred_element_type=jnp.float32,
+        ).astype(jnp.float32)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, t_blk
+        ).sum()
+        hits = (logits.argmax(-1) == t_blk).sum()
+        return (loss_acc + loss, hit_acc + hits), None
+
+    (loss, hits), _ = jax.lax.scan(
+        body,
+        (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)),
+        (h, t),
+    )
+    n = B * T
+    return loss / n, hits.astype(jnp.float32) / n
 
 
 def accuracy(logits, labels) -> jnp.ndarray:

@@ -177,17 +177,36 @@ class Trainer:
         from pytorch_distributed_nn_tpu.parallel.dp import forward
 
         loss_fn = self.loss_fn
+        xent_chunk = self.cfg.xent_chunk
 
-        def eval_step(state, x, y):
-            # dp.forward is the one place that knows how to assemble
-            # variables/mutable collections; eval must not fork it
-            logits, _, _ = forward(state, state.params, x, train=False)
-            loss = loss_fn(logits, y)
-            # masked accuracy: labels < 0 mean "ignore" (BERT MLM)
-            valid = y >= 0
-            hit = jnp.logical_and(logits.argmax(-1) == y, valid)
-            acc = hit.sum() / jnp.maximum(valid.sum(), 1)
-            return loss.astype(jnp.float32), acc.astype(jnp.float32)
+        if xent_chunk:
+            # long-context LM: dense (B, T, V) eval logits would OOM the
+            # same way training would — evaluate chunked too
+            from pytorch_distributed_nn_tpu.train.losses import (
+                chunked_lm_eval,
+            )
+
+            def eval_step(state, x, y):
+                hidden, _, _ = forward(
+                    state, state.params, x, train=False,
+                    apply_kwargs={"return_hidden": True},
+                )
+                kernel = state.params["lm_head"]["kernel"]
+                loss, acc = chunked_lm_eval(hidden, kernel, y,
+                                            chunk=xent_chunk)
+                return loss, acc
+        else:
+            def eval_step(state, x, y):
+                # dp.forward is the one place that knows how to assemble
+                # variables/mutable collections; eval must not fork it
+                logits, _, _ = forward(state, state.params, x,
+                                       train=False)
+                loss = loss_fn(logits, y)
+                # masked accuracy: labels < 0 mean "ignore" (BERT MLM)
+                valid = y >= 0
+                hit = jnp.logical_and(logits.argmax(-1) == y, valid)
+                acc = hit.sum() / jnp.maximum(valid.sum(), 1)
+                return loss.astype(jnp.float32), acc.astype(jnp.float32)
 
         self._eval_step = jax.jit(eval_step)
 
