@@ -42,6 +42,27 @@ def masked_lm_xent(logits, labels) -> jnp.ndarray:
     return per_tok.sum() / jnp.maximum(valid.sum(), 1)
 
 
+def _to_chunks(hidden, targets, chunk: int):
+    """(B, T, ·) -> per-chunk scan operands (nb, B, chunk, ·), or None
+    when T is indivisible — logged loudly, because the dense fallback
+    materializes the (B, T, V) logits the chunked path exists to avoid
+    (api.make_train_step rejects this at config time; direct callers
+    get the warning)."""
+    B, T = targets.shape
+    if T % chunk:
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "chunked LM loss: T=%d %% chunk=%d != 0 — dense fallback, "
+            "(B, T, V) logits WILL materialize", T, chunk,
+        )
+        return None
+    nb = T // chunk
+    h = hidden.reshape(B, nb, chunk, -1).transpose(1, 0, 2, 3)
+    t = targets.reshape(B, nb, chunk).transpose(1, 0, 2)
+    return h, t
+
+
 def chunked_lm_xent(hidden, kernel, targets, *, chunk: int = 2048
                     ) -> jnp.ndarray:
     """Causal-LM xent without ever materializing the (B, T, V) logits.
@@ -56,23 +77,13 @@ def chunked_lm_xent(hidden, kernel, targets, *, chunk: int = 2048
     path); kernel: (D, V) lm_head weight; targets: (B, T) int.
     Numerically identical to ``lm_xent(hidden @ kernel, targets)``.
     """
-    B, T, D = hidden.shape
-    if T % chunk:
-        # api.make_train_step validates divisibility at config time;
-        # this runtime fallback covers direct callers, loudly (a silent
-        # dense fallback would OOM exactly where chunking was wanted)
-        import logging
-
-        logging.getLogger(__name__).warning(
-            "chunked_lm_xent: T=%d %% chunk=%d != 0 — dense fallback, "
-            "(B, T, V) logits WILL materialize", T, chunk,
-        )
+    chunks = _to_chunks(hidden, targets, chunk)
+    if chunks is None:
         return lm_xent(
             jnp.einsum("btd,dv->btv", hidden, kernel), targets
         )
-    nb = T // chunk
-    h = hidden.reshape(B, nb, chunk, D).transpose(1, 0, 2, 3)
-    t = targets.reshape(B, nb, chunk).transpose(1, 0, 2)
+    h, t = chunks
+    B, T, _ = hidden.shape
 
     @jax.checkpoint
     def body(acc, ht):
@@ -95,13 +106,12 @@ def chunked_lm_eval(hidden, kernel, targets, *, chunk: int = 2048
     """Eval twin of :func:`chunked_lm_xent`: (mean loss, accuracy)
     per T-chunk, still never materializing full logits (an eval pass at
     long context would otherwise OOM exactly like training did)."""
-    B, T, D = hidden.shape
-    if T % chunk:
+    chunks = _to_chunks(hidden, targets, chunk)
+    if chunks is None:
         logits = jnp.einsum("btd,dv->btv", hidden, kernel)
         return lm_xent(logits, targets), accuracy(logits, targets)
-    nb = T // chunk
-    h = hidden.reshape(B, nb, chunk, D).transpose(1, 0, 2, 3)
-    t = targets.reshape(B, nb, chunk).transpose(1, 0, 2)
+    h, t = chunks
+    B, T, _ = hidden.shape
 
     def body(carry, ht):
         loss_acc, hit_acc = carry

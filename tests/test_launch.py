@@ -55,14 +55,26 @@ def test_crash_restart_resumes_from_checkpoint(tmp_path):
         ckpt = sys.argv[1] + "/state.npy"
         rank = int(os.environ["RANK"])
         incarnation = int(os.environ["TPUNN_RESTART"])
-        step = int(np.load(ckpt)) if os.path.exists(ckpt) else 0
+        # tolerate a torn file: the writer may have been killed mid-save
+        # (real checkpointing is atomic; this toy one must be too)
+        try:
+            step = int(np.load(ckpt)) if os.path.exists(ckpt) else 0
+        except Exception:
+            step = 0
         first_step = step
+        # injected fault: fire once rank 0 has published at least one
+        # checkpoint (waiting beats a step-count trigger, which races
+        # against rank 0 finishing before rank 1 even starts)
+        if rank == 1 and incarnation == 0:
+            import time
+            while not os.path.exists(ckpt):
+                time.sleep(0.02)
+            os._exit(17)
         while step < 10:
             step += 1
-            if rank == 1 and incarnation == 0 and step == 5:
-                os._exit(17)  # injected fault
             if rank == 0:
-                np.save(ckpt, np.int64(step))
+                np.save(ckpt + ".tmp.npy", np.int64(step))
+                os.replace(ckpt + ".tmp.npy", ckpt)  # atomic publish
         with open(f"{sys.argv[1]}/done{rank}_{incarnation}", "w") as f:
             f.write(str(first_step))
     """)
@@ -71,8 +83,10 @@ def test_crash_restart_resumes_from_checkpoint(tmp_path):
     assert result.exit_code == 0
     assert result.restarts == 1
     assert int(np.load(tmp_path / "state.npy")) == 10
-    # incarnation 1 resumed from the checkpoint, not from scratch
-    assert int((tmp_path / "done0_1").read_text()) >= 4
+    # incarnation 1 resumed from a published checkpoint, not scratch
+    # (the fault only fires after rank 0 publishes step >= 1)
+    resumed_at = int((tmp_path / "done0_1").read_text())
+    assert 1 <= resumed_at <= 10
 
 
 def test_restart_budget_exhausted(tmp_path):
