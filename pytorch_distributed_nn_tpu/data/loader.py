@@ -19,7 +19,19 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding
 
 from pytorch_distributed_nn_tpu.data.datasets import SyntheticDataset
-from pytorch_distributed_nn_tpu.runtime.mesh import batch_pspec
+from pytorch_distributed_nn_tpu.runtime.mesh import AXIS_SEQ, batch_pspec
+
+
+def array_pspec(mesh: Mesh, ndim: int, seq_len: int | None):
+    """Batch layout for one array: rows over data×fsdp always; a
+    (B, T) token array additionally shards T over ``seq`` when the mesh
+    has sequence parallelism (model-level ring attention expects its
+    activations sequence-sharded from the start — see parallel/api.py
+    validation)."""
+    seq = mesh.shape.get(AXIS_SEQ, 1)
+    if seq > 1 and ndim == 2 and seq_len and seq_len % seq == 0:
+        return batch_pspec(AXIS_SEQ)
+    return batch_pspec()
 
 
 class DataLoader:
@@ -35,7 +47,6 @@ class DataLoader:
         self.mesh = mesh
         self.start_step = start_step
         self.prefetch = prefetch
-        self.sharding = NamedSharding(mesh, batch_pspec())
         gbs = dataset.batch_size
         n_proc = jax.process_count()
         if gbs % n_proc:
@@ -49,6 +60,26 @@ class DataLoader:
             raise ValueError(
                 f"global batch {gbs} not divisible by data degree {dp}"
             )
+        if (mesh.shape.get(AXIS_SEQ, 1) > 1 and jax.process_count() > 1
+                and self._seq_spans_processes(mesh)):
+            # _host_slice hands each process its batch rows with the
+            # FULL sequence dim; that is only the process's addressable
+            # portion when every seq-axis device is process-local
+            raise NotImplementedError(
+                "sequence sharding across processes is not supported: "
+                "keep the seq mesh axis within one host (it wants ICI "
+                "anyway) and put data/pipe across hosts"
+            )
+
+    @staticmethod
+    def _seq_spans_processes(mesh: Mesh) -> bool:
+        devs = np.asarray(mesh.devices)
+        seq_axis = list(mesh.axis_names).index(AXIS_SEQ)
+        moved = np.moveaxis(devs, seq_axis, 0)
+        for line in moved.reshape(moved.shape[0], -1).T:
+            if len({d.process_index for d in line}) > 1:
+                return True
+        return False
 
     def _host_slice(self, arr: np.ndarray) -> np.ndarray:
         """The rows of the global batch this process owns (contiguous
@@ -59,10 +90,15 @@ class DataLoader:
         return arr[i * per:(i + 1) * per]
 
     def _to_global(self, arr: np.ndarray) -> jax.Array:
+        sharding = NamedSharding(
+            self.mesh,
+            array_pspec(self.mesh, arr.ndim,
+                        arr.shape[1] if arr.ndim >= 2 else None),
+        )
         if jax.process_count() == 1:
-            return jax.device_put(arr, self.sharding)
+            return jax.device_put(arr, sharding)
         return jax.make_array_from_process_local_data(
-            self.sharding, self._host_slice(arr)
+            sharding, self._host_slice(arr)
         )
 
     def batch_at(self, step: int) -> tuple[jax.Array, ...]:

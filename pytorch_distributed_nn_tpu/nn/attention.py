@@ -131,7 +131,54 @@ class MultiHeadAttention(nn.Module):
                 "decode mode ignores padding masks; strip padding (or "
                 "left-trim) before prefill"
             )
-        if decode:
+        if self.impl == "ring":
+            # Sequence/context parallelism at the model level: the
+            # activation's T dim is sharded over the `seq` mesh axis and
+            # attention runs as a KV ring (parallel/sequence.py) inside
+            # a nested shard_map (seq manual, other mesh axes stay
+            # auto). Requires an ambient mesh (Trainer sets it when
+            # mesh.seq > 1) and causal attention; rotary positions are
+            # global (computed from the shard's ring index).
+            if decode:
+                raise ValueError("ring attention has no decode cache; "
+                                 "generate with impl='auto'")
+            if not self.causal or mask is not None:
+                raise ValueError(
+                    "ring attention is causal-only and takes no mask"
+                )
+            from jax.sharding import PartitionSpec as _P
+
+            from pytorch_distributed_nn_tpu.parallel.sequence import (
+                ring_attention,
+            )
+            from pytorch_distributed_nn_tpu.runtime.mesh import AXIS_SEQ
+
+            def attn_local(q, k, v):
+                if self.rotary:
+                    Tl = q.shape[1]
+                    start = jax.lax.axis_index(AXIS_SEQ) * Tl
+                    pos = start + jnp.arange(Tl)[None]
+                    q, k = rotary_embedding(q, k, theta=self.rope_theta,
+                                            positions=pos)
+                    q = q.astype(self.dtype)
+                    k = k.astype(self.dtype)
+                return ring_attention(q, k, v, axis=AXIS_SEQ,
+                                      causal=True)
+
+            # axis_names: manual over seq ONLY — without it shard_map
+            # goes manual over every mesh axis and the unsharded specs
+            # all-gather the batch dim over data x fsdp, silently
+            # negating data parallelism at every attention layer
+            # (check_vma stays on: check_vma=False combined with
+            # axis_names flips every mesh axis manual and the specs
+            # get rejected; ring carries are pvary'd instead)
+            out = jax.shard_map(
+                attn_local,
+                in_specs=(_P(None, AXIS_SEQ),) * 3,
+                out_specs=_P(None, AXIS_SEQ),
+                axis_names={AXIS_SEQ},
+            )(q, k, v)
+        elif decode:
             B, T = x.shape[0], x.shape[1]
             init_k = nn.initializers.zeros
             cached_k = self.variable(

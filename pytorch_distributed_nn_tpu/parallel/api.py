@@ -46,8 +46,36 @@ def make_train_step(
     function that lays the freshly-initialised TrainState out on the mesh
     (replication broadcast, ZeRO sharding, or stage split)."""
     from pytorch_distributed_nn_tpu.parallel import dp
+    from pytorch_distributed_nn_tpu.runtime.mesh import AXIS_SEQ
 
     strategy = cfg.parallel.strategy
+    if mesh.shape.get(AXIS_SEQ, 1) > 1:
+        if cfg.data.dataset not in ("lm_synthetic",):
+            raise ValueError(
+                "mesh.seq > 1 shards the sequence dim of (B, T) token "
+                f"batches; dataset {cfg.data.dataset!r} has no sequence "
+                "dim to shard"
+            )
+        if strategy not in ("single", "dp", "zero"):
+            raise ValueError(
+                "mesh.seq > 1 needs the compiler-sharded step (single/"
+                f"dp/zero): ring attention's nested shard_map cannot "
+                f"live inside strategy {strategy!r}"
+            )
+        if cfg.data.seq_len % mesh.shape[AXIS_SEQ]:
+            # the loader would silently fall back to batch-only
+            # sharding while zero's jit demands seq-sharded batches
+            raise ValueError(
+                f"seq_len {cfg.data.seq_len} not divisible by mesh.seq "
+                f"{mesh.shape[AXIS_SEQ]}"
+            )
+        if cfg.model.extra.get("attn_impl") != "ring":
+            logging.getLogger(__name__).warning(
+                "mesh.seq=%d but model.extra.attn_impl != 'ring': XLA "
+                "will all-gather the sequence dim around attention "
+                "instead of running the KV ring — correct but slow",
+                mesh.shape[AXIS_SEQ],
+            )
     if cfg.xent_chunk:
         if strategy not in ("single", "dp", "dp_explicit", "zero"):
             raise ValueError(

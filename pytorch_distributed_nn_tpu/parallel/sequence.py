@@ -107,6 +107,10 @@ def _ring_attention_xla(q, k, v, *, axis: str = AXIS_SEQ,
     m0 = jnp.full((B, H, Tl, 1), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((B, H, Tl, 1), jnp.float32)
     acc0 = jnp.zeros((B, Tl, H, D), jnp.float32)
+    # fresh zeros are unvarying over the mesh; the scan carry becomes
+    # device-varying after one block update, so mark the initials
+    # varying up front or check_vma rejects the carry type change
+    m0, l0, acc0 = (lax.pvary(t, axis) for t in (m0, l0, acc0))
     # s-1 rotate-after-use rounds in the scan, then the last held block
     # outside it: the final rotation's output is never read, so don't
     # pay its 2 ppermutes of full KV shards.
@@ -144,6 +148,9 @@ def _ring_fused_impl(q, k, v, axis: str, causal: bool, interpret: bool):
     m0 = jnp.full((B * H, Tl, STAT_LANES), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((B * H, Tl, STAT_LANES), jnp.float32)
     acc0 = jnp.zeros((B * H, Tl, D), jnp.float32)
+    # see _ring_attention_xla: initials must be device-varying for the
+    # scan carry to type-check under check_vma
+    m0, l0, acc0 = (lax.pvary(t, axis) for t in (m0, l0, acc0))
 
     def step(carry, i):
         k_blk, v_blk, m, l, acc = carry

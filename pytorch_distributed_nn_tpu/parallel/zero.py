@@ -78,7 +78,15 @@ def make_zero_train_step(mesh: Mesh, loss_fn: Callable, *, stage: int = 3):
     declaratively as shardings')."""
     if stage not in (0, 1, 3):
         raise ValueError(f"zero_stage must be 0, 1 or 3, got {stage}")
-    batch_sh = NamedSharding(mesh, batch_pspec())
+    from pytorch_distributed_nn_tpu.runtime.mesh import AXIS_SEQ
+
+    # under sequence parallelism the (B, T) token batches arrive
+    # seq-sharded from the loader; the jit contract must match or the
+    # compiler would reshard (all-gathering the sequence) at entry
+    seq = mesh.shape.get(AXIS_SEQ, 1)
+    batch_sh = NamedSharding(
+        mesh, batch_pspec(AXIS_SEQ) if seq > 1 else batch_pspec()
+    )
 
     def step(state: TrainState, x, y):
         loss, new_model_state, grads = dp._loss_and_grads(

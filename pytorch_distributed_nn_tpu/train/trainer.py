@@ -64,6 +64,10 @@ class Trainer:
         self.mesh = mesh if mesh is not None else make_mesh(
             cfg.mesh.resolve(len(jax.devices()))
         )
+        # sequence parallelism: model-level ring attention builds its
+        # nested shard_map against the ambient mesh — scoped per call
+        # (a process-global set_mesh would leak into unrelated code)
+        self._seq_parallel = self.mesh.shape.get("seq", 1) > 1
         self.dataset = get_dataset(
             cfg.data.dataset,
             seed=cfg.seed,
@@ -78,6 +82,9 @@ class Trainer:
         self.state = self._init_state()
         step_fn, place_fn = make_train_step(cfg, self.mesh, self.loss_fn,
                                             model=self.model)
+        if self._seq_parallel:
+            step_fn = self._with_mesh(step_fn)
+            place_fn = self._with_mesh(place_fn)
         self.step_fn = step_fn
         self.state = place_fn(self.state)
         self.history: list[StepRecord] = []
@@ -98,13 +105,26 @@ class Trainer:
                 log.info("resumed from step %d (data_step %d)",
                          meta["step"], self.data_step)
 
+    def _with_mesh(self, fn):
+        """Run ``fn`` with this trainer's mesh as the ambient mesh (the
+        nested shard_map of model-level ring attention resolves against
+        it at trace time)."""
+        def wrapped(*args, **kwargs):
+            with jax.set_mesh(self.mesh):
+                return fn(*args, **kwargs)
+
+        return wrapped
+
     def _init_state(self) -> TrainState:
         cfg = self.cfg
         rng = jax.random.key(cfg.seed)
         x0, _ = self.dataset.batch(0)
         # init on one example — shapes only; keeps init cheap for big nets
+        init = self.model.init
+        if self._seq_parallel:  # ring attention traces a shard_map
+            init = self._with_mesh(init)
         with jax.default_device(jax.devices()[0]):
-            variables = self.model.init(rng, x0[:1], train=False)
+            variables = init(rng, x0[:1], train=False)
         params = variables.pop("params")
         model_state = dict(variables)
         # per-step transients (MoE aux losses / router diagnostics), not
@@ -209,6 +229,8 @@ class Trainer:
                 return loss.astype(jnp.float32), acc.astype(jnp.float32)
 
         self._eval_step = jax.jit(eval_step)
+        if self._seq_parallel:
+            self._eval_step = self._with_mesh(self._eval_step)
 
     def evaluate(self, num_batches: int | None = None) -> EvalRecord:
         """Forward-only pass over the held-out stream; returns (and
