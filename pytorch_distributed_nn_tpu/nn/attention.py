@@ -49,12 +49,14 @@ def dot_product_attention(
     dtype (MXU-friendly: bf16 operands, f32 accumulate).
 
     impl: 'xla' (fused by the compiler; required for padding masks and
-    cross-length kv), 'flash' (Pallas blockwise kernel on TPU with
-    blockwise-recompute backward), or 'auto'. Measured on v5e
-    (llama-shaped blocks, fwd+bwd): xla wins at T=1k (19.9 vs 20.4 ms),
-    flash from T=2k up (1.17x at 2k, 1.7x at 4k, 15.6x at 8k where
-    xla's (T, T) scores thrash HBM) — so 'auto' picks flash on TPU for
-    self-attention at T >= 2048 with no padding mask.
+    cross-length kv), 'flash' (Pallas kernels in both directions: the
+    streamed forward plus the two-pass lse-replay backward), or 'auto'.
+    Measured on v5e (llama-shaped blocks, fwd+bwd): xla wins at T=512,
+    ~tie at 1k (isolated A/B favors flash 1.34x; full-model bench is
+    within noise either way), flash clearly from 2k up (1.59x at 2k,
+    growing with T — xla's (T, T) scores thrash HBM from 8k) — so
+    'auto' picks flash on TPU for self-attention at T >= 2048 with no
+    padding mask.
     """
     if impl == "auto":
         impl = ("flash" if jax.default_backend() == "tpu"

@@ -41,8 +41,8 @@ def _attention_reference(q, k, v, *, causal: bool):
 STAT_LANES = 8  # minor dim of the m/l scratch (min f32 sublane tile)
 
 
-def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
-                  causal: bool, block_q: int, block_k: int):
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
+                  acc_scr, *, causal: bool, block_q: int, block_k: int):
     """One (bh, qi, kj) grid step. The kj grid dim iterates sequentially
     on TPU, so the f32 running stats (m, l, acc) live in VMEM scratch
     across k blocks: initialized at kj == 0, emitted at the last kj.
@@ -90,16 +90,22 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
 
     @pl.when(kj == nk - 1)
     def _emit():
+        m = m_scr[...][:, :1]
         l = l_scr[...][:, :1]
         o_ref[0] = (acc_scr[...] / jnp.maximum(l, 1e-30)).astype(
             o_ref.dtype
         )
+        # logsumexp per row — the softmax stat the backward kernels
+        # need to reconstruct p without a second online pass. Layout
+        # (BH, nq, block_q) with the whole (nq, block_q) plane resident:
+        # TPU block tiling rejects a (1, block_q) slice of (BH, T).
+        lse_ref[0, qi] = (m + jnp.log(jnp.maximum(l, 1e-30)))[:, 0]
 
 
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k"))
 def _flash_bhtd(q, k, v, *, causal: bool, block_q: int, block_k: int):
     """(BH, T, D) flash attention via pallas_call (K/V streamed by the
-    grid, so sequence length is not VMEM-bounded)."""
+    grid, so sequence length is not VMEM-bounded). Returns (out, lse)."""
     BH, T, D = q.shape
     grid = (BH, pl.cdiv(T, block_q), pl.cdiv(T, block_k))
     kernel = functools.partial(
@@ -127,18 +133,28 @@ def _flash_bhtd(q, k, v, *, causal: bool, block_q: int, block_k: int):
             pl.BlockSpec((1, block_k, D), kv_map,
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec(
-            (1, block_q, D), lambda bh, qi, kj: (bh, qi, 0),
-            memory_space=pltpu.VMEM,
-        ),
-        out_shape=jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+        out_specs=[
+            pl.BlockSpec((1, block_q, D), lambda bh, qi, kj: (bh, qi, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, grid[1], block_q),
+                         lambda bh, qi, kj: (bh, 0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+            jax.ShapeDtypeStruct((BH, grid[1], block_q), jnp.float32),
+        ],
         scratch_shapes=[
             pltpu.VMEM((block_q, STAT_LANES), jnp.float32),
             pltpu.VMEM((block_q, STAT_LANES), jnp.float32),
             pltpu.VMEM((block_q, D), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
-            dimension_semantics=("parallel", "parallel", "arbitrary"),
+            # qi must be 'arbitrary': the lse output block is constant
+            # in qi, and a megacore split over a parallel qi would give
+            # each core a private copy of the (nq, block_q) plane with
+            # only its own rows written — last writer wins
+            dimension_semantics=("parallel", "arbitrary", "arbitrary"),
         ),
         cost_estimate=pl.CostEstimate(
             flops=4 * BH * T * T * D,
@@ -150,14 +166,189 @@ def _flash_bhtd(q, k, v, *, causal: bool, block_q: int, block_k: int):
     )(q, k, v)
 
 
+def _bwd_recompute(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                   qi, kj, *, causal: bool, block_q: int, block_k: int):
+    """Shared recompute for both backward passes: p from the saved lse
+    and ds from the flash recurrence. Returns (q, k_blk, g_blk, p, ds)
+    in f32 — the two kernels differ only in which products they
+    accumulate from these."""
+    scale = q_ref.shape[-1] ** -0.5
+    q = q_ref[0].astype(jnp.float32)
+    k_blk = k_ref[0].astype(jnp.float32)
+    v_blk = v_ref[0].astype(jnp.float32)
+    g_blk = g_ref[0].astype(jnp.float32)
+    s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_pos = kj * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    p = jnp.exp(s - lse_ref[0, qi][:, None])
+    dp = jnp.dot(g_blk, v_blk.T, preferred_element_type=jnp.float32)
+    ds = p * (dp - delta_ref[0, qi][:, None]) * scale
+    return q, k_blk, g_blk, p, ds
+
+
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                   dq_ref, dq_scr, *, causal: bool, block_q: int,
+                   block_k: int):
+    """dq pass: fixed Q block, stream K/V blocks (same grid shape and
+    causal DMA clamp as the forward). p is reconstructed from the
+    forward's lse, so no online-softmax rescan is needed."""
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    nk = pl.num_programs(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        dq_scr[...] = jnp.zeros_like(dq_scr)
+
+    live = (kj * block_k <= (qi + 1) * block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _body():
+        _, k_blk, _, _, ds = _bwd_recompute(
+            q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, qi, kj,
+            causal=causal, block_q=block_q, block_k=block_k,
+        )
+        dq_scr[...] += jnp.dot(ds, k_blk,
+                               preferred_element_type=jnp.float32)
+
+    @pl.when(kj == nk - 1)
+    def _emit():
+        dq_ref[0] = dq_scr[...].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_scr, dv_scr, *, causal: bool,
+                    block_q: int, block_k: int):
+    """dk/dv pass: fixed K/V block, stream Q blocks (roles swapped —
+    the accumulators live with the K/V tile)."""
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+    nq = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
+
+    # causal: Q blocks entirely before this K block see none of it
+    live = ((qi + 1) * block_q - 1 >= kj * block_k) if causal else True
+
+    @pl.when(live)
+    def _body():
+        q, _, g_blk, p, ds = _bwd_recompute(
+            q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, qi, kj,
+            causal=causal, block_q=block_q, block_k=block_k,
+        )
+        dv_scr[...] += jnp.dot(p.T, g_blk,
+                               preferred_element_type=jnp.float32)
+        dk_scr[...] += jnp.dot(ds.T, q,
+                               preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _emit():
+        dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
+        dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k"))
+def _flash_bwd_pallas(q, k, v, g, lse, delta, *, causal: bool,
+                      block_q: int, block_k: int):
+    """(dq, dk, dv) via the two-pass Pallas backward."""
+    BH, T, D = q.shape
+    nq = pl.cdiv(T, block_q)
+    nk = pl.cdiv(T, block_k)
+
+    q_map = lambda bh, qi, kj: (bh, qi, 0)  # noqa: E731
+    # stats: whole (nq, block_q) plane resident (128 KB f32 at T=32k)
+    stat_map = lambda bh, qi, kj: (bh, 0, 0)  # noqa: E731
+    stat_block = (1, nq, block_q)
+    if causal:
+        def kv_map(bh, qi, kj):
+            last_live = ((qi + 1) * block_q - 1) // block_k
+            return (bh, jnp.minimum(kj, last_live), 0)
+    else:
+        def kv_map(bh, qi, kj):
+            return (bh, kj, 0)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=(BH, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), q_map, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, D), kv_map, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, D), kv_map, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, D), q_map, memory_space=pltpu.VMEM),
+            pl.BlockSpec(stat_block, stat_map, memory_space=pltpu.VMEM),
+            pl.BlockSpec(stat_block, stat_map, memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, D), q_map,
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+    )(q, k, v, g, lse, delta)
+
+    # dk/dv pass: grid iterates Q blocks innermost for a fixed K block
+    kv_fix = lambda bh, kj, qi: (bh, kj, 0)  # noqa: E731
+    stat_fix = lambda bh, kj, qi: (bh, 0, 0)  # noqa: E731
+    if causal:
+        def q_stream(bh, kj, qi):
+            first_live = (kj * block_k) // block_q
+            return (bh, jnp.maximum(qi, first_live), 0)
+    else:
+        q_stream = lambda bh, kj, qi: (bh, qi, 0)  # noqa: E731
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, causal=causal,
+                          block_q=block_q, block_k=block_k),
+        grid=(BH, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, block_q, D), q_stream,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, D), kv_fix, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, D), kv_fix, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_q, D), q_stream,
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(stat_block, stat_fix, memory_space=pltpu.VMEM),
+            pl.BlockSpec(stat_block, stat_fix, memory_space=pltpu.VMEM),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, D), kv_fix, memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, block_k, D), kv_fix, memory_space=pltpu.VMEM),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, T, D), k.dtype),
+            jax.ShapeDtypeStruct((BH, T, D), v.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, D), jnp.float32),
+            pltpu.VMEM((block_k, D), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        ),
+    )(q, k, v, g, lse, delta)
+    return dq, dk, dv
+
+
 def _flash_bwd_blockwise(q, k, v, o, g, *, causal: bool,
                          block_q: int = 128):
-    """Flash-attention backward, blockwise over Q: the standard
-    recompute recurrence (dv = pᵀ·dO; ds = p∘(dO·vᵀ − Δ); dq = ds·k;
-    dk = dsᵀ·q with Δ = rowsum(dO∘O)) as a ``lax.scan`` over Q blocks.
-    Peak live memory is O(block_q × T) per (B·H) slice — never the
-    (T, T) score matrix. Inputs (BH, T, D); returns (dq, dk, dv) in the
-    input dtypes. Pure jnp, so it runs (and is tested) on CPU."""
+    """CPU-testable oracle of the backward recurrence the Pallas pair
+    (:func:`_bwd_dq_kernel` / :func:`_bwd_dkv_kernel`) implements:
+    dv = pᵀ·dO; ds = p∘(dO·vᵀ − Δ); dq = ds·k; dk = dsᵀ·q with
+    Δ = rowsum(dO∘O), blockwise over Q via ``lax.scan``. Not a
+    production path — tests/test_pallas_fallbacks.py validates this
+    math against jax AD on CPU, and scripts/validate_tpu_kernels.py
+    validates the Pallas kernels against jax AD on the chip."""
     BH, T, D = q.shape
     scale = D ** -0.5
     qf = q.astype(jnp.float32)
@@ -207,27 +398,30 @@ def _flash_bwd_blockwise(q, k, v, o, g, *, causal: bool,
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def _flash_diff(qb, kb, vb, causal, block_q, block_k):
-    """Differentiable wrapper: Pallas forward, blockwise-recompute
-    backward (:func:`_flash_bwd_blockwise`) — neither direction ever
-    materializes the (T, T) score matrix, and AD never touches the
-    pallas_call."""
-    return _flash_bhtd(qb, kb, vb, causal=causal, block_q=block_q,
-                       block_k=block_k)
+    """Differentiable wrapper: Pallas forward, Pallas two-pass backward
+    (dq; dk/dv) reconstructing p from the forward's saved lse — neither
+    direction ever materializes the (T, T) score matrix, and AD never
+    touches a pallas_call."""
+    out, _ = _flash_bhtd(qb, kb, vb, causal=causal, block_q=block_q,
+                         block_k=block_k)
+    return out
 
 
 def _flash_diff_fwd(qb, kb, vb, causal, block_q, block_k):
-    out = _flash_bhtd(qb, kb, vb, causal=causal, block_q=block_q,
-                      block_k=block_k)
-    return out, (qb, kb, vb, out)
+    out, lse = _flash_bhtd(qb, kb, vb, causal=causal, block_q=block_q,
+                           block_k=block_k)
+    return out, (qb, kb, vb, out, lse)
 
 
 def _flash_diff_bwd(causal, block_q, block_k, res, g):
-    qb, kb, vb, out = res
-    # honor the caller's block_q ceiling (it is the memory knob: the
-    # backward materializes (BH, block_q, T) intermediates)
-    bq = _pick_block(qb.shape[1], block_q) or block_q
-    return _flash_bwd_blockwise(qb, kb, vb, out, g, causal=causal,
-                                block_q=bq)
+    qb, kb, vb, out, lse = res
+    BH, T, _ = qb.shape
+    delta = jnp.sum(out.astype(jnp.float32) * g.astype(jnp.float32), -1)
+    delta = delta.reshape(BH, T // block_q, block_q)  # lse's layout
+    return _flash_bwd_pallas(
+        qb, kb, vb, g.astype(qb.dtype), lse, delta,
+        causal=causal, block_q=block_q, block_k=block_k,
+    )
 
 
 _flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
@@ -247,8 +441,8 @@ def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 512,
                     block_k: int = 512):
     """(B, T, H, D) attention. KV heads must already be expanded to match
     Q heads (the caller handles GQA). Falls back to the jnp reference off
-    TPU. Differentiable: backward is flash-style recompute through the
-    jnp schedule."""
+    TPU. Differentiable: the backward is the Pallas two-pass kernel pair
+    (dq, then dk/dv) replaying p from the forward's saved lse."""
     B, T, H, D = q.shape
     if k.shape[2] != H:
         raise ValueError(
