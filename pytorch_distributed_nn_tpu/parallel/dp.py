@@ -33,6 +33,7 @@ from pytorch_distributed_nn_tpu.runtime.mesh import (
     AXIS_DATA,
     AXIS_FSDP,
     batch_pspec,
+    global_device_put,
 )
 from pytorch_distributed_nn_tpu.train.state import TrainState
 
@@ -177,4 +178,6 @@ def replicate_state(state: TrainState, mesh: Mesh) -> TrainState:
     """Initial parameter broadcast — the reference's rank-0 ``broadcast``
     at DDP construction (SURVEY.md §3.1). SPMD form: place every leaf
     with a fully-replicated sharding."""
-    return jax.device_put(state, NamedSharding(mesh, P()))
+    return global_device_put(
+        state, jax.tree.map(lambda _: NamedSharding(mesh, P()), state)
+    )

@@ -41,6 +41,7 @@ from pytorch_distributed_nn_tpu.config import TrainConfig
 from pytorch_distributed_nn_tpu.runtime.mesh import (
     AXIS_PIPE,
     batch_pspec,
+    global_device_put,
 )
 from pytorch_distributed_nn_tpu.train.state import TrainState
 
@@ -308,7 +309,7 @@ def make_pipeline_train_step(cfg: TrainConfig, mesh: Mesh,
             model_state=state.model_state, rng=state.rng,
         )
         sh = shardings_of(state)
-        placed = jax.device_put(state, sh)
+        placed = global_device_put(state, sh)
         compiled["step"] = jax.jit(
             step,
             in_shardings=(sh, batch_sh, batch_sh),

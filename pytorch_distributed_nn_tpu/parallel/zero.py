@@ -38,6 +38,7 @@ from pytorch_distributed_nn_tpu.runtime.mesh import (
     AXIS_FSDP,
     AXIS_TENSOR,
     batch_pspec,
+    global_device_put,
 )
 from pytorch_distributed_nn_tpu.train.state import TrainState
 
@@ -101,7 +102,7 @@ def make_zero_train_step(mesh: Mesh, loss_fn: Callable, *, stage: int = 3):
 
     def place_state(state: TrainState) -> TrainState:
         shardings = state_shardings(state, mesh, stage=stage)
-        placed = jax.device_put(state, shardings)
+        placed = global_device_put(state, shardings)
         compiled["step"] = jax.jit(
             step,
             in_shardings=(shardings, batch_sh, batch_sh),

@@ -26,6 +26,7 @@ import math
 from typing import Sequence
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, AbstractMesh, PartitionSpec as P
 
@@ -191,6 +192,34 @@ def make_mesh(
         )
         dev_array = np.asarray(devices, dtype=object).reshape(spec.shape)
     return Mesh(dev_array, AXES)
+
+
+def global_device_put(tree, shardings):
+    """``jax.device_put`` that also works under multi-process: a
+    multi-host NamedSharding cannot be device_put directly (non-
+    addressable devices), so each process materializes only its
+    addressable shards via ``make_array_from_callback``. Correct for
+    values that are identical on every process (deterministic seeded
+    init, restored checkpoints) — the per-process host value is the
+    global value."""
+    if jax.process_count() == 1:
+        return jax.device_put(tree, shardings)
+
+    def put(x, sh):
+        is_key = (hasattr(x, "dtype")
+                  and jnp.issubdtype(x.dtype, jax.dtypes.prng_key))
+        if is_key:
+            impl = jax.random.key_impl(x)
+            x = jax.random.key_data(x)
+        host = np.asarray(jax.device_get(x))
+        out = jax.make_array_from_callback(
+            host.shape, sh, lambda idx: host[idx]
+        )
+        if is_key:
+            out = jax.random.wrap_key_data(out, impl=impl)
+        return out
+
+    return jax.tree.map(put, tree, shardings)
 
 
 def make_abstract_mesh(spec: MeshSpec, n_devices: int) -> AbstractMesh:

@@ -123,7 +123,10 @@ class Trainer:
         init = self.model.init
         if self._seq_parallel:  # ring attention traces a shard_map
             init = self._with_mesh(init)
-        with jax.default_device(jax.devices()[0]):
+        # local_devices: under multi-process jax.devices()[0] is rank
+        # 0's device — non-addressable elsewhere (and segfaults CPU
+        # backends when used as default_device on other ranks)
+        with jax.default_device(jax.local_devices()[0]):
             variables = init(rng, x0[:1], train=False)
         params = variables.pop("params")
         model_state = dict(variables)
