@@ -59,6 +59,14 @@ class ParallelConfig:
     overlap: bool = True
     zero_stage: int = 3  # 1 = optimizer-state shard; 3 = params too
     microbatches: int = 1  # pipeline microbatching
+    # gradient accumulation (single/dp/zero): split the global batch into
+    # this many sequentially-scanned microbatches per optimizer step —
+    # ~grad_accum× lower peak activation memory. Identical math to
+    # accum=1 for deterministic stateless models; dropout masks are
+    # re-drawn per microbatch and BatchNorm stats update per microbatch
+    # (torch-accumulation-loop semantics), so those curves differ
+    # slightly from the one-shot step
+    grad_accum: int = 1
     # Only "gpipe" exists: the backward schedule is AD-derived (the scan
     # transpose IS the reverse fill-drain), so a manually interleaved
     # 1F1B would be a different construction, not a flag.

@@ -94,6 +94,21 @@ def make_train_step(
                 "would defeat the memory bound"
             )
         loss_fn = make_chunked_loss(cfg.xent_chunk)
+    accum = cfg.parallel.grad_accum
+    if accum < 1:
+        raise ValueError(f"parallel.grad_accum must be >= 1, got {accum}")
+    if accum > 1:
+        if strategy not in ("single", "dp", "zero"):
+            raise ValueError(
+                f"grad_accum needs the compiler-sharded step (single/dp/"
+                f"zero), got strategy {strategy!r} (pipeline microbatches "
+                "its own schedule via parallel.microbatches)"
+            )
+        if cfg.data.batch_size % accum:
+            raise ValueError(
+                f"batch_size {cfg.data.batch_size} not divisible by "
+                f"grad_accum {accum}"
+            )
     if strategy in ("single", "dp"):
         if cfg.parallel.quantized_allreduce:
             logging.getLogger(__name__).warning(
@@ -101,7 +116,7 @@ def make_train_step(
                 "(the compiler-sharded 'dp' path owns its own collectives) "
                 "— ignoring"
             )
-        return dp.make_dp_train_step(mesh, loss_fn)
+        return dp.make_dp_train_step(mesh, loss_fn, accum=accum)
     if strategy == "dp_explicit":
         quant = cfg.parallel.quantized_allreduce
         if quant.lower() in ("true", "1", "yes", "on"):  # legacy bool flag
@@ -129,7 +144,7 @@ def make_train_step(
         from pytorch_distributed_nn_tpu.parallel import zero
 
         return zero.make_zero_train_step(
-            mesh, loss_fn, stage=cfg.parallel.zero_stage
+            mesh, loss_fn, stage=cfg.parallel.zero_stage, accum=accum
         )
     if strategy == "pipeline":
         from pytorch_distributed_nn_tpu.parallel import pipeline

@@ -95,7 +95,7 @@ def _loss_and_grads(state, x, y, loss_fn):
     return loss, new_model_state, grads
 
 
-def make_dp_train_step(mesh: Mesh, loss_fn: Callable):
+def make_dp_train_step(mesh: Mesh, loss_fn: Callable, *, accum: int = 1):
     """Compiler-sharded DP step: ``(step, place_state)``.
 
     Sharding contract: TrainState replicated over the data axes (TP rules
@@ -104,11 +104,12 @@ def make_dp_train_step(mesh: Mesh, loss_fn: Callable):
     params make XLA emit exactly one all-reduce per parameter (fused and
     overlapped by the async-collective scheduler). Implemented as
     ZeRO-stage-0 — DP is the layout special case, not a separate code
-    path.
+    path. ``accum``: gradient-accumulation microbatches (see
+    zero.make_zero_train_step).
     """
     from pytorch_distributed_nn_tpu.parallel import zero
 
-    return zero.make_zero_train_step(mesh, loss_fn, stage=0)
+    return zero.make_zero_train_step(mesh, loss_fn, stage=0, accum=accum)
 
 
 def make_dp_train_step_explicit(

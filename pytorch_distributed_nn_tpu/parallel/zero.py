@@ -26,6 +26,7 @@ from __future__ import annotations
 from typing import Callable
 
 import jax
+import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from pytorch_distributed_nn_tpu.parallel import dp
@@ -73,21 +74,61 @@ def state_shardings(state: TrainState, mesh: Mesh, *, stage: int = 3):
     )
 
 
-def make_zero_train_step(mesh: Mesh, loss_fn: Callable, *, stage: int = 3):
+def _split_microbatches(x, accum: int, n_shards: int, micro_sh):
+    """(B, ...) → (accum, B/accum, ...) for the accumulation scan.
+
+    The averaged gradient is invariant to which examples form a
+    microbatch (mean of equal-sized microbatch-mean grads == global
+    mean), so the split is chosen for *layout*: each of the ``n_shards``
+    devices contributes the a-th sub-block of its local batch shard to
+    microbatch a, making the reshape purely local — no resharding
+    collective at step entry. Falls back to contiguous chunks (same
+    math, one input reshard) when B doesn't divide that way.
+    """
+    B = x.shape[0]
+    if B % accum:
+        raise ValueError(
+            f"global batch {B} not divisible by grad_accum {accum}"
+        )
+    rest = x.shape[1:]
+    if B % (accum * n_shards) == 0:
+        m = x.reshape(n_shards, accum, B // (accum * n_shards), *rest)
+        m = jnp.moveaxis(m, 1, 0).reshape(accum, B // accum, *rest)
+    else:
+        m = x.reshape(accum, B // accum, *rest)
+    return jax.lax.with_sharding_constraint(m, micro_sh)
+
+
+def make_zero_train_step(mesh: Mesh, loss_fn: Callable, *, stage: int = 3,
+                         accum: int = 1):
     """Returns (step, place_state). The step body is identical to DP —
     sharded DP is purely a layout change (SURVEY.md §3.4 'expressed
-    declaratively as shardings')."""
+    declaratively as shardings').
+
+    ``accum > 1`` runs gradient accumulation: the global batch is split
+    into ``accum`` microbatches scanned sequentially (``lax.scan``),
+    per-microbatch grads summed in f32, one optimizer step on the mean.
+    Peak activation memory drops ~accum×. For deterministic stateless
+    models the gradient is the same global-batch mean the accum=1 step
+    computes; dropout models re-draw masks per microbatch and BatchNorm
+    stats update sequentially per microbatch (the same semantics as a
+    torch accumulation loop), which differs slightly from one full-batch
+    step.
+    """
     if stage not in (0, 1, 3):
         raise ValueError(f"zero_stage must be 0, 1 or 3, got {stage}")
-    from pytorch_distributed_nn_tpu.runtime.mesh import AXIS_SEQ
+    if accum < 1:
+        raise ValueError(f"accum must be >= 1, got {accum}")
+    from pytorch_distributed_nn_tpu.runtime.mesh import AXIS_SEQ, data_axis_size
 
     # under sequence parallelism the (B, T) token batches arrive
     # seq-sharded from the loader; the jit contract must match or the
     # compiler would reshard (all-gathering the sequence) at entry
     seq = mesh.shape.get(AXIS_SEQ, 1)
-    batch_sh = NamedSharding(
-        mesh, batch_pspec(AXIS_SEQ) if seq > 1 else batch_pspec()
-    )
+    batch_spec = batch_pspec(AXIS_SEQ) if seq > 1 else batch_pspec()
+    batch_sh = NamedSharding(mesh, batch_spec)
+    micro_sh = NamedSharding(mesh, P(None, *batch_spec))
+    n_shards = data_axis_size(mesh)
 
     def step(state: TrainState, x, y):
         loss, new_model_state, grads = dp._loss_and_grads(
@@ -97,6 +138,46 @@ def make_zero_train_step(mesh: Mesh, loss_fn: Callable, *, stage: int = 3):
             model_state=new_model_state
         )
         return new_state, {"loss": loss}
+
+    def step_accum(state: TrainState, x, y):
+        mx = _split_microbatches(x, accum, n_shards, micro_sh)
+        my = _split_microbatches(y, accum, n_shards, micro_sh)
+
+        def body(carry, inp):
+            model_state, gsum, lsum = carry
+            i, bx, by = inp
+            # decorrelate the per-microbatch dropout stream (forward
+            # folds state.step on top, decorrelating across steps)
+            fwd_state = state.replace(
+                model_state=model_state,
+                rng=jax.random.fold_in(state.rng, i),
+            )
+            loss, new_model_state, grads = dp._loss_and_grads(
+                fwd_state, bx, by, loss_fn
+            )
+            gsum = jax.tree.map(
+                lambda a, g: a + g.astype(jnp.float32), gsum, grads
+            )
+            return (new_model_state, gsum, lsum + loss), None
+
+        zeros = jax.tree.map(
+            lambda p: jnp.zeros(p.shape, jnp.float32), state.params
+        )
+        (new_model_state, gsum, lsum), _ = jax.lax.scan(
+            body,
+            (state.model_state, zeros, jnp.zeros((), jnp.float32)),
+            (jnp.arange(accum), mx, my),
+        )
+        grads = jax.tree.map(
+            lambda a, p: (a / accum).astype(p.dtype), gsum, state.params
+        )
+        new_state = state.apply_gradients(grads).replace(
+            model_state=new_model_state
+        )
+        return new_state, {"loss": lsum / accum}
+
+    if accum > 1:
+        step = step_accum
 
     compiled: dict = {}
 

@@ -1,0 +1,209 @@
+"""PyTorch ↔ framework weight interop.
+
+The reference's users hold `state_dict()` checkpoints (torch `nn.Module`
+weights — SURVEY.md §5 "Checkpoint / resume" row names `torch.save/load`
+as the reference's only persistence). Migration therefore needs a weight
+bridge, not just an API map (docs/migration.md): these converters move
+weights between torch layouts and this framework's flax param trees.
+
+Conventions bridged:
+
+- torch ``nn.Linear.weight`` is ``(out, in)``; flax ``Dense.kernel`` is
+  ``(in, out)`` — transposed.
+- attention projections here are ``DenseGeneral`` kernels shaped
+  ``(d_model, heads, head_dim)`` (q/k/v) and ``(heads, head_dim,
+  d_model)`` (out); torch/HF fuse heads into one matrix row dim.
+- rotary halves: both sides use the split-half convention (HF
+  ``rotate_half``; :func:`..nn.attention.rotary_embedding`), so q/k need
+  **no** permutation — weights map 1:1.
+
+torch is imported lazily: the framework itself never depends on it, the
+bridge only needs it when called (and accepts numpy-valued state dicts
+too, e.g. one loaded on a host without torch).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Mapping
+
+import numpy as np
+
+
+def to_numpy(x) -> np.ndarray:
+    """torch tensor | numpy array → numpy (detached, CPU, contiguous)."""
+    if hasattr(x, "detach"):  # torch tensor without importing torch
+        x = x.detach().cpu().numpy()
+    return np.ascontiguousarray(x)
+
+
+def linear_kernel(weight) -> np.ndarray:
+    """torch Linear weight (out, in) → flax Dense kernel (in, out)."""
+    return to_numpy(weight).T
+
+
+def _heads_in_kernel(weight, heads: int, head_dim: int) -> np.ndarray:
+    """(H*Dh, D) q/k/v projection → DenseGeneral kernel (D, H, Dh)."""
+    w = to_numpy(weight)
+    d_model = w.shape[1]
+    return w.T.reshape(d_model, heads, head_dim)
+
+
+def _heads_out_kernel(weight, heads: int, head_dim: int) -> np.ndarray:
+    """(D, H*Dh) out projection → DenseGeneral kernel (H, Dh, D)."""
+    w = to_numpy(weight)
+    d_model = w.shape[0]
+    return w.T.reshape(heads, head_dim, d_model)
+
+
+def llama_params_from_torch(
+    state_dict: Mapping[str, Any],
+    *,
+    num_layers: int,
+    num_heads: int,
+    num_kv_heads: int,
+) -> dict:
+    """HF ``LlamaForCausalLM.state_dict()`` → params for models/llama.py.
+
+    Key layout bridged (HF side): ``model.embed_tokens``, per layer
+    ``model.layers.{i}.{input_layernorm, self_attn.{q,k,v,o}_proj,
+    post_attention_layernorm, mlp.{gate,up,down}_proj}``, ``model.norm``,
+    ``lm_head`` (untied, as Llama-3 ships). Raises KeyError on missing
+    keys — a truncated checkpoint should fail loudly, not half-load.
+    """
+    sd = state_dict
+    consumed: set[str] = set()
+
+    class _Tracking:
+        def __getitem__(self, key):
+            consumed.add(key)
+            return sd[key]
+
+        def get(self, key, default=None):
+            if key in sd:
+                consumed.add(key)
+                return sd[key]
+            return default
+
+    tracked = _Tracking()
+    embed = to_numpy(tracked["model.embed_tokens.weight"])  # (V, D)
+    d_model = embed.shape[1]
+    if d_model % num_heads:
+        raise ValueError(f"d_model {d_model} % num_heads {num_heads} != 0")
+    head_dim = d_model // num_heads
+
+    params: dict = {"tok_embed": {"embedding": embed}}
+    for i in range(num_layers):
+        p = f"model.layers.{i}."
+        params[f"layer{i}"] = {
+            "attn_norm": {"scale": to_numpy(
+                tracked[p + "input_layernorm.weight"])},
+            "attn": {
+                "query": {"kernel": _heads_in_kernel(
+                    tracked[p + "self_attn.q_proj.weight"], num_heads,
+                    head_dim)},
+                "key": {"kernel": _heads_in_kernel(
+                    tracked[p + "self_attn.k_proj.weight"], num_kv_heads,
+                    head_dim)},
+                "value": {"kernel": _heads_in_kernel(
+                    tracked[p + "self_attn.v_proj.weight"], num_kv_heads,
+                    head_dim)},
+                "out": {"kernel": _heads_out_kernel(
+                    tracked[p + "self_attn.o_proj.weight"], num_heads,
+                    head_dim)},
+            },
+            "mlp_norm": {"scale": to_numpy(
+                tracked[p + "post_attention_layernorm.weight"])},
+            "gate_proj": {"kernel": linear_kernel(
+                tracked[p + "mlp.gate_proj.weight"])},
+            "up_proj": {"kernel": linear_kernel(
+                tracked[p + "mlp.up_proj.weight"])},
+            "down_proj": {"kernel": linear_kernel(
+                tracked[p + "mlp.down_proj.weight"])},
+        }
+    params["final_norm"] = {"scale": to_numpy(tracked["model.norm.weight"])}
+    lm_head = tracked.get("lm_head.weight")
+    if lm_head is None:  # tied-embedding checkpoints (llama-2 style)
+        lm_head = embed
+    params["lm_head"] = {"kernel": to_numpy(lm_head).T}
+
+    # Fail loudly on anything the layout above didn't consume (e.g.
+    # attention biases from a Qwen-style attention_bias=True checkpoint):
+    # silently dropping learned tensors would produce wrong logits with
+    # no error. Non-learned rotary buffers are the one known exception.
+    leftover = [k for k in sd if k not in consumed
+                and "rotary_emb" not in k]
+    if leftover:
+        raise ValueError(
+            f"state_dict tensors the llama3 layout does not map "
+            f"(model variant mismatch?): {sorted(leftover)[:8]}"
+        )
+    return params
+
+
+def llama_params_to_torch(params: Mapping[str, Any]) -> dict:
+    """Inverse of :func:`llama_params_from_torch`: params →
+    HF-layout state dict of torch tensors."""
+    import torch
+
+    def t(a):
+        return torch.from_numpy(np.ascontiguousarray(np.asarray(a)))
+
+    out = {
+        "model.embed_tokens.weight": t(params["tok_embed"]["embedding"]),
+        "model.norm.weight": t(params["final_norm"]["scale"]),
+        "lm_head.weight": t(np.asarray(params["lm_head"]["kernel"]).T),
+    }
+    i = 0
+    while f"layer{i}" in params:
+        layer = params[f"layer{i}"]
+        p = f"model.layers.{i}."
+        attn = layer["attn"]
+        d_model = np.asarray(attn["query"]["kernel"]).shape[0]
+
+        def fuse_in(kernel):  # (D, H, Dh) → (H*Dh, D)
+            return t(np.asarray(kernel).reshape(d_model, -1).T)
+
+        out[p + "input_layernorm.weight"] = t(layer["attn_norm"]["scale"])
+        out[p + "self_attn.q_proj.weight"] = fuse_in(attn["query"]["kernel"])
+        out[p + "self_attn.k_proj.weight"] = fuse_in(attn["key"]["kernel"])
+        out[p + "self_attn.v_proj.weight"] = fuse_in(attn["value"]["kernel"])
+        out[p + "self_attn.o_proj.weight"] = t(
+            np.asarray(attn["out"]["kernel"]).reshape(-1, d_model).T
+        )
+        out[p + "post_attention_layernorm.weight"] = t(
+            layer["mlp_norm"]["scale"])
+        for name in ("gate_proj", "up_proj", "down_proj"):
+            out[p + f"mlp.{name}.weight"] = t(
+                np.asarray(layer[name]["kernel"]).T)
+        i += 1
+    return out
+
+
+def mlp_params_from_torch(state_dict: Mapping[str, Any]) -> dict:
+    """torch ``nn.Sequential`` of Linears (the reference's
+    ``Net(nn.Module)``, SURVEY.md §2a) → params for models/mlp.py.
+
+    Linear layers are taken in state-dict order (torch preserves
+    registration order), mapping the j-th Linear to ``Dense_j``. Only
+    2-D weights qualify as Linear kernels; any other weight tensor
+    (BatchNorm/LayerNorm scales are 1-D) means the module isn't the
+    plain Linear stack models/mlp.py implements — raise rather than
+    load garbage under shifted layer indices.
+    """
+    weights = [k for k in state_dict if k.endswith(".weight")]
+    non_linear = [k for k in weights
+                  if to_numpy(state_dict[k]).ndim != 2]
+    if non_linear:
+        raise ValueError(
+            f"non-Linear weight tensors {non_linear} — models/mlp.py is a "
+            "plain Linear stack; convert norm-bearing nets via a "
+            "model-specific mapping instead"
+        )
+    params: dict = {}
+    for j, wk in enumerate(weights):
+        leaf = {"kernel": linear_kernel(state_dict[wk])}
+        bk = wk[: -len(".weight")] + ".bias"
+        if bk in state_dict:
+            leaf["bias"] = to_numpy(state_dict[bk])
+        params[f"Dense_{j}"] = leaf
+    return params

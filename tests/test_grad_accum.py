@@ -1,0 +1,68 @@
+"""Gradient accumulation: accum=A on the same global batch is the same
+math as accum=1 (mean of equal-sized microbatch-mean grads == the
+global-batch mean), so the single-device/accum=1 loss curve is the
+golden oracle — same oracle DP uses (SURVEY.md §4)."""
+
+import jax
+import numpy as np
+import pytest
+
+from pytorch_distributed_nn_tpu.config import get_config
+from pytorch_distributed_nn_tpu.runtime.mesh import MeshSpec, make_mesh
+from pytorch_distributed_nn_tpu.train.trainer import Trainer
+
+STEPS = 5
+
+
+def run(accum: int, strategy: str = "dp", mesh_spec: MeshSpec | None = None,
+        **extra):
+    cfg = get_config(
+        "mlp_mnist",
+        **{"steps": str(STEPS), "log_every": "1", "data.prefetch": "0"},
+    )
+    cfg.parallel.strategy = strategy
+    cfg.parallel.grad_accum = accum
+    for key, value in extra.items():
+        cfg.override(**{key: value})
+    cfg.mesh = mesh_spec or MeshSpec(data=8)
+    mesh = make_mesh(cfg.mesh.resolve(len(jax.devices())))
+    trainer = Trainer(cfg, mesh=mesh)
+    trainer.train()
+    return np.array(trainer.losses()), trainer.state
+
+
+@pytest.fixture(scope="module")
+def oracle():
+    return run(1)
+
+
+def test_accum4_matches_accum1(oracle):
+    base_losses, base_state = oracle
+    losses, state = run(4)
+    np.testing.assert_allclose(losses, base_losses, rtol=2e-5, atol=1e-5)
+    for a, b in zip(jax.tree.leaves(base_state.params),
+                    jax.tree.leaves(state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_accum_under_zero3(oracle):
+    base_losses, _ = oracle
+    losses, _ = run(2, strategy="zero", mesh_spec=MeshSpec(fsdp=8, data=1))
+    np.testing.assert_allclose(losses, base_losses, rtol=2e-5, atol=1e-5)
+
+
+def test_accum_nondivisible_batch_rejected():
+    with pytest.raises(ValueError, match="not divisible"):
+        run(3)  # batch 128 % 3 != 0
+
+
+def test_accum_rejected_under_pipeline():
+    cfg = get_config("mlp_mnist")
+    cfg.parallel.strategy = "pipeline"
+    cfg.parallel.grad_accum = 2
+    from pytorch_distributed_nn_tpu.parallel import make_train_step
+
+    with pytest.raises(ValueError, match="grad_accum"):
+        make_train_step(cfg, make_mesh(MeshSpec(data=8).resolve(8)),
+                        lambda a, b: 0.0)
