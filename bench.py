@@ -26,6 +26,7 @@ NOMINAL = {
     "resnet50_dp": 400.0,     # ResNet-50 DDP, samples/s/GPU (V100, NCCL)
     "bert_base_buckets": 180.0,  # BERT-base pretrain phase-1 seqlen 128
     "mlp_mnist": None,
+    "lenet_cifar10": None,
     "transformer_lm_pp": None,
     "llama3_8b_zero": None,
     "moe_lm_ep": None,
@@ -37,6 +38,7 @@ PER_CHIP_BATCH = {
     "resnet50_dp": 128,  # measured optimum on v5e (2528 vs 2477 @ 256)
     "bert_base_buckets": 128,
     "mlp_mnist": 1024,
+    "lenet_cifar10": 512,
     "transformer_lm_pp": 8,
     "llama3_8b_zero": 1,
     "moe_lm_ep": 8,

@@ -19,7 +19,7 @@ from pytorch_distributed_nn_tpu.runtime.mesh import MeshSpec
 
 @dataclass
 class OptimConfig:
-    name: str = "sgd"  # sgd | momentum | adam | adamw
+    name: str = "sgd"  # sgd | momentum | adam | adamw | adafactor | lamb | lion
     lr: float = 0.01
     momentum: float = 0.9
     weight_decay: float = 0.0
@@ -159,6 +159,20 @@ def _mlp_mnist() -> TrainConfig:
     )
 
 
+def _lenet_cifar10() -> TrainConfig:
+    # The reference's classic small-net config (SURVEY.md §2a Models row
+    # [R]: "LeNet-ish CNN on MNIST/CIFAR-10") — not one of the five
+    # BASELINE configs, kept as a named preset for parity breadth.
+    return TrainConfig(
+        preset="lenet_cifar10",
+        steps=200,
+        optim=OptimConfig(name="momentum", lr=0.05),
+        data=DataConfig(dataset="cifar10", batch_size=128),
+        model=ModelConfig(name="lenet", compute_dtype="float32"),
+        parallel=ParallelConfig(strategy="dp"),
+    )
+
+
 def _resnet50_dp() -> TrainConfig:
     # Config 2: "ResNet-50 / ImageNet, pure data-parallel DDP allreduce".
     return TrainConfig(
@@ -265,6 +279,7 @@ def _moe_lm_ep() -> TrainConfig:
 
 PRESETS = {
     "mlp_mnist": _mlp_mnist,
+    "lenet_cifar10": _lenet_cifar10,
     "moe_lm_ep": _moe_lm_ep,
     "llama3_longcontext": _llama3_longcontext,
     "resnet50_dp": _resnet50_dp,

@@ -47,6 +47,18 @@ def make_optimizer(cfg: OptimConfig,
     elif cfg.name == "adamw":
         opt = optax.adamw(schedule, b1=cfg.b1, b2=cfg.b2, eps=cfg.eps,
                           weight_decay=cfg.weight_decay)
+    elif cfg.name == "adafactor":
+        # The TPU-native memory-factored optimizer (Shazeer & Stern): 2nd
+        # moments stored as row/col factors, O(n+m) not O(nm) state per
+        # matrix — what makes billion-param training fit without ZeRO.
+        opt = optax.adafactor(schedule,
+                              weight_decay_rate=cfg.weight_decay or None)
+    elif cfg.name == "lamb":
+        opt = optax.lamb(schedule, b1=cfg.b1, b2=cfg.b2, eps=cfg.eps,
+                         weight_decay=cfg.weight_decay)
+    elif cfg.name == "lion":
+        opt = optax.lion(schedule, b1=cfg.b1, b2=cfg.b2,
+                         weight_decay=cfg.weight_decay)
     else:
         raise ValueError(f"unknown optimizer {cfg.name!r}")
 

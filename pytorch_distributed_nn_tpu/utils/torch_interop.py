@@ -48,6 +48,33 @@ def _heads_in_kernel(weight, heads: int, head_dim: int) -> np.ndarray:
     return w.T.reshape(d_model, heads, head_dim)
 
 
+class _TrackingDict:
+    """Read-through view of a state_dict that records consumed keys, so
+    converters can fail loudly on tensors their layout never mapped."""
+
+    def __init__(self, sd: Mapping[str, Any]):
+        self.sd = sd
+        self.consumed: set[str] = set()
+
+    def __getitem__(self, key):
+        self.consumed.add(key)
+        return self.sd[key]
+
+    def get(self, key, default=None):
+        if key in self.sd:
+            return self[key]
+        return default
+
+    def check_consumed(self, ignorable: tuple[str, ...]) -> None:
+        leftover = [k for k in self.sd if k not in self.consumed
+                    and not any(frag in k for frag in ignorable)]
+        if leftover:
+            raise ValueError(
+                f"state_dict tensors this layout does not map (model "
+                f"variant mismatch?): {sorted(leftover)[:8]}"
+            )
+
+
 def _heads_out_kernel(weight, heads: int, head_dim: int) -> np.ndarray:
     """(D, H*Dh) out projection → DenseGeneral kernel (H, Dh, D)."""
     w = to_numpy(weight)
@@ -70,21 +97,7 @@ def llama_params_from_torch(
     ``lm_head`` (untied, as Llama-3 ships). Raises KeyError on missing
     keys — a truncated checkpoint should fail loudly, not half-load.
     """
-    sd = state_dict
-    consumed: set[str] = set()
-
-    class _Tracking:
-        def __getitem__(self, key):
-            consumed.add(key)
-            return sd[key]
-
-        def get(self, key, default=None):
-            if key in sd:
-                consumed.add(key)
-                return sd[key]
-            return default
-
-    tracked = _Tracking()
+    tracked = _TrackingDict(state_dict)
     embed = to_numpy(tracked["model.embed_tokens.weight"])  # (V, D)
     d_model = embed.shape[1]
     if d_model % num_heads:
@@ -130,13 +143,7 @@ def llama_params_from_torch(
     # attention biases from a Qwen-style attention_bias=True checkpoint):
     # silently dropping learned tensors would produce wrong logits with
     # no error. Non-learned rotary buffers are the one known exception.
-    leftover = [k for k in sd if k not in consumed
-                and "rotary_emb" not in k]
-    if leftover:
-        raise ValueError(
-            f"state_dict tensors the llama3 layout does not map "
-            f"(model variant mismatch?): {sorted(leftover)[:8]}"
-        )
+    tracked.check_consumed(ignorable=("rotary_emb",))
     return params
 
 
@@ -177,6 +184,81 @@ def llama_params_to_torch(params: Mapping[str, Any]) -> dict:
                 np.asarray(layer[name]["kernel"]).T)
         i += 1
     return out
+
+
+def bert_params_from_torch(
+    state_dict: Mapping[str, Any], *, num_layers: int, num_heads: int
+) -> dict:
+    """HF ``BertForMaskedLM.state_dict()`` → params for models/bert.py.
+
+    Architectural note: models/bert.py uses flax's tanh-approximate gelu
+    (the original TF-BERT activation) — HF checkpoints configured with
+    ``hidden_act='gelu'`` (exact erf) convert fine but diverge at the
+    ~1e-3 level; ``gelu_new``/``gelu_pytorch_tanh`` checkpoints match
+    tightly. The unused pooler head (when present) is dropped — it does
+    not feed MLM logits.
+    """
+    sd = _TrackingDict(state_dict)
+    e = "bert.embeddings."
+    embed = to_numpy(sd[e + "word_embeddings.weight"])  # (V, D)
+    d_model = embed.shape[1]
+    if d_model % num_heads:
+        raise ValueError(f"d_model {d_model} % num_heads {num_heads} != 0")
+    head_dim = d_model // num_heads
+
+    def ln(prefix: str) -> dict:
+        return {"scale": to_numpy(sd[prefix + ".weight"]),
+                "bias": to_numpy(sd[prefix + ".bias"])}
+
+    def dense(prefix: str) -> dict:
+        return {"kernel": linear_kernel(sd[prefix + ".weight"]),
+                "bias": to_numpy(sd[prefix + ".bias"])}
+
+    params: dict = {
+        "tok_embed": {"embedding": embed},
+        "pos_embed": {"embedding": to_numpy(
+            sd[e + "position_embeddings.weight"])},
+        "type_embed": {"embedding": to_numpy(
+            sd[e + "token_type_embeddings.weight"])},
+        "ln_embed": ln(e + "LayerNorm"),
+    }
+    for i in range(num_layers):
+        p = f"bert.encoder.layer.{i}."
+
+        def heads_in(prefix: str) -> dict:
+            return {
+                "kernel": _heads_in_kernel(sd[prefix + ".weight"],
+                                           num_heads, head_dim),
+                "bias": to_numpy(sd[prefix + ".bias"]).reshape(
+                    num_heads, head_dim),
+            }
+
+        params[f"layer{i}"] = {
+            "attn": {
+                "query": heads_in(p + "attention.self.query"),
+                "key": heads_in(p + "attention.self.key"),
+                "value": heads_in(p + "attention.self.value"),
+                "out": {
+                    "kernel": _heads_out_kernel(
+                        sd[p + "attention.output.dense.weight"],
+                        num_heads, head_dim),
+                    "bias": to_numpy(
+                        sd[p + "attention.output.dense.bias"]),
+                },
+            },
+            "ln1": ln(p + "attention.output.LayerNorm"),
+            "mlp_in": dense(p + "intermediate.dense"),
+            "mlp_out": dense(p + "output.dense"),
+            "ln2": ln(p + "output.LayerNorm"),
+        }
+    params["mlm_dense"] = dense("cls.predictions.transform.dense")
+    params["mlm_ln"] = ln("cls.predictions.transform.LayerNorm")
+    decoder = {"kernel": to_numpy(sd["cls.predictions.decoder.weight"]).T,
+               "bias": to_numpy(sd["cls.predictions.bias"])}
+    sd.get("cls.predictions.decoder.bias")  # alias of cls.predictions.bias
+    params["mlm_decoder"] = decoder
+    sd.check_consumed(ignorable=("position_ids", "pooler"))
+    return params
 
 
 def mlp_params_from_torch(state_dict: Mapping[str, Any]) -> dict:

@@ -81,6 +81,12 @@ def test_resnet_trains():
     assert losses[-1] < losses[0]
 
 
+def test_lenet_preset_trains():
+    losses = _tiny_train("lenet_cifar10", "lenet", "cifar10", steps=6)
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
 def test_bert_mlm_trains():
     losses = _tiny_train("bert_base_buckets", "bert_base",
                          "mlm_synthetic", steps=6, seq_len=16,

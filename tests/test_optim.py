@@ -6,7 +6,8 @@ from pytorch_distributed_nn_tpu.config import OptimConfig
 from pytorch_distributed_nn_tpu.train.optim import make_optimizer, make_schedule
 
 
-@pytest.mark.parametrize("name", ["sgd", "momentum", "adam", "adamw"])
+@pytest.mark.parametrize("name", ["sgd", "momentum", "adam", "adamw",
+                                  "adafactor", "lamb", "lion"])
 def test_optimizers_step(name):
     tx = make_optimizer(OptimConfig(name=name, lr=0.1), total_steps=10)
     params = {"w": jnp.ones(4)}

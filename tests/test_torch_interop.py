@@ -82,6 +82,40 @@ def test_llama_roundtrip(tiny_llama):
                                    atol=0, err_msg=key)
 
 
+def test_bert_logits_match_hf():
+    transformers = pytest.importorskip("transformers")
+    cfg = transformers.BertConfig(
+        vocab_size=100, hidden_size=48, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=96,
+        max_position_embeddings=32, type_vocab_size=2,
+        hidden_act="gelu_new",  # tanh-approx gelu == flax nn.gelu
+        layer_norm_eps=1e-6,    # == flax nn.LayerNorm default
+        hidden_dropout_prob=0.0, attention_probs_dropout_prob=0.0,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    hf = transformers.BertForMaskedLM(cfg).eval()
+    params = ti.bert_params_from_torch(hf.state_dict(), num_layers=2,
+                                       num_heads=4)
+    model = get_model(ModelConfig(
+        name="bert_base", dtype="float32", compute_dtype="float32",
+        extra=dict(vocab_size=100, num_layers=2, d_model=48, num_heads=4,
+                   mlp_dim=96, max_len=32),
+    ))
+    tokens = np.random.default_rng(2).integers(0, 100, size=(2, 12))
+    # HF always adds the token_type-0 embedding; pass explicit zeros so
+    # our model does too
+    ours = model.apply(
+        {"params": jax.tree.map(np.asarray, params)},
+        tokens.astype(np.int32), train=False,
+        token_types=np.zeros_like(tokens, dtype=np.int32),
+    )
+    with torch.no_grad():
+        theirs = hf(torch.from_numpy(tokens)).logits.numpy()
+    np.testing.assert_allclose(np.asarray(ours), theirs, rtol=5e-4,
+                               atol=5e-4)
+
+
 def test_unmapped_tensors_fail_loudly(tiny_llama):
     sd = dict(tiny_llama.state_dict())
     # a Qwen-style attention bias the llama3 layout has no slot for
