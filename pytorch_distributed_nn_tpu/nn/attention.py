@@ -133,27 +133,35 @@ class MultiHeadAttention(nn.Module):
                 "decode mode ignores padding masks; strip padding (or "
                 "left-trim) before prefill"
             )
-        if self.impl == "ring":
+        if self.impl in ("ring", "ulysses"):
             # Sequence/context parallelism at the model level: the
             # activation's T dim is sharded over the `seq` mesh axis and
-            # attention runs as a KV ring (parallel/sequence.py) inside
-            # a nested shard_map (seq manual, other mesh axes stay
-            # auto). Requires an ambient mesh (Trainer sets it when
-            # mesh.seq > 1) and causal attention; rotary positions are
-            # global (computed from the shard's ring index).
+            # attention runs inside a nested shard_map (seq manual,
+            # other mesh axes stay auto) — either as a KV ring or as
+            # Ulysses all-to-all head-scatter (parallel/sequence.py).
+            # Requires an ambient mesh (Trainer sets it when mesh.seq >
+            # 1) and causal attention; rotary positions are global
+            # (computed from the shard's ring index) and applied before
+            # any resharding, so both schemes see identical q/k.
             if decode:
-                raise ValueError("ring attention has no decode cache; "
-                                 "generate with impl='auto'")
+                raise ValueError(
+                    f"{self.impl} attention has no decode cache; "
+                    "generate with impl='auto'"
+                )
             if not self.causal or mask is not None:
                 raise ValueError(
-                    "ring attention is causal-only and takes no mask"
+                    f"{self.impl} attention is causal-only and takes "
+                    "no mask"
                 )
             from jax.sharding import PartitionSpec as _P
 
             from pytorch_distributed_nn_tpu.parallel.sequence import (
                 ring_attention,
+                ulysses_attention,
             )
             from pytorch_distributed_nn_tpu.runtime.mesh import AXIS_SEQ
+
+            seq_impl = self.impl
 
             def attn_local(q, k, v):
                 if self.rotary:
@@ -164,6 +172,9 @@ class MultiHeadAttention(nn.Module):
                                             positions=pos)
                     q = q.astype(self.dtype)
                     k = k.astype(self.dtype)
+                if seq_impl == "ulysses":
+                    return ulysses_attention(q, k, v, axis=AXIS_SEQ,
+                                             causal=True)
                 return ring_attention(q, k, v, axis=AXIS_SEQ,
                                       causal=True)
 

@@ -69,11 +69,12 @@ def make_train_step(
                 f"seq_len {cfg.data.seq_len} not divisible by mesh.seq "
                 f"{mesh.shape[AXIS_SEQ]}"
             )
-        if cfg.model.extra.get("attn_impl") != "ring":
+        if cfg.model.extra.get("attn_impl") not in ("ring", "ulysses"):
             logging.getLogger(__name__).warning(
-                "mesh.seq=%d but model.extra.attn_impl != 'ring': XLA "
-                "will all-gather the sequence dim around attention "
-                "instead of running the KV ring — correct but slow",
+                "mesh.seq=%d but model.extra.attn_impl is not 'ring'/"
+                "'ulysses': XLA will all-gather the sequence dim around "
+                "attention instead of running the sequence-parallel "
+                "schedule — correct but slow",
                 mesh.shape[AXIS_SEQ],
             )
     if cfg.xent_chunk:

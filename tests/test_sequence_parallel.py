@@ -152,7 +152,10 @@ def test_model_level_ring_training_golden():
         return cfg
 
     ring = Trainer(cfg_for(MeshSpec(seq=4, data=2), "ring")).train()
+    # ulysses scatters kv heads (2) over seq — needs seq degree <= 2
+    ulysses = Trainer(cfg_for(MeshSpec(seq=2, data=4), "ulysses")).train()
     plain = Trainer(cfg_for(MeshSpec(seq=1, data=-1), "xla")).train()
-    assert len(ring) == len(plain) > 0
-    for a, b in zip(ring, plain):
+    assert len(ring) == len(ulysses) == len(plain) > 0
+    for a, u, b in zip(ring, ulysses, plain):
         np.testing.assert_allclose(a.loss, b.loss, rtol=2e-5)
+        np.testing.assert_allclose(u.loss, b.loss, rtol=2e-5)
