@@ -153,7 +153,9 @@ def llama_params_to_torch(params: Mapping[str, Any]) -> dict:
     import torch
 
     def t(a):
-        return torch.from_numpy(np.ascontiguousarray(np.asarray(a)))
+        # copy=True: device_get can hand back non-writable views, which
+        # torch.from_numpy rejects (undefined behavior on write)
+        return torch.from_numpy(np.array(a, copy=True))
 
     out = {
         "model.embed_tokens.weight": t(params["tok_embed"]["embedding"]),

@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""Checkpoint conversion: torch/HF weights ↔ framework checkpoints.
+
+Import (torch → here): load a ``torch.save``'d state_dict (or any
+pickle/safetensors file torch.load understands), map it onto the
+preset's model via utils/torch_interop, and write a framework checkpoint
+that ``scripts/train.py --resume`` / ``scripts/generate.py
+--checkpoint-dir`` consume directly:
+
+    python scripts/convert.py --arch llama3 --preset llama3_8b_zero \
+        --torch-checkpoint llama.pt --out runs/llama_ckpt \
+        --model.extra '{"num_layers":2,"d_model":64,...}'
+
+Export (here → torch): read the latest framework checkpoint and write an
+HF-layout state_dict torch can load:
+
+    python scripts/convert.py --arch llama3 --preset llama3_8b_zero \
+        --export runs/llama_ckpt --torch-checkpoint out.pt ...
+
+The model dims must match the weights being converted — set them via
+``--model.extra`` exactly as for training (a mismatch fails with the
+offending shapes, nothing half-loads).
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+sys.path.insert(0, ".")  # run from repo root without install
+
+from pytorch_distributed_nn_tpu.runtime.platform import (
+    apply_platform_overrides,
+)
+
+apply_platform_overrides()
+
+
+def _converted_params(arch: str, state_dict, model_cfg):
+    from pytorch_distributed_nn_tpu.utils import torch_interop as ti
+
+    e = model_cfg.extra
+    if arch == "llama3":
+        return ti.llama_params_from_torch(
+            state_dict,
+            num_layers=e.get("num_layers", 32),
+            num_heads=e.get("num_heads", 32),
+            num_kv_heads=e.get("num_kv_heads", 8),
+        )
+    if arch == "bert":
+        return ti.bert_params_from_torch(
+            state_dict,
+            num_layers=e.get("num_layers", 12),
+            num_heads=e.get("num_heads", 12),
+        )
+    if arch == "mlp":
+        return ti.mlp_params_from_torch(state_dict)
+    raise ValueError(f"unknown --arch {arch!r} (llama3 | bert | mlp)")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    ap.add_argument("--arch", required=True,
+                    choices=("llama3", "bert", "mlp"))
+    ap.add_argument("--preset", required=True)
+    ap.add_argument("--torch-checkpoint", required=True,
+                    help="torch state_dict file (read on import, "
+                         "written on export)")
+    ap.add_argument("--out", default="",
+                    help="framework checkpoint dir to write (import mode)")
+    ap.add_argument("--export", default="",
+                    help="framework checkpoint dir to read (export mode)")
+    args, rest = ap.parse_known_args(argv)
+    if bool(args.out) == bool(args.export):
+        ap.error("exactly one of --out (import) / --export is required")
+
+    import jax
+    import numpy as np
+    import torch
+
+    from pytorch_distributed_nn_tpu.config import get_config, parse_overrides
+    from pytorch_distributed_nn_tpu.train.checkpoint import CheckpointManager
+    from pytorch_distributed_nn_tpu.train.trainer import Trainer
+
+    cfg = get_config(args.preset, **parse_overrides(rest))
+    cfg.steps = 0
+    cfg.checkpoint_dir = ""  # Trainer must not auto-resume anything
+    trainer = Trainer(cfg)
+
+    if args.out:
+        state_dict = torch.load(args.torch_checkpoint,
+                                map_location="cpu", weights_only=True)
+        converted = _converted_params(args.arch, state_dict, cfg.model)
+        template = trainer.state.params
+        try:
+            placed = jax.tree.map(
+                lambda a, t: jax.device_put(
+                    np.asarray(a, dtype=t.dtype), t.sharding),
+                converted, template,
+            )
+        except ValueError as e:
+            raise SystemExit(
+                f"converted weights do not fit the configured model "
+                f"(set --model.extra to the checkpoint's dims): {e}"
+            ) from e
+        mgr = CheckpointManager(args.out, async_save=False)
+        mgr.save(trainer.state.replace(params=placed), data_step=0,
+                 extra_meta={"converted_from": args.torch_checkpoint},
+                 force=True)
+        mgr.close()
+        print(f"wrote framework checkpoint: {args.out} "
+              f"(step 0, arch {args.arch})")
+        return 0
+
+    mgr = CheckpointManager(args.export, async_save=False)
+    state, meta = mgr.restore(trainer.state)
+    mgr.close()
+    if args.arch != "llama3":
+        raise SystemExit("export currently supports --arch llama3 only")
+    from pytorch_distributed_nn_tpu.utils import torch_interop as ti
+
+    host_params = jax.tree.map(
+        lambda x: np.asarray(jax.device_get(x), np.float32), state.params
+    )
+    torch.save(ti.llama_params_to_torch(host_params),
+               args.torch_checkpoint)
+    print(f"wrote torch state_dict: {args.torch_checkpoint} "
+          f"(from step {meta['step']})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -39,13 +39,15 @@ def main(argv=None) -> int:
     ap.add_argument("--temperature", type=float, default=0.0)
     ap.add_argument("--top-k", type=int, default=0)
     ap.add_argument("--seed", type=int, default=0)
-    args = ap.parse_args(argv)
+    # remaining --a.b style flags are config overrides, as in train.py
+    # (the model dims must match the checkpoint being decoded)
+    args, rest = ap.parse_known_args(argv)
 
-    from pytorch_distributed_nn_tpu.config import get_config
+    from pytorch_distributed_nn_tpu.config import get_config, parse_overrides
     from pytorch_distributed_nn_tpu.inference import generate
     from pytorch_distributed_nn_tpu.models import get_model
 
-    cfg = get_config(args.preset)
+    cfg = get_config(args.preset, **parse_overrides(rest))
     model = get_model(cfg.model)
     prompt = jnp.asarray(
         [[int(t) for t in args.prompt.split()]], jnp.int32
