@@ -263,6 +263,73 @@ def bert_params_from_torch(
     return params
 
 
+def gpt2_params_from_torch(
+    state_dict: Mapping[str, Any], *, num_layers: int, num_heads: int
+) -> dict:
+    """HF ``GPT2LMHeadModel.state_dict()`` → params for
+    models/transformer_lm.py (the same architecture: pre-LN blocks,
+    learned positions, tanh-approximate gelu, biased attention, tied LM
+    head).
+
+    HF GPT-2 uses ``Conv1D`` layers whose weights are stored ``(in,
+    out)`` — the flax kernel layout already, so unlike ``nn.Linear``
+    nothing transposes. The fused ``c_attn`` (D, 3D) splits into q/k/v;
+    the causal-mask ``attn.bias`` buffers are non-learned and ignored.
+    """
+    sd = _TrackingDict(state_dict)
+    embed = to_numpy(sd["transformer.wte.weight"])  # (V, D)
+    d_model = embed.shape[1]
+    if d_model % num_heads:
+        raise ValueError(f"d_model {d_model} % num_heads {num_heads} != 0")
+    head_dim = d_model // num_heads
+
+    def ln(prefix: str) -> dict:
+        return {"scale": to_numpy(sd[prefix + ".weight"]),
+                "bias": to_numpy(sd[prefix + ".bias"])}
+
+    def conv1d(prefix: str) -> dict:  # (in, out) — flax layout already
+        return {"kernel": to_numpy(sd[prefix + ".weight"]),
+                "bias": to_numpy(sd[prefix + ".bias"])}
+
+    params: dict = {
+        "tok_embed": {"embedding": embed},
+        "pos_embed": {"embedding": to_numpy(
+            sd["transformer.wpe.weight"])},
+    }
+    for i in range(num_layers):
+        p = f"transformer.h.{i}."
+        ca_w = to_numpy(sd[p + "attn.c_attn.weight"])  # (D, 3D)
+        ca_b = to_numpy(sd[p + "attn.c_attn.bias"])    # (3D,)
+        qkv_w = np.split(ca_w, 3, axis=1)
+        qkv_b = np.split(ca_b, 3)
+        heads = {
+            name: {
+                "kernel": w.reshape(d_model, num_heads, head_dim),
+                "bias": b.reshape(num_heads, head_dim),
+            }
+            for name, w, b in zip(("query", "key", "value"), qkv_w, qkv_b)
+        }
+        proj = conv1d(p + "attn.c_proj")
+        heads["out"] = {
+            "kernel": proj["kernel"].reshape(num_heads, head_dim, d_model),
+            "bias": proj["bias"],
+        }
+        params[f"block{i}"] = {
+            "ln1": ln(p + "ln_1"),
+            "attn": heads,
+            "ln2": ln(p + "ln_2"),
+            "mlp_in": conv1d(p + "mlp.c_fc"),
+            "mlp_out": conv1d(p + "mlp.c_proj"),
+        }
+    params["ln_f"] = ln("transformer.ln_f")
+    lm_head = sd.get("lm_head.weight")  # tied to wte in stock GPT-2
+    params["lm_head"] = {
+        "kernel": (to_numpy(lm_head) if lm_head is not None else embed).T
+    }
+    sd.check_consumed(ignorable=(".attn.bias", ".attn.masked_bias"))
+    return params
+
+
 def mlp_params_from_torch(state_dict: Mapping[str, Any]) -> dict:
     """torch ``nn.Sequential`` of Linears (the reference's
     ``Net(nn.Module)``, SURVEY.md §2a) → params for models/mlp.py.

@@ -53,9 +53,17 @@ def _converted_params(arch: str, state_dict, model_cfg):
             num_layers=e.get("num_layers", 12),
             num_heads=e.get("num_heads", 12),
         )
+    if arch == "gpt2":
+        return ti.gpt2_params_from_torch(
+            state_dict,
+            num_layers=e.get("num_layers", 12),
+            num_heads=e.get("num_heads", 12),
+        )
     if arch == "mlp":
         return ti.mlp_params_from_torch(state_dict)
-    raise ValueError(f"unknown --arch {arch!r} (llama3 | bert | mlp)")
+    raise ValueError(
+        f"unknown --arch {arch!r} (llama3 | bert | gpt2 | mlp)"
+    )
 
 
 def main(argv=None) -> int:
@@ -64,7 +72,7 @@ def main(argv=None) -> int:
         formatter_class=argparse.RawDescriptionHelpFormatter,
     )
     ap.add_argument("--arch", required=True,
-                    choices=("llama3", "bert", "mlp"))
+                    choices=("llama3", "bert", "gpt2", "mlp"))
     ap.add_argument("--preset", required=True)
     ap.add_argument("--torch-checkpoint", required=True,
                     help="torch state_dict file (read on import, "

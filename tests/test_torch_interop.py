@@ -116,6 +116,33 @@ def test_bert_logits_match_hf():
                                atol=5e-4)
 
 
+def test_gpt2_logits_match_hf():
+    transformers = pytest.importorskip("transformers")
+    cfg = transformers.GPT2Config(
+        vocab_size=128, n_positions=64, n_embd=48, n_layer=2, n_head=4,
+        layer_norm_epsilon=1e-6,  # == flax nn.LayerNorm default
+        activation_function="gelu_new",  # == flax nn.gelu (tanh approx)
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+        attn_implementation="eager",
+    )
+    torch.manual_seed(0)
+    hf = transformers.GPT2LMHeadModel(cfg).eval()
+    params = ti.gpt2_params_from_torch(hf.state_dict(), num_layers=2,
+                                       num_heads=4)
+    model = get_model(ModelConfig(
+        name="transformer_lm", dtype="float32", compute_dtype="float32",
+        extra=dict(vocab_size=128, num_layers=2, d_model=48, num_heads=4,
+                   mlp_dim=192, max_len=64),
+    ))
+    tokens = np.random.default_rng(3).integers(0, 128, size=(2, 20))
+    ours = model.apply({"params": jax.tree.map(np.asarray, params)},
+                       tokens.astype(np.int32), train=False)
+    with torch.no_grad():
+        theirs = hf(torch.from_numpy(tokens)).logits.numpy()
+    np.testing.assert_allclose(np.asarray(ours), theirs, rtol=5e-4,
+                               atol=5e-4)
+
+
 def test_unmapped_tensors_fail_loudly(tiny_llama):
     sd = dict(tiny_llama.state_dict())
     # a Qwen-style attention bias the llama3 layout has no slot for
