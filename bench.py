@@ -42,7 +42,8 @@ PER_CHIP_BATCH = {
     "transformer_lm_pp": 8,
     "llama3_8b_zero": 1,
     "moe_lm_ep": 8,
-    "llama3_longcontext": 1,  # 32k tokens per sample
+    "llama3_longcontext": 2,  # 32k tokens/sample (GQA-native flash keeps
+                              # KV unexpanded, freeing HBM for batch 2)
 }
 
 

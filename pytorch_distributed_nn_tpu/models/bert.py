@@ -23,6 +23,7 @@ class EncoderBlock(nn.Module):
     num_heads: int
     mlp_dim: int
     dropout: float = 0.0
+    ln_eps: float = 1e-6
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
 
@@ -37,8 +38,8 @@ class EncoderBlock(nn.Module):
         )(x, mask=mask)
         if self.dropout:
             y = nn.Dropout(self.dropout, deterministic=not train)(y)
-        x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
-                         name="ln1")(x + y)
+        x = nn.LayerNorm(epsilon=self.ln_eps, dtype=self.dtype,
+                         param_dtype=self.param_dtype, name="ln1")(x + y)
         y = nn.Dense(self.mlp_dim, dtype=self.dtype,
                      param_dtype=self.param_dtype, name="mlp_in")(x)
         y = nn.gelu(y)
@@ -46,7 +47,8 @@ class EncoderBlock(nn.Module):
                      name="mlp_out")(y)
         if self.dropout:
             y = nn.Dropout(self.dropout, deterministic=not train)(y)
-        return nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
+        return nn.LayerNorm(epsilon=self.ln_eps, dtype=self.dtype,
+                            param_dtype=self.param_dtype,
                             name="ln2")(x + y)
 
 
@@ -59,6 +61,9 @@ class Bert(nn.Module):
     max_len: int = 512
     type_vocab: int = 2
     dropout: float = 0.0
+    # HF BERT checkpoints use layer_norm_eps=1e-12; converted weights
+    # must set extra["ln_eps"]=1e-12 to reproduce the original
+    ln_eps: float = 1e-6
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
 
@@ -81,20 +86,20 @@ class Bert(nn.Module):
             x = x + nn.Embed(self.type_vocab, self.d_model,
                              param_dtype=self.param_dtype,
                              name="type_embed")(token_types)
-        x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
-                         name="ln_embed")(x.astype(self.dtype))
+        x = nn.LayerNorm(epsilon=self.ln_eps, dtype=self.dtype,
+                         param_dtype=self.param_dtype, name="ln_embed")(x.astype(self.dtype))
         for i in range(self.num_layers):
             x = EncoderBlock(
                 num_heads=self.num_heads, mlp_dim=self.mlp_dim,
-                dropout=self.dropout, dtype=self.dtype,
+                dropout=self.dropout, ln_eps=self.ln_eps, dtype=self.dtype,
                 param_dtype=self.param_dtype, name=f"layer{i}",
             )(x, mask=attention_mask, train=train)
         # MLM head: dense + gelu + LN, then decode to vocab
         x = nn.Dense(self.d_model, dtype=self.dtype,
                      param_dtype=self.param_dtype, name="mlm_dense")(x)
         x = nn.gelu(x)
-        x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
-                         name="mlm_ln")(x)
+        x = nn.LayerNorm(epsilon=self.ln_eps, dtype=self.dtype,
+                         param_dtype=self.param_dtype, name="mlm_ln")(x)
         return nn.Dense(self.vocab_size, dtype=jnp.float32,
                         param_dtype=self.param_dtype, name="mlm_decoder")(x)
 
@@ -111,6 +116,7 @@ def build_bert_base(cfg: ModelConfig) -> Bert:
         mlp_dim=e.get("mlp_dim", 3072),
         max_len=e.get("max_len", 512),
         dropout=e.get("dropout", 0.0),
+        ln_eps=e.get("ln_eps", 1e-6),
         dtype=policy.compute_dtype,
         param_dtype=policy.param_dtype,
     )

@@ -41,6 +41,7 @@ class LlamaBlock(nn.Module):
     num_kv_heads: int
     mlp_dim: int
     rope_theta: float = 500000.0
+    norm_eps: float = 1e-5
     attn_impl: str = "auto"
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
@@ -48,8 +49,8 @@ class LlamaBlock(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = False, decode: bool = False):
         d = x.shape[-1]
-        y = RMSNorm(dtype=self.dtype, param_dtype=self.param_dtype,
-                    name="attn_norm")(x)
+        y = RMSNorm(eps=self.norm_eps, dtype=self.dtype,
+                    param_dtype=self.param_dtype, name="attn_norm")(x)
         y = MultiHeadAttention(
             num_heads=self.num_heads, head_dim=d // self.num_heads,
             num_kv_heads=self.num_kv_heads, causal=True, rotary=True,
@@ -58,8 +59,8 @@ class LlamaBlock(nn.Module):
             param_dtype=self.param_dtype, name="attn",
         )(y, decode=decode)
         x = x + y
-        y = RMSNorm(dtype=self.dtype, param_dtype=self.param_dtype,
-                    name="mlp_norm")(x)
+        y = RMSNorm(eps=self.norm_eps, dtype=self.dtype,
+                    param_dtype=self.param_dtype, name="mlp_norm")(x)
         gate = nn.Dense(self.mlp_dim, use_bias=False, dtype=self.dtype,
                         param_dtype=self.param_dtype, name="gate_proj")(y)
         up = nn.Dense(self.mlp_dim, use_bias=False, dtype=self.dtype,
@@ -78,6 +79,9 @@ class Llama(nn.Module):
     num_kv_heads: int = 8
     mlp_dim: int = 14336
     rope_theta: float = 500000.0
+    # rms_norm_eps: 1e-5 for Llama-3 (HF default is 1e-6 — set
+    # extra["norm_eps"] to the checkpoint's value when converting)
+    norm_eps: float = 1e-5
     remat: bool = False
     attn_impl: str = "auto"
     dtype: jnp.dtype = jnp.float32
@@ -103,13 +107,14 @@ class Llama(nn.Module):
             x = block_cls(
                 num_heads=self.num_heads, num_kv_heads=self.num_kv_heads,
                 mlp_dim=self.mlp_dim, rope_theta=self.rope_theta,
+                norm_eps=self.norm_eps,
                 attn_impl=self.attn_impl, dtype=self.dtype,
                 param_dtype=self.param_dtype, name=f"layer{i}",
             )(x, train, decode)
         if last_only:
             x = x[:, -1:]
-        x = RMSNorm(dtype=self.dtype, param_dtype=self.param_dtype,
-                    name="final_norm")(x)
+        x = RMSNorm(eps=self.norm_eps, dtype=self.dtype,
+                    param_dtype=self.param_dtype, name="final_norm")(x)
         if return_hidden:
             return x
         return nn.Dense(self.vocab_size, use_bias=False, dtype=jnp.float32,
@@ -128,6 +133,7 @@ def build_llama3_8b(cfg: ModelConfig) -> Llama:
         num_kv_heads=e.get("num_kv_heads", 8),
         mlp_dim=e.get("mlp_dim", 14336),
         rope_theta=e.get("rope_theta", 500000.0),
+        norm_eps=e.get("norm_eps", 1e-5),
         remat=cfg.remat,
         attn_impl=e.get("attn_impl", "auto"),
         dtype=policy.compute_dtype,

@@ -24,6 +24,7 @@ class DecoderBlock(nn.Module):
     num_heads: int
     mlp_dim: int
     dropout: float = 0.0
+    ln_eps: float = 1e-6
     attn_impl: str = "auto"
     # FFN override hook: (block, y, train) -> y, creating its submodules in
     # the block's scope. None = the standard dense MLP. This is how the MoE
@@ -36,8 +37,8 @@ class DecoderBlock(nn.Module):
     @nn.compact
     def __call__(self, x, train: bool = False, decode: bool = False):
         d = x.shape[-1]
-        y = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
-                         name="ln1")(x)
+        y = nn.LayerNorm(epsilon=self.ln_eps, dtype=self.dtype,
+                         param_dtype=self.param_dtype, name="ln1")(x)
         y = MultiHeadAttention(
             num_heads=self.num_heads, head_dim=d // self.num_heads,
             causal=True, impl=self.attn_impl, dtype=self.dtype,
@@ -46,8 +47,8 @@ class DecoderBlock(nn.Module):
         if self.dropout:
             y = nn.Dropout(self.dropout, deterministic=not train)(y)
         x = x + y
-        y = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
-                         name="ln2")(x)
+        y = nn.LayerNorm(epsilon=self.ln_eps, dtype=self.dtype,
+                         param_dtype=self.param_dtype, name="ln2")(x)
         if self.ffn is not None:
             y = self.ffn(self, y, train)
         else:
@@ -69,6 +70,10 @@ class TransformerLM(nn.Module):
     mlp_dim: int = 3072
     max_len: int = 2048
     dropout: float = 0.0
+    # HF GPT-2 checkpoints use layer_norm_epsilon=1e-5; flax's default is
+    # 1e-6 — converted checkpoints must set extra["ln_eps"]=1e-5 to
+    # reproduce the original's numbers (utils/torch_interop.py)
+    ln_eps: float = 1e-6
     remat: bool = False
     attn_impl: str = "auto"
     dtype: jnp.dtype = jnp.float32
@@ -79,7 +84,8 @@ class TransformerLM(nn.Module):
     def block_kwargs(self) -> dict:
         return dict(num_heads=self.num_heads, mlp_dim=self.mlp_dim,
                     dropout=self.dropout, attn_impl=self.attn_impl,
-                    dtype=self.dtype, param_dtype=self.param_dtype)
+                    ln_eps=self.ln_eps, dtype=self.dtype,
+                    param_dtype=self.param_dtype)
 
     def layer_ffn(self, i: int) -> Optional[Callable]:
         """Per-layer FFN override for block i (see DecoderBlock.ffn).
@@ -138,8 +144,8 @@ class TransformerLM(nn.Module):
                           name=f"block{i}")(x, train, decode)
         if last_only:
             x = x[:, -1:]
-        x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
-                         name="ln_f")(x)
+        x = nn.LayerNorm(epsilon=self.ln_eps, dtype=self.dtype,
+                         param_dtype=self.param_dtype, name="ln_f")(x)
         if return_hidden:
             return x
         return nn.Dense(self.vocab_size, use_bias=False, dtype=jnp.float32,
@@ -158,6 +164,7 @@ def build_transformer_lm(cfg: ModelConfig) -> TransformerLM:
         mlp_dim=e.get("mlp_dim", 3072),
         max_len=e.get("max_len", 2048),
         dropout=e.get("dropout", 0.0),
+        ln_eps=e.get("ln_eps", 1e-6),
         remat=cfg.remat,
         attn_impl=e.get("attn_impl", "auto"),
         dtype=policy.compute_dtype,

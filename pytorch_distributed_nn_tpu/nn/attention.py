@@ -66,9 +66,6 @@ def dot_product_attention(
         raise ValueError(f"unknown attention impl {impl!r}")
     B, T, H, D = q.shape
     S, Hkv = k.shape[1], k.shape[2]
-    if H != Hkv:  # grouped-query: repeat kv heads
-        k = jnp.repeat(k, H // Hkv, axis=2)
-        v = jnp.repeat(v, H // Hkv, axis=2)
     if impl == "flash":
         if mask is not None:
             raise ValueError(
@@ -78,7 +75,13 @@ def dot_product_attention(
             flash_attention,
         )
 
+        # kv stays grouped: the kernel streams each KV tile for its
+        # whole Q-head group (expanding here would multiply KV HBM
+        # traffic by H/Hkv)
         return flash_attention(q, k, v, causal=causal)
+    if H != Hkv:  # grouped-query: repeat kv heads for the einsum path
+        k = jnp.repeat(k, H // Hkv, axis=2)
+        v = jnp.repeat(v, H // Hkv, axis=2)
     scale = D ** -0.5
     logits = jnp.einsum(
         "bthd,bshd->bhts", q, k, preferred_element_type=jnp.float32

@@ -105,8 +105,17 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k"))
 def _flash_bhtd(q, k, v, *, causal: bool, block_q: int, block_k: int):
     """(BH, T, D) flash attention via pallas_call (K/V streamed by the
-    grid, so sequence length is not VMEM-bounded). Returns (out, lse)."""
+    grid, so sequence length is not VMEM-bounded). Returns (out, lse).
+
+    GQA-native: k/v may be (BKV, T, D) with BKV dividing BH — each KV
+    head serves BH/BKV consecutive Q heads (row ``bh`` reads kv row
+    ``bh // q_per_kv``), so grouped KV is streamed once per Q head
+    *group*, never expanded in HBM."""
     BH, T, D = q.shape
+    BKV = k.shape[0]
+    if BH % BKV:
+        raise ValueError(f"q heads {BH} not a multiple of kv heads {BKV}")
+    q_per_kv = BH // BKV
     grid = (BH, pl.cdiv(T, block_q), pl.cdiv(T, block_k))
     kernel = functools.partial(
         _flash_kernel, causal=causal, block_q=block_q, block_k=block_k,
@@ -118,10 +127,10 @@ def _flash_bhtd(q, k, v, *, causal: bool, block_q: int, block_k: int):
         # saving ~half the streamed K/V bytes.
         def kv_map(bh, qi, kj):
             last_live = ((qi + 1) * block_q - 1) // block_k
-            return (bh, jnp.minimum(kj, last_live), 0)
+            return (bh // q_per_kv, jnp.minimum(kj, last_live), 0)
     else:
         def kv_map(bh, qi, kj):
-            return (bh, kj, 0)
+            return (bh // q_per_kv, kj, 0)
     return pl.pallas_call(
         kernel,
         grid=grid,
@@ -224,14 +233,17 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr, *, causal: bool,
-                    block_q: int, block_k: int):
+                    block_q: int, block_k: int, nq: int):
     """dk/dv pass: fixed K/V block, stream Q blocks (roles swapped —
-    the accumulators live with the K/V tile)."""
+    the accumulators live with the K/V tile). The inner grid dim is
+    ``g * nq + qi`` over the KV head's Q-head group (GQA): the group
+    reduction happens in the same accumulator as the Q-block sum."""
     kj = pl.program_id(1)
-    qi = pl.program_id(2)
-    nq = pl.num_programs(2)
+    inner = pl.program_id(2)
+    n_inner = pl.num_programs(2)
+    qi = inner % nq
 
-    @pl.when(qi == 0)
+    @pl.when(inner == 0)
     def _init():
         dk_scr[...] = jnp.zeros_like(dk_scr)
         dv_scr[...] = jnp.zeros_like(dv_scr)
@@ -250,7 +262,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         dk_scr[...] += jnp.dot(ds.T, q,
                                preferred_element_type=jnp.float32)
 
-    @pl.when(qi == nq - 1)
+    @pl.when(inner == n_inner - 1)
     def _emit():
         dk_ref[0] = dk_scr[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
@@ -259,8 +271,15 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
 @functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k"))
 def _flash_bwd_pallas(q, k, v, g, lse, delta, *, causal: bool,
                       block_q: int, block_k: int):
-    """(dq, dk, dv) via the two-pass Pallas backward."""
+    """(dq, dk, dv) via the two-pass Pallas backward. GQA-native like the
+    forward: k/v (BKV, T, D) with BKV | BH; dk/dv come back grouped —
+    the dk/dv grid iterates the group's Q heads inside each KV block so
+    their contributions sum in the VMEM accumulator, which is exactly
+    the head-group reduction an expanded-KV backward would need a
+    separate sum for."""
     BH, T, D = q.shape
+    BKV = k.shape[0]
+    q_per_kv = BH // BKV
     nq = pl.cdiv(T, block_q)
     nk = pl.cdiv(T, block_k)
 
@@ -271,10 +290,10 @@ def _flash_bwd_pallas(q, k, v, g, lse, delta, *, causal: bool,
     if causal:
         def kv_map(bh, qi, kj):
             last_live = ((qi + 1) * block_q - 1) // block_k
-            return (bh, jnp.minimum(kj, last_live), 0)
+            return (bh // q_per_kv, jnp.minimum(kj, last_live), 0)
     else:
         def kv_map(bh, qi, kj):
-            return (bh, kj, 0)
+            return (bh // q_per_kv, kj, 0)
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, causal=causal,
@@ -297,20 +316,25 @@ def _flash_bwd_pallas(q, k, v, g, lse, delta, *, causal: bool,
         ),
     )(q, k, v, g, lse, delta)
 
-    # dk/dv pass: grid iterates Q blocks innermost for a fixed K block
-    kv_fix = lambda bh, kj, qi: (bh, kj, 0)  # noqa: E731
-    stat_fix = lambda bh, kj, qi: (bh, 0, 0)  # noqa: E731
+    # dk/dv pass: for a fixed K/V block, the inner grid dim walks the
+    # group's Q heads and their Q blocks (inner = g * nq + qi) so every
+    # contribution to this KV head lands in one VMEM accumulator.
+    kv_fix = lambda bkv, kj, inner: (bkv, kj, 0)  # noqa: E731
+    stat_fix = lambda bkv, kj, inner: (  # noqa: E731
+        bkv * q_per_kv + inner // nq, 0, 0)
     if causal:
-        def q_stream(bh, kj, qi):
+        def q_stream(bkv, kj, inner):
             first_live = (kj * block_k) // block_q
-            return (bh, jnp.maximum(qi, first_live), 0)
+            return (bkv * q_per_kv + inner // nq,
+                    jnp.maximum(inner % nq, first_live), 0)
     else:
-        q_stream = lambda bh, kj, qi: (bh, qi, 0)  # noqa: E731
+        def q_stream(bkv, kj, inner):
+            return (bkv * q_per_kv + inner // nq, inner % nq, 0)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, causal=causal,
-                          block_q=block_q, block_k=block_k),
-        grid=(BH, nk, nq),
+                          block_q=block_q, block_k=block_k, nq=nq),
+        grid=(BKV, nk, q_per_kv * nq),
         in_specs=[
             pl.BlockSpec((1, block_q, D), q_stream,
                          memory_space=pltpu.VMEM),
@@ -326,8 +350,8 @@ def _flash_bwd_pallas(q, k, v, g, lse, delta, *, causal: bool,
             pl.BlockSpec((1, block_k, D), kv_fix, memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, T, D), k.dtype),
-            jax.ShapeDtypeStruct((BH, T, D), v.dtype),
+            jax.ShapeDtypeStruct((BKV, T, D), k.dtype),
+            jax.ShapeDtypeStruct((BKV, T, D), v.dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, D), jnp.float32),
@@ -439,15 +463,20 @@ def _pick_block(T: int, want: int) -> int | None:
 
 def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 512,
                     block_k: int = 512):
-    """(B, T, H, D) attention. KV heads must already be expanded to match
-    Q heads (the caller handles GQA). Falls back to the jnp reference off
-    TPU. Differentiable: the backward is the Pallas two-pass kernel pair
-    (dq, then dk/dv) replaying p from the forward's saved lse."""
+    """(B, T, H, D) attention; k/v may carry fewer heads (GQA) as long
+    as Hkv divides H — grouped KV is streamed natively (each KV tile
+    serves its whole Q-head group), cutting streamed KV bytes by
+    H/Hkv versus expanding. Falls back to the jnp reference off TPU.
+    Differentiable: the backward is the Pallas two-pass kernel pair
+    (dq, then dk/dv) replaying p from the forward's saved lse; dk/dv
+    come back grouped, so AD flows to the unexpanded projections with
+    no extra head-sum."""
     B, T, H, D = q.shape
-    if k.shape[2] != H:
+    Hkv = k.shape[2]
+    if H % Hkv:
         raise ValueError(
-            f"flash_attention expects expanded kv heads ({k.shape[2]} vs "
-            f"{H}); repeat kv before calling"
+            f"flash_attention needs kv heads dividing q heads "
+            f"({Hkv} vs {H})"
         )
     if k.shape[1] != T:
         raise ValueError(
@@ -456,18 +485,24 @@ def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 512,
         )
 
     def to_bh(x):
-        return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+        h = x.shape[2]
+        return x.transpose(0, 2, 1, 3).reshape(B * h, T, D)
 
     def from_bh(x):
         return x.reshape(B, H, T, D).transpose(0, 2, 1, 3)
 
+    def expand(x):  # row bh reads kv row bh // q_per_kv — same layout
+        return jnp.repeat(x, H // Hkv, axis=0)
+
     qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
     if jax.default_backend() != "tpu":
-        return from_bh(_attention_reference(qb, kb, vb, causal=causal))
+        return from_bh(_attention_reference(qb, expand(kb), expand(vb),
+                                            causal=causal))
     bq = _pick_block(T, min(block_q, T))
     bk = _pick_block(T, min(block_k, T))
     if bq is None or bk is None:
-        return from_bh(_attention_reference(qb, kb, vb, causal=causal))
+        return from_bh(_attention_reference(qb, expand(kb), expand(vb),
+                                            causal=causal))
     return from_bh(
         _flash_diff(qb, kb, vb, causal, bq, bk)
     )

@@ -197,8 +197,10 @@ def bert_params_from_torch(
     (the original TF-BERT activation) — HF checkpoints configured with
     ``hidden_act='gelu'`` (exact erf) convert fine but diverge at the
     ~1e-3 level; ``gelu_new``/``gelu_pytorch_tanh`` checkpoints match
-    tightly. The unused pooler head (when present) is dropped — it does
-    not feed MLM logits.
+    tightly. Set ``ModelConfig.extra['ln_eps']`` to the checkpoint's
+    ``layer_norm_eps`` (HF default 1e-12) when building the model. The
+    unused pooler head (when present) is dropped — it does not feed MLM
+    logits.
     """
     sd = _TrackingDict(state_dict)
     e = "bert.embeddings."
@@ -275,6 +277,9 @@ def gpt2_params_from_torch(
     out)`` — the flax kernel layout already, so unlike ``nn.Linear``
     nothing transposes. The fused ``c_attn`` (D, 3D) splits into q/k/v;
     the causal-mask ``attn.bias`` buffers are non-learned and ignored.
+    Set ``ModelConfig.extra['ln_eps']`` to the checkpoint's
+    ``layer_norm_epsilon`` (1e-5 for stock GPT-2) when building the
+    model.
     """
     sd = _TrackingDict(state_dict)
     embed = to_numpy(sd["transformer.wte.weight"])  # (V, D)

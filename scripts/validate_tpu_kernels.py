@@ -24,23 +24,28 @@ from pytorch_distributed_nn_tpu.ops.pallas.quantize import (
 def check_flash() -> bool:
     ok = True
     rng = np.random.RandomState(0)
-    for (B, T, H, D) in [(2, 512, 8, 128), (1, 1024, 4, 64)]:
+    # (B, T, H, D, Hkv): last two cases exercise GQA-native KV streaming
+    for (B, T, H, D, Hkv) in [(2, 512, 8, 128, 8), (1, 1024, 4, 64, 4),
+                              (1, 1024, 8, 64, 2), (2, 512, 8, 128, 4)]:
         q = rng.randn(B, T, H, D).astype(np.float32) * 0.3
-        k = rng.randn(B, T, H, D).astype(np.float32) * 0.3
-        v = rng.randn(B, T, H, D).astype(np.float32)
+        k = rng.randn(B, T, Hkv, D).astype(np.float32) * 0.3
+        v = rng.randn(B, T, Hkv, D).astype(np.float32)
         for causal in (True, False):
             got = np.asarray(flash_attention(
                 jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
                 causal=causal))
             to_bh = lambda x: jnp.asarray(x).transpose(0, 2, 1, 3).reshape(
                 B * H, T, D)  # noqa: E731
+            expand = lambda x: jnp.repeat(  # noqa: E731
+                jnp.asarray(x), H // Hkv, axis=2)
             want = np.asarray(_attention_reference(
-                to_bh(q), to_bh(k), to_bh(v), causal=causal,
+                to_bh(q), to_bh(expand(k)), to_bh(expand(v)),
+                causal=causal,
             )).reshape(B, H, T, D).transpose(0, 2, 1, 3)
             err = float(np.abs(got - want).max())
             line_ok = err < 2e-2
             ok &= line_ok
-            print(f"flash B{B} T{T} H{H} D{D} causal={causal}: "
+            print(f"flash B{B} T{T} H{H}/kv{Hkv} D{D} causal={causal}: "
                   f"max_err={err:.2e} {'OK' if line_ok else 'FAIL'}")
     return ok
 
@@ -55,13 +60,17 @@ def check_flash_grad() -> bool:
     uses flash in)."""
     ok = True
     rng = np.random.RandomState(4)
-    for (B, T, H, D) in [(2, 512, 4, 64), (1, 2048, 4, 64)]:
+    # Hkv < H covers the GQA backward: grouped dk/dv accumulated over
+    # the head group inside the dkv kernel's inner grid dim
+    for (B, T, H, D, Hkv) in [(2, 512, 4, 64, 4), (1, 2048, 4, 64, 4),
+                              (1, 2048, 4, 64, 2)]:
         q = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32)) * 0.3
-        k = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32)) * 0.3
-        v = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32))
+        k = jnp.asarray(rng.randn(B, T, Hkv, D).astype(np.float32)) * 0.3
+        v = jnp.asarray(rng.randn(B, T, Hkv, D).astype(np.float32))
 
         def to_bh(x):
-            return x.transpose(0, 2, 1, 3).reshape(B * H, T, D)
+            h = x.shape[2]
+            return x.transpose(0, 2, 1, 3).reshape(B * h, T, D)
 
         for causal in (True, False):
             def f_flash(q, k, v):
@@ -69,6 +78,8 @@ def check_flash_grad() -> bool:
                         .astype(jnp.float32).sum())
 
             def f_ref(q, k, v):
+                k = jnp.repeat(k, H // Hkv, axis=2)
+                v = jnp.repeat(v, H // Hkv, axis=2)
                 return (_attention_reference(
                     to_bh(q), to_bh(k), to_bh(v), causal=causal,
                 ).astype(jnp.float32).sum())

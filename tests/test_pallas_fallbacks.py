@@ -35,13 +35,36 @@ def test_flash_impl_matches_xla(causal):
     np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-5)
 
 
-def test_flash_impl_gqa_expansion_happens_before_kernel():
+def test_flash_impl_gqa_native():
+    # grouped kv goes straight into flash_attention (no expansion at the
+    # caller — the kernel maps each Q head onto its group's KV rows)
     q, k, v = _qkv(hkv=2)
     want = np.asarray(dot_product_attention(q, k, v, causal=True,
                                             impl="xla"))
     got = np.asarray(dot_product_attention(q, k, v, causal=True,
                                            impl="flash"))
     np.testing.assert_allclose(got, want, rtol=2e-5, atol=1e-5)
+
+
+def test_flash_gqa_grads_match_xla():
+    # dk/dv must come back GROUPED (shape of the unexpanded kv) and
+    # equal the head-group sum the expanded path would produce
+    q, k, v = _qkv(hkv=2)
+    q, k, v = jnp.asarray(q), jnp.asarray(k), jnp.asarray(v)
+
+    def loss(impl):
+        def f(q, k, v):
+            out = dot_product_attention(q, k, v, causal=True, impl=impl)
+            return (out.astype(jnp.float32) ** 2).sum()
+
+        return jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+    got = loss("flash")
+    want = loss("xla")
+    for g, w, name in zip(got, want, ("dq", "dk", "dv")):
+        assert g.shape == w.shape, name
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=2e-4, atol=2e-5, err_msg=name)
 
 
 def test_flash_rejects_mask():
@@ -52,8 +75,8 @@ def test_flash_rejects_mask():
                               mask=mask)
 
 
-def test_flash_raw_requires_expanded_heads():
-    q, k, v = _qkv(hkv=2)
+def test_flash_raw_rejects_indivisible_heads():
+    q, k, v = _qkv(hkv=3)  # 8 q heads % 3 kv heads != 0
     with pytest.raises(ValueError):
         flash_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v))
 
