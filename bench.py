@@ -127,14 +127,53 @@ def bench_bus_bw(args) -> int:
     return 0
 
 
+def bench_decode(args) -> int:
+    """Inference decode throughput (beyond the reference, which has no
+    serving story): KV-cache greedy generation tokens/s on the scaled
+    Llama, batch 8, 128-token prompts, 128 new tokens."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_nn_tpu.config import get_config
+    from pytorch_distributed_nn_tpu.inference import generate
+    from pytorch_distributed_nn_tpu.models import get_model
+
+    cfg = get_config("llama3_8b_zero")
+    if len(jax.devices()) < 8:  # same 1-chip fix-up as main()
+        cfg.model.extra = dict(num_layers=8, d_model=1024, num_heads=16,
+                               num_kv_heads=8, mlp_dim=3584,
+                               vocab_size=32000)
+    cfg.model.remat = False
+    model = get_model(cfg.model)
+    B, P, N = 8, 128, 128
+    rng = jax.random.key(0)
+    prompt = jax.random.randint(rng, (B, P), 0, 32000, jnp.int32)
+    params = model.init(rng, prompt[:, :1], train=False)["params"]
+
+    out = generate(model, params, prompt, N, temperature=0.0)
+    jax.block_until_ready(out)  # warmup: compiles prefill + decode step
+    t0 = time.perf_counter()
+    out = generate(model, params, prompt, N, temperature=0.0)
+    jax.block_until_ready(out)
+    dt = time.perf_counter() - t0
+    value = B * N / dt
+    print(json.dumps(dict(
+        metric="decode tokens/sec (llama scaled, KV-cache greedy, "
+               f"batch {B}, prompt {P}, new {N})",
+        value=round(value, 1), unit="tokens/sec", vs_baseline=None,
+    )))
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--preset", default="resnet50_dp",
                     choices=sorted(PER_CHIP_BATCH))
     ap.add_argument("--metric", default="throughput",
-                    choices=("throughput", "bus_bw"),
+                    choices=("throughput", "bus_bw", "decode"),
                     help="bus_bw: BASELINE's grad-allreduce bus-bandwidth "
-                         "metric (use with --preset bert_base_buckets)")
+                         "metric (use with --preset bert_base_buckets); "
+                         "decode: KV-cache generation tokens/s")
     ap.add_argument("--steps", type=int, default=30,
                     help="timed steps (after warmup)")
     ap.add_argument("--warmup", type=int, default=5,
@@ -144,6 +183,8 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     if args.metric == "bus_bw":
         return bench_bus_bw(args)
+    if args.metric == "decode":
+        return bench_decode(args)
 
     import jax
 

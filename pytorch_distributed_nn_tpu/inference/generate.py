@@ -8,9 +8,10 @@ a reference user switches to needs an inference path. Design:
   layer — :class:`nn.attention.MultiHeadAttention` with ``decode=True``);
 - the prompt is consumed in ONE prefill ``apply`` (full (B, P) chunk —
   batched matmuls on the MXU, not P sequential steps);
-- each new token is one jitted (B, 1) step with the cache donated, so
-  decoding is O(T) in cache reads instead of the O(T^2) full-context
-  recompute;
+- the token loop is ONE jitted device program (``lax.scan`` over
+  sample→feed steps, cache donated): decoding is O(T) in cache reads
+  instead of the O(T^2) full-context recompute, and the host dispatches
+  once per generate() call, not once per token;
 - sampling: greedy (``temperature=0``), temperature, and top-k — all on
   device via ``jax.random.categorical``.
 
@@ -57,8 +58,7 @@ def init_cache(model, batch_size: int, max_len: int):
                         shapes["cache"])
 
 
-@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
-def _decode_step(model, params, cache, tokens):
+def _apply_decode(model, params, cache, tokens):
     """One (B, T) decode chunk: returns ((B, V) next-token logits,
     updated cache). last_only skips the vocab projection for all but
     the final position (the only row generation consumes)."""
@@ -67,6 +67,44 @@ def _decode_step(model, params, cache, tokens):
         train=False, decode=True, last_only=True, mutable=["cache"],
     )
     return logits[:, -1, :], mutated["cache"]
+
+
+_decode_step = functools.partial(jax.jit, static_argnums=(0,),
+                                 donate_argnums=(2,))(_apply_decode)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 5, 6, 7, 8),
+                   donate_argnums=(2,))
+def _decode_loop(model, params, cache, next_logits, rng, n_steps,
+                 temperature, top_k, eos_token):
+    """The whole autoregressive loop as ONE device program: ``lax.scan``
+    over decode steps (sample → feed → next logits). One dispatch for
+    all ``n_steps`` tokens — per-token host round-trips would otherwise
+    dominate wall-clock when the chip sits behind a network tunnel (and
+    still cost ~dispatch-latency × n_steps locally). Returns (n_steps,
+    B) sampled tokens."""
+
+    def step(carry, _):
+        next_logits, cache, rng, done = carry
+        rng, step_rng = jax.random.split(rng)
+        tok = _sample(next_logits, temperature=temperature, top_k=top_k,
+                      rng=step_rng)
+        if eos_token is not None:
+            tok = jnp.where(done, eos_token, tok)
+            done = done | (tok == eos_token)
+        tok = tok.astype(jnp.int32)
+        # the final iteration's decode is one step of dead compute
+        # (its logits are never sampled) but keeps the scan uniform;
+        # the cache is sized for it (index ends at P + n_steps)
+        next_logits, cache = _apply_decode(model, params, cache,
+                                           tok[:, None])
+        return (next_logits, cache, rng, done), tok
+
+    done0 = jnp.zeros((next_logits.shape[0],), bool)
+    (_, _, _, _), toks = jax.lax.scan(
+        step, (next_logits, cache, rng, done0), None, length=n_steps
+    )
+    return toks
 
 
 def _sample(logits, *, temperature: float, top_k: int, rng):
@@ -107,22 +145,12 @@ def generate(model, params, prompt, max_new_tokens: int, *,
 
     # prefill: the whole prompt in one chunk
     next_logits, cache = _decode_step(model, params, cache, prompt)
+    if max_new_tokens == 0:
+        return prompt
 
-    tokens = [prompt]
-    done = jnp.zeros((B,), bool)
-    for i in range(max_new_tokens):
-        if rng is not None:
-            rng, step_rng = jax.random.split(rng)
-        else:
-            step_rng = None
-        tok = _sample(next_logits, temperature=temperature, top_k=top_k,
-                      rng=step_rng)
-        if eos_token is not None:
-            tok = jnp.where(done, eos_token, tok)
-            done = done | (tok == eos_token)
-        tokens.append(tok[:, None].astype(jnp.int32))
-        if i + 1 < max_new_tokens:
-            next_logits, cache = _decode_step(
-                model, params, cache, tok[:, None].astype(jnp.int32)
-            )
-    return jnp.concatenate(tokens, axis=1)
+    # greedy ignores the key; pass a constant so the trace is uniform
+    rng0 = rng if rng is not None else jax.random.key(0)
+    toks = _decode_loop(model, params, cache, next_logits, rng0,
+                        max_new_tokens, float(temperature), int(top_k),
+                        eos_token)
+    return jnp.concatenate([prompt, toks.T.astype(jnp.int32)], axis=1)

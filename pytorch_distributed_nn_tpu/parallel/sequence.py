@@ -65,16 +65,23 @@ def _ring_attention_xla(q, k, v, *, axis: str = AXIS_SEQ,
     idx = lax.axis_index(axis)
     B, Tl, H, D = q.shape
     Hkv = k.shape[2]
-    if H != Hkv:  # grouped-query: expand kv once, locally
-        k = jnp.repeat(k, H // Hkv, axis=2)
-        v = jnp.repeat(v, H // Hkv, axis=2)
+    if H % Hkv:
+        raise ValueError(f"kv heads {Hkv} must divide q heads {H}")
+    # GQA: the ring rotates the GROUPED (Hkv) shards — expanding before
+    # the ring would multiply every ppermute's ICI bytes by H/Hkv; each
+    # visiting block is expanded locally at use instead.
+    q_per_kv = H // Hkv
     scale = D ** -0.5
     qf = q.astype(jnp.float32)
+
+    def expand(x):
+        return jnp.repeat(x, q_per_kv, axis=2) if q_per_kv > 1 else x
 
     # global positions of my query rows
     q_pos = idx * Tl + lax.broadcasted_iota(jnp.int32, (Tl, 1), 0)
 
     def block_contrib(k_blk, v_blk, src_block, m, l, acc):
+        k_blk, v_blk = expand(k_blk), expand(v_blk)
         logits = jnp.einsum(
             "bthd,bshd->bhts", qf, k_blk.astype(jnp.float32)
         ) * scale
@@ -137,13 +144,19 @@ def _ring_fused_impl(q, k, v, axis: str, causal: bool, interpret: bool):
     idx = lax.axis_index(axis)
     B, Tl, H, D = q.shape
     Hkv = k.shape[2]
-    if H != Hkv:
-        k = jnp.repeat(k, H // Hkv, axis=2)
-        v = jnp.repeat(v, H // Hkv, axis=2)
+    q_per_kv = H // Hkv
 
     def to_bh(x):
-        return x.transpose(0, 2, 1, 3).reshape(B * H, Tl, D)
+        h = x.shape[2]
+        return x.transpose(0, 2, 1, 3).reshape(B * h, Tl, D)
 
+    def expand_bh(x):  # (B*Hkv, Tl, D) → (B*H, Tl, D), local only
+        if q_per_kv == 1:
+            return x
+        return jnp.repeat(x, q_per_kv, axis=0)
+
+    # the ring carries GROUPED KV shards (see _ring_attention_xla);
+    # expansion happens locally per visiting block
     qb, kb, vb = to_bh(q), to_bh(k), to_bh(v)
     m0 = jnp.full((B * H, Tl, STAT_LANES), _NEG_INF, jnp.float32)
     l0 = jnp.zeros((B * H, Tl, STAT_LANES), jnp.float32)
@@ -157,8 +170,8 @@ def _ring_fused_impl(q, k, v, axis: str, causal: bool, interpret: bool):
         src_block = (idx - i) % s
         offs = jnp.stack([idx * Tl, src_block * Tl]).astype(jnp.int32)
         m, l, acc = ring_block_update(
-            qb, k_blk, v_blk, m, l, acc, offs, causal=causal,
-            interpret=interpret,
+            qb, expand_bh(k_blk), expand_bh(v_blk), m, l, acc, offs,
+            causal=causal, interpret=interpret,
         )
         k_blk = cc.shift_right(k_blk, axis)
         v_blk = cc.shift_right(v_blk, axis)
@@ -174,7 +187,8 @@ def _ring_fused_impl(q, k, v, axis: str, causal: bool, interpret: bool):
         [idx * Tl, ((idx - last) % s) * Tl]
     ).astype(jnp.int32)
     m, l, acc = ring_block_update(
-        qb, kb, vb, m, l, acc, offs, causal=causal, interpret=interpret,
+        qb, expand_bh(kb), expand_bh(vb), m, l, acc, offs,
+        causal=causal, interpret=interpret,
     )
     out = acc / jnp.maximum(l[..., 0:1], 1e-30)
     return out.reshape(B, H, Tl, D).transpose(0, 2, 1, 3).astype(q.dtype)

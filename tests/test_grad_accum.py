@@ -52,6 +52,35 @@ def test_accum_under_zero3(oracle):
     np.testing.assert_allclose(losses, base_losses, rtol=2e-5, atol=1e-5)
 
 
+def test_accum_composes_with_sequence_parallel():
+    """grad_accum splits the batch dim of seq-sharded (B, T) token
+    batches — the microbatch reshape must stay local (dim 0 only) and
+    reproduce the accum=1 loss curve under ring attention."""
+    from pytorch_distributed_nn_tpu.config import get_config
+
+    def cfg_for(accum):
+        cfg = get_config("llama3_8b_zero", steps=3, log_every=1)
+        cfg.mesh = MeshSpec(seq=2, data=4)
+        cfg.parallel.strategy = "dp"
+        cfg.parallel.grad_accum = accum
+        cfg.data.batch_size = 8
+        cfg.data.seq_len = 32
+        cfg.data.vocab_size = 97
+        cfg.data.prefetch = 0
+        cfg.model.compute_dtype = "float32"
+        cfg.model.dtype = "float32"
+        cfg.model.remat = False
+        cfg.model.extra = dict(num_layers=2, d_model=64, num_heads=4,
+                               num_kv_heads=2, mlp_dim=128, vocab_size=97,
+                               attn_impl="ring")
+        return cfg
+
+    accum = Trainer(cfg_for(2)).train()
+    plain = Trainer(cfg_for(1)).train()
+    for a, b in zip(accum, plain):
+        np.testing.assert_allclose(a.loss, b.loss, rtol=2e-5)
+
+
 def test_accum_nondivisible_batch_rejected():
     with pytest.raises(ValueError, match="not divisible"):
         run(3)  # batch 128 % 3 != 0
