@@ -139,10 +139,12 @@ def bench_decode(args) -> int:
     from pytorch_distributed_nn_tpu.models import get_model
 
     cfg = get_config("llama3_8b_zero")
-    if len(jax.devices()) < 8:  # same 1-chip fix-up as main()
-        cfg.model.extra = dict(num_layers=8, d_model=1024, num_heads=16,
-                               num_kv_heads=8, mlp_dim=3584,
-                               vocab_size=32000)
+    # always the scaled model: generate() runs unsharded (params on one
+    # device), so the full 8B layout would OOM a single chip's HBM
+    # regardless of how many devices the host has
+    cfg.model.extra = dict(num_layers=8, d_model=1024, num_heads=16,
+                           num_kv_heads=8, mlp_dim=3584,
+                           vocab_size=32000)
     cfg.model.remat = False
     model = get_model(cfg.model)
     B, P, N = 8, 128, 128

@@ -73,7 +73,7 @@ _decode_step = functools.partial(jax.jit, static_argnums=(0,),
                                  donate_argnums=(2,))(_apply_decode)
 
 
-@functools.partial(jax.jit, static_argnums=(0, 5, 6, 7, 8),
+@functools.partial(jax.jit, static_argnums=(0, 5, 7, 8),
                    donate_argnums=(2,))
 def _decode_loop(model, params, cache, next_logits, rng, n_steps,
                  temperature, top_k, eos_token):
@@ -81,8 +81,10 @@ def _decode_loop(model, params, cache, next_logits, rng, n_steps,
     over decode steps (sample → feed → next logits). One dispatch for
     all ``n_steps`` tokens — per-token host round-trips would otherwise
     dominate wall-clock when the chip sits behind a network tunnel (and
-    still cost ~dispatch-latency × n_steps locally). Returns (n_steps,
-    B) sampled tokens."""
+    still cost ~dispatch-latency × n_steps locally). ``temperature`` is
+    a traced operand (per-request values don't recompile); only
+    n_steps/top_k/eos_token key the compile cache. Returns (n_steps, B)
+    sampled tokens."""
 
     def step(carry, _):
         next_logits, cache, rng, done = carry
@@ -107,16 +109,22 @@ def _decode_loop(model, params, cache, next_logits, rng, n_steps,
     return toks
 
 
-def _sample(logits, *, temperature: float, top_k: int, rng):
-    """logits (B, V) -> tokens (B,)."""
-    if temperature == 0.0:
-        return jnp.argmax(logits, axis=-1)
-    logits = logits / temperature
+def _sample(logits, *, temperature, top_k: int, rng):
+    """logits (B, V) -> tokens (B,). ``temperature`` may be a traced
+    scalar (0 selects greedy via jnp.where — top-k membership is
+    temperature-invariant, so filtering before scaling is equivalent),
+    which keeps per-request temperatures from recompiling the decode
+    scan. ``top_k`` stays static (lax.top_k needs a static k)."""
+    greedy = jnp.argmax(logits, axis=-1)
+    if rng is None:
+        return greedy
     if top_k > 0:
         k = min(top_k, logits.shape[-1])
         kth = jax.lax.top_k(logits, k)[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
-    return jax.random.categorical(rng, logits, axis=-1)
+    scaled = logits / jnp.maximum(temperature, 1e-6)
+    sampled = jax.random.categorical(rng, scaled, axis=-1)
+    return jnp.where(temperature == 0.0, greedy, sampled)
 
 
 def generate(model, params, prompt, max_new_tokens: int, *,
@@ -139,18 +147,18 @@ def generate(model, params, prompt, max_new_tokens: int, *,
         raise ValueError(f"temperature must be >= 0, got {temperature}")
     if temperature > 0.0 and rng is None:
         raise ValueError("sampling (temperature > 0) needs an rng key")
+    if max_new_tokens == 0:
+        return prompt
     B, P = prompt.shape
     total = P + max_new_tokens
     cache = init_cache(model, B, total)
 
     # prefill: the whole prompt in one chunk
     next_logits, cache = _decode_step(model, params, cache, prompt)
-    if max_new_tokens == 0:
-        return prompt
 
     # greedy ignores the key; pass a constant so the trace is uniform
     rng0 = rng if rng is not None else jax.random.key(0)
     toks = _decode_loop(model, params, cache, next_logits, rng0,
-                        max_new_tokens, float(temperature), int(top_k),
-                        eos_token)
+                        max_new_tokens, jnp.float32(temperature),
+                        int(top_k), eos_token)
     return jnp.concatenate([prompt, toks.T.astype(jnp.int32)], axis=1)

@@ -46,6 +46,10 @@ def ring_attention(q, k, v, *, axis: str = AXIS_SEQ, causal: bool = True,
     'pallas_interpret' (the Pallas kernel under the interpreter — CPU
     correctness runs), or 'auto' (pallas on TPU, xla elsewhere).
     """
+    if q.shape[2] % k.shape[2]:
+        raise ValueError(
+            f"kv heads {k.shape[2]} must divide q heads {q.shape[2]}"
+        )
     if impl == "auto":
         impl = "pallas" if jax.default_backend() == "tpu" else "xla"
     if impl == "xla":
