@@ -33,7 +33,12 @@ class OptimConfig:
 
 @dataclass
 class DataConfig:
-    dataset: str = "mnist"  # mnist | cifar10 | imagenet_synthetic | lm_synthetic
+    # mnist | cifar10 | imagenet_synthetic | lm_synthetic | mlm_synthetic
+    # | token_file (causal LM from a memory-mapped .bin/.npy token dump)
+    # | array_file (classification from a .npz with arrays x, y)
+    dataset: str = "mnist"
+    path: str = ""  # file for token_file / array_file datasets
+    token_dtype: str = "uint16"  # raw .bin token width (token_file)
     batch_size: int = 128  # global batch size
     num_workers: int = 2
     seq_len: int = 512

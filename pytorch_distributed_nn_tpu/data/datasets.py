@@ -124,8 +124,107 @@ class SyntheticMLM(SyntheticLM):
         return inputs, labels
 
 
+class TokenFileDataset(SyntheticDataset):
+    """Causal-LM corpus from a token file the user brings — the "real
+    data" path for reference migrants (their torch pipelines read the
+    same flat-token format, e.g. nanoGPT/Megatron ``.bin`` dumps).
+
+    ``path``: a 1-D token array, either raw ``.bin`` (``token_dtype``,
+    default uint16) or ``.npy``. The file is memory-mapped — corpora far
+    larger than RAM stream through the page cache; nothing is copied at
+    construction. ``batch(step)`` slices ``batch_size`` windows of
+    ``seq_len + 1`` tokens at (seed, step)-deterministic random offsets
+    (the standard random-window LM pretraining sampler), so the
+    determinism contract (same global batch on any topology) holds
+    exactly as for the synthetic streams. Held-out evaluation draws from
+    the same window distribution (windows, not documents, are the unit —
+    overlap with training windows is possible, as in any random-window
+    sampler)."""
+
+    def __init__(self, path: str, seed: int, batch_size: int, *,
+                 seq_len: int, vocab_size: int,
+                 token_dtype: str = "uint16") -> None:
+        super().__init__(seed, batch_size)
+        self.seq_len = seq_len
+        self.spec = BatchSpec((seq_len,), np.dtype(np.int32), (seq_len,),
+                              np.dtype(np.int32), vocab_size)
+        if str(path).endswith(".npy"):
+            self.tokens = np.load(path, mmap_mode="r")
+        else:
+            self.tokens = np.memmap(path, dtype=np.dtype(token_dtype),
+                                    mode="r")
+        if self.tokens.ndim != 1:
+            raise ValueError(
+                f"token file must be 1-D, got shape {self.tokens.shape}"
+            )
+        if len(self.tokens) < seq_len + 1:
+            raise ValueError(
+                f"token file has {len(self.tokens)} tokens; need at "
+                f"least seq_len + 1 = {seq_len + 1}"
+            )
+
+    def batch(self, step: int) -> tuple[np.ndarray, np.ndarray]:
+        rng = self._rng(step)
+        starts = rng.integers(
+            0, len(self.tokens) - self.seq_len - 1, size=self.batch_size
+        )
+        rows = np.stack([
+            np.asarray(self.tokens[s:s + self.seq_len + 1])
+            for s in starts
+        ]).astype(np.int64)
+        if rows.max() >= self.spec.num_classes:
+            raise ValueError(
+                f"token id {rows.max()} >= vocab_size "
+                f"{self.spec.num_classes} — set data.vocab_size to the "
+                "tokenizer's size"
+            )
+        return (rows[:, :-1].astype(np.int32),
+                rows[:, 1:].astype(np.int32))
+
+
+class ArrayFileDataset(SyntheticDataset):
+    """Classification data from a ``.npz`` the user brings, with arrays
+    ``x`` (N, ...) and integer ``y`` (N,) — the torchvision-Dataset
+    analogue for migrants with exported arrays. ``batch(step)`` samples
+    (seed, step)-deterministic indices with replacement, preserving the
+    any-topology determinism contract."""
+
+    def __init__(self, path: str, seed: int, batch_size: int) -> None:
+        super().__init__(seed, batch_size)
+        data = np.load(path)
+        try:
+            self.x, self.y = data["x"], data["y"]
+        except KeyError as e:
+            raise ValueError(
+                f"{path} must contain arrays 'x' and 'y'"
+            ) from e
+        if len(self.x) != len(self.y):
+            raise ValueError(
+                f"x has {len(self.x)} rows but y has {len(self.y)}"
+            )
+        self.y = self.y.astype(np.int32)
+        self.spec = BatchSpec(tuple(self.x.shape[1:]),
+                              np.dtype(np.float32), (),
+                              np.dtype(np.int32),
+                              int(self.y.max()) + 1)
+
+    def batch(self, step: int) -> tuple[np.ndarray, np.ndarray]:
+        rng = self._rng(step)
+        idx = rng.integers(0, len(self.x), size=self.batch_size)
+        return self.x[idx].astype(np.float32), self.y[idx]
+
+
 def get_dataset(name: str, *, seed: int, batch_size: int,
-                seq_len: int = 512, vocab_size: int = 32000):
+                seq_len: int = 512, vocab_size: int = 32000,
+                path: str = "", token_dtype: str = "uint16"):
+    if name in ("token_file", "array_file") and not path:
+        raise ValueError(f"dataset {name!r} needs data.path")
+    if name == "token_file":
+        return TokenFileDataset(path, seed, batch_size, seq_len=seq_len,
+                                vocab_size=vocab_size,
+                                token_dtype=token_dtype)
+    if name == "array_file":
+        return ArrayFileDataset(path, seed, batch_size)
     if name == "mnist":
         return ClassTemplateImages(seed, batch_size, shape=(28, 28),
                                    num_classes=10)

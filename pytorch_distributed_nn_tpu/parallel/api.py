@@ -49,8 +49,9 @@ def make_train_step(
     from pytorch_distributed_nn_tpu.runtime.mesh import AXIS_SEQ
 
     strategy = cfg.parallel.strategy
+    token_datasets = ("lm_synthetic", "token_file")
     if mesh.shape.get(AXIS_SEQ, 1) > 1:
-        if cfg.data.dataset not in ("lm_synthetic",):
+        if cfg.data.dataset not in token_datasets:
             raise ValueError(
                 "mesh.seq > 1 shards the sequence dim of (B, T) token "
                 f"batches; dataset {cfg.data.dataset!r} has no sequence "
@@ -83,10 +84,10 @@ def make_train_step(
                 f"xent_chunk is not supported under strategy "
                 f"{strategy!r} (needs the shared dp/zero step)"
             )
-        if cfg.data.dataset != "lm_synthetic":
+        if cfg.data.dataset not in token_datasets:
             raise ValueError(
-                "xent_chunk is a causal-LM loss option "
-                f"(dataset lm_synthetic), got {cfg.data.dataset!r}"
+                "xent_chunk is a causal-LM loss option (datasets "
+                f"{token_datasets}), got {cfg.data.dataset!r}"
             )
         if cfg.data.seq_len % cfg.xent_chunk:
             raise ValueError(

@@ -141,6 +141,7 @@ def accuracy(logits, labels) -> jnp.ndarray:
 
 _LOSSES = {
     "lm_synthetic": lm_xent,
+    "token_file": lm_xent,
     "mlm_synthetic": masked_lm_xent,
 }
 

@@ -74,6 +74,8 @@ class Trainer:
             batch_size=cfg.data.batch_size,
             seq_len=cfg.data.seq_len,
             vocab_size=cfg.data.vocab_size,
+            path=cfg.data.path,
+            token_dtype=cfg.data.token_dtype,
         )
         self.loader = DataLoader(self.dataset, self.mesh,
                                  prefetch=cfg.data.prefetch)
