@@ -4,12 +4,14 @@
 Usage:
     python scripts/generate.py --preset llama3_longcontext \
         [--checkpoint-dir runs/ckpt] [--prompt "5 17 42"] \
-        [--max-new 32] [--temperature 0.8] [--top-k 40] [--seed 0]
+        [--max-new 32] [--temperature 0.8] [--top-k 40] [--seed 0] \
+        [--tokenizer path/to/tokenizer_dir_or_json]
 
-Prompts are space-separated token ids (the synthetic datasets have no
-tokenizer; a real deployment plugs one in front of this). Without
---checkpoint-dir the model is randomly initialized — useful only for
-smoke-testing the decode path.
+Prompts are space-separated token ids, or text when ``--tokenizer``
+names a local HF tokenizer (a saved directory, or a tokenizer.json) —
+the output is then detokenized too, and the tokenizer's eos stops
+generation. Without --checkpoint-dir the model is randomly
+initialized — useful only for smoke-testing the decode path.
 """
 
 from __future__ import annotations
@@ -39,6 +41,9 @@ def main(argv=None) -> int:
     ap.add_argument("--temperature", type=float, default=0.0)
     ap.add_argument("--top-k", type=int, default=0)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--tokenizer", default="",
+                    help="local HF tokenizer dir or tokenizer.json; "
+                         "prompt/output become text")
     # remaining --a.b style flags are config overrides, as in train.py
     # (the model dims must match the checkpoint being decoded)
     args, rest = ap.parse_known_args(argv)
@@ -49,9 +54,30 @@ def main(argv=None) -> int:
 
     cfg = get_config(args.preset, **parse_overrides(rest))
     model = get_model(cfg.model)
-    prompt = jnp.asarray(
-        [[int(t) for t in args.prompt.split()]], jnp.int32
-    )
+
+    tokenizer = None
+    eos_token = None
+    if args.tokenizer:
+        import transformers
+
+        if args.tokenizer.endswith(".json"):
+            tokenizer = transformers.PreTrainedTokenizerFast(
+                tokenizer_file=args.tokenizer
+            )
+        else:
+            tokenizer = transformers.AutoTokenizer.from_pretrained(
+                args.tokenizer
+            )
+        eos_token = tokenizer.eos_token_id
+        ids = tokenizer.encode(args.prompt)
+        if not ids:
+            print("tokenizer produced an empty prompt", file=sys.stderr)
+            return 1
+        prompt = jnp.asarray([ids], jnp.int32)
+    else:
+        prompt = jnp.asarray(
+            [[int(t) for t in args.prompt.split()]], jnp.int32
+        )
 
     if args.checkpoint_dir:
         cfg.checkpoint_dir = args.checkpoint_dir
@@ -76,8 +102,12 @@ def main(argv=None) -> int:
            if args.temperature > 0 else None)
     out = generate(model, params, prompt, args.max_new,
                    temperature=args.temperature, top_k=args.top_k,
-                   rng=rng)
-    print(" ".join(str(t) for t in np.asarray(out)[0]))
+                   rng=rng, eos_token=eos_token)
+    ids = [int(t) for t in np.asarray(out)[0]]
+    if tokenizer is not None:
+        print(tokenizer.decode(ids, skip_special_tokens=True))
+    else:
+        print(" ".join(str(t) for t in ids))
     return 0
 
 

@@ -1,0 +1,50 @@
+"""scripts/generate.py CLI: token-id mode and local-tokenizer text mode
+(the tokenizer is built offline — zero-egress container)."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+EXTRA = ('{"num_layers":2,"d_model":64,"num_heads":4,"num_kv_heads":2,'
+         '"mlp_dim":128,"vocab_size":97}')
+OVERRIDES = ["--model.extra", EXTRA, "--data.vocab_size", "97",
+             "--data.seq_len", "32", "--data.batch_size", "8",
+             "--model.remat", "false", "--mesh.fsdp", "1",
+             "--mesh.data", "-1"]
+
+
+def run_cli(*args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, "scripts/generate.py", *args], env=env,
+        cwd="/root/repo", capture_output=True, text=True, timeout=300,
+    )
+
+
+def test_token_id_mode():
+    r = run_cli("--preset", "llama3_8b_zero", "--prompt", "5 9 42",
+                "--max-new", "4", "--temperature", "0", *OVERRIDES)
+    assert r.returncode == 0, r.stderr
+    ids = [int(t) for t in r.stdout.strip().splitlines()[-1].split()]
+    assert ids[:3] == [5, 9, 42] and len(ids) == 7
+
+
+def test_tokenizer_text_mode(tmp_path):
+    tokenizers = pytest.importorskip("tokenizers")
+    vocab = {f"w{i}": i for i in range(90)}
+    vocab["[UNK]"] = 90
+    tok = tokenizers.Tokenizer(
+        tokenizers.models.WordLevel(vocab, unk_token="[UNK]"))
+    tok.pre_tokenizer = tokenizers.pre_tokenizers.Whitespace()
+    path = tmp_path / "tokenizer.json"
+    tok.save(str(path))
+
+    r = run_cli("--preset", "llama3_8b_zero", "--prompt", "w5 w9 w42",
+                "--max-new", "4", "--temperature", "0",
+                "--tokenizer", str(path), *OVERRIDES)
+    assert r.returncode == 0, r.stderr
+    text = r.stdout.strip().splitlines()[-1]
+    assert text.startswith("w5 w9 w42")
+    assert len(text.split()) == 7  # 3 prompt + 4 new, detokenized
