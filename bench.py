@@ -182,6 +182,9 @@ def main(argv=None) -> int:
                     help="untimed steps (includes compile)")
     ap.add_argument("--per-chip-batch", type=int, default=0,
                     help="override per-chip batch size")
+    ap.add_argument("--profile-dir", default="",
+                    help="capture an XProf/TensorBoard trace of the "
+                         "timed steps into this directory")
     args = ap.parse_args(argv)
     if args.metric == "bus_bw":
         return bench_bus_bw(args)
@@ -240,10 +243,19 @@ def main(argv=None) -> int:
         state, metrics = trainer.step_fn(state, *pool[i % len(pool)])
     fence(metrics)
 
+    import contextlib
+
+    profile = contextlib.nullcontext()
+    if args.profile_dir:
+        from pytorch_distributed_nn_tpu.utils.profiling import xprof_trace
+
+        profile = xprof_trace(args.profile_dir)
+
     t0 = time.perf_counter()
-    for i in range(args.steps):
-        state, metrics = trainer.step_fn(state, *pool[i % len(pool)])
-    loss = fence(metrics)
+    with profile:
+        for i in range(args.steps):
+            state, metrics = trainer.step_fn(state, *pool[i % len(pool)])
+        loss = fence(metrics)
     dt = time.perf_counter() - t0
     if not (loss == loss):  # NaN guard: a benchmark that diverged is void
         raise RuntimeError(f"non-finite loss {loss} in benchmark loop")

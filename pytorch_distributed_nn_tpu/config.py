@@ -28,7 +28,14 @@ class OptimConfig:
     eps: float = 1e-8
     grad_clip_norm: float = 0.0  # 0 = off
     warmup_steps: int = 0
-    schedule: str = "constant"  # constant | cosine | linear
+    schedule: str = "constant"  # constant | cosine | linear | step
+    # schedule="step" (torch StepLR): decay by step_gamma at these
+    # fractions of the post-warmup run
+    step_milestones: tuple[float, ...] = (0.5, 0.75)
+    step_gamma: float = 0.1
+    # skip weight decay on 1-D params (norm scales/biases) — the usual
+    # LLM recipe; False reproduces torch's decay-everything default
+    decay_mask_norms: bool = False
 
 
 @dataclass
@@ -123,6 +130,9 @@ def _set_dotted(obj: Any, dotted: str, value: Any) -> None:
             value = type(current)(value)
         elif isinstance(current, dict):
             value = json.loads(value)  # e.g. --model.extra '{"d_model":64}'
+        elif isinstance(current, (tuple, list)):
+            # e.g. --optim.step_milestones '[0.3, 0.6, 0.9]'
+            value = type(current)(json.loads(value))
     setattr(obj, leaf, value)
 
 

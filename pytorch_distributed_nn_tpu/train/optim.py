@@ -27,6 +27,15 @@ def make_schedule(cfg: OptimConfig, total_steps: int) -> optax.Schedule:
         base = optax.linear_schedule(
             cfg.lr, 0.0, max(total_steps - cfg.warmup_steps, 1)
         )
+    elif cfg.schedule == "step":
+        # torch StepLR / torchvision-recipe decay: multiply by
+        # step_gamma at each boundary (fractions of the post-warmup run)
+        span = max(total_steps - cfg.warmup_steps, 1)
+        base = optax.piecewise_constant_schedule(
+            cfg.lr,
+            {int(span * frac): cfg.step_gamma
+             for frac in cfg.step_milestones},
+        )
     else:
         raise ValueError(f"unknown schedule {cfg.schedule!r}")
     if cfg.warmup_steps > 0:
@@ -35,9 +44,19 @@ def make_schedule(cfg: OptimConfig, total_steps: int) -> optax.Schedule:
     return base
 
 
+def _decay_mask(params):
+    """True where decay applies: skip 1-D leaves (norm scales, biases,
+    per-channel stats) — the standard LLM recipe when
+    ``decay_mask_norms`` is on."""
+    import jax
+
+    return jax.tree.map(lambda p: p.ndim > 1, params)
+
+
 def make_optimizer(cfg: OptimConfig,
                    total_steps: int = 10_000) -> optax.GradientTransformation:
     schedule = make_schedule(cfg, total_steps)
+    mask = _decay_mask if cfg.decay_mask_norms else None
     if cfg.name == "sgd":
         opt = optax.sgd(schedule)
     elif cfg.name == "momentum":
@@ -46,19 +65,20 @@ def make_optimizer(cfg: OptimConfig,
         opt = optax.adam(schedule, b1=cfg.b1, b2=cfg.b2, eps=cfg.eps)
     elif cfg.name == "adamw":
         opt = optax.adamw(schedule, b1=cfg.b1, b2=cfg.b2, eps=cfg.eps,
-                          weight_decay=cfg.weight_decay)
+                          weight_decay=cfg.weight_decay, mask=mask)
     elif cfg.name == "adafactor":
         # The TPU-native memory-factored optimizer (Shazeer & Stern): 2nd
         # moments stored as row/col factors, O(n+m) not O(nm) state per
         # matrix — what makes billion-param training fit without ZeRO.
         opt = optax.adafactor(schedule,
-                              weight_decay_rate=cfg.weight_decay or None)
+                              weight_decay_rate=cfg.weight_decay or None,
+                              weight_decay_mask=mask)
     elif cfg.name == "lamb":
         opt = optax.lamb(schedule, b1=cfg.b1, b2=cfg.b2, eps=cfg.eps,
-                         weight_decay=cfg.weight_decay)
+                         weight_decay=cfg.weight_decay, mask=mask)
     elif cfg.name == "lion":
         opt = optax.lion(schedule, b1=cfg.b1, b2=cfg.b2,
-                         weight_decay=cfg.weight_decay)
+                         weight_decay=cfg.weight_decay, mask=mask)
     else:
         raise ValueError(f"unknown optimizer {cfg.name!r}")
 
@@ -68,6 +88,7 @@ def make_optimizer(cfg: OptimConfig,
     if cfg.weight_decay > 0 and cfg.name in ("sgd", "momentum", "adam"):
         # L2-into-grad semantics (torch's SGD/Adam weight_decay); adamw
         # applies decoupled decay internally instead.
-        chain.append(optax.add_decayed_weights(cfg.weight_decay))
+        chain.append(optax.add_decayed_weights(cfg.weight_decay,
+                                               mask=mask))
     chain.append(opt)
     return optax.chain(*chain) if len(chain) > 1 else opt
