@@ -27,6 +27,57 @@ import functools
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+def shard_params_for_inference(params, mesh):
+    """Place params on ``mesh`` per the TP/EP layout rules
+    (parallel/sharding_rules) with no fsdp sharding — inference has no
+    optimizer state to spread, and row/column-parallel weights are what
+    make a model wider than one chip's HBM decodable. XLA inserts the
+    Megatron all-reduces in the decode step from these layouts alone."""
+    from pytorch_distributed_nn_tpu.parallel.sharding_rules import (
+        path_str,
+        spec_for,
+    )
+    from pytorch_distributed_nn_tpu.runtime.mesh import (
+        AXIS_EXPERT,
+        AXIS_TENSOR,
+        global_device_put,
+    )
+
+    tensor = mesh.shape.get(AXIS_TENSOR, 1)
+    expert = mesh.shape.get(AXIS_EXPERT, 1)
+    shardings = jax.tree_util.tree_map_with_path(
+        lambda kp, x: NamedSharding(
+            mesh,
+            spec_for(path_str(kp), tuple(x.shape), tensor=tensor,
+                     expert=expert),
+        ),
+        params,
+    )
+    return global_device_put(params, shardings)
+
+
+def _shard_cache(cache, mesh):
+    """KV caches shard their heads dim over ``tensor`` (matching the
+    q/k/v projection layout, so cache writes stay local); scalars and
+    indivisible leaves replicate."""
+    from pytorch_distributed_nn_tpu.runtime.mesh import (
+        AXIS_TENSOR,
+        global_device_put,
+    )
+
+    tensor = mesh.shape.get(AXIS_TENSOR, 1)
+
+    def spec(x):
+        if x.ndim == 4 and tensor > 1 and x.shape[2] % tensor == 0:
+            return P(None, None, AXIS_TENSOR)  # (B, T, Hkv, D)
+        return P()
+
+    shardings = jax.tree.map(lambda x: NamedSharding(mesh, spec(x)),
+                             cache)
+    return global_device_put(cache, shardings)
 
 
 def init_cache(model, batch_size: int, max_len: int):
@@ -129,12 +180,18 @@ def _sample(logits, *, temperature, top_k: int, rng):
 
 def generate(model, params, prompt, max_new_tokens: int, *,
              temperature: float = 0.0, top_k: int = 0, rng=None,
-             eos_token: int | None = None):
+             eos_token: int | None = None, mesh=None):
     """Generate continuations for ``prompt`` (B, P) int32.
 
     Returns (B, P + max_new_tokens) tokens (prompt included). With
     ``eos_token`` set, sequences that emit it keep it and then pad with
     it (the batch still runs max_new_tokens steps).
+
+    ``mesh``: distributed decoding — params are laid out tensor/expert-
+    parallel (:func:`shard_params_for_inference`), the KV cache shards
+    its heads dim to match, and the jitted decode program runs SPMD over
+    the mesh with XLA-inserted collectives. Token-identical to the
+    single-device path.
     """
     prompt = jnp.asarray(prompt, jnp.int32)
     if prompt.ndim != 2 or prompt.shape[1] < 1:
@@ -149,9 +206,17 @@ def generate(model, params, prompt, max_new_tokens: int, *,
         raise ValueError("sampling (temperature > 0) needs an rng key")
     if max_new_tokens == 0:
         return prompt
-    B, P = prompt.shape
-    total = P + max_new_tokens
+    B, P_len = prompt.shape
+    total = P_len + max_new_tokens
     cache = init_cache(model, B, total)
+    if mesh is not None:
+        params = shard_params_for_inference(params, mesh)
+        cache = _shard_cache(cache, mesh)
+        from pytorch_distributed_nn_tpu.runtime.mesh import (
+            global_device_put,
+        )
+
+        prompt = global_device_put(prompt, NamedSharding(mesh, P()))
 
     # prefill: the whole prompt in one chunk
     next_logits, cache = _decode_step(model, params, cache, prompt)

@@ -44,6 +44,10 @@ def main(argv=None) -> int:
     ap.add_argument("--tokenizer", default="",
                     help="local HF tokenizer dir or tokenizer.json; "
                          "prompt/output become text")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="tensor-parallel degree: decode SPMD over a "
+                         "(tensor=tp, data=rest) mesh with sharded "
+                         "params and KV cache")
     # remaining --a.b style flags are config overrides, as in train.py
     # (the model dims must match the checkpoint being decoded)
     args, rest = ap.parse_known_args(argv)
@@ -98,11 +102,20 @@ def main(argv=None) -> int:
             jax.random.key(cfg.seed), prompt, train=False
         )["params"]
 
+    mesh = None
+    if args.tp > 1:
+        from pytorch_distributed_nn_tpu.runtime.mesh import (
+            MeshSpec,
+            make_mesh,
+        )
+
+        mesh = make_mesh(MeshSpec(tensor=args.tp, data=-1))
+
     rng = (jax.random.key(args.seed)
            if args.temperature > 0 else None)
     out = generate(model, params, prompt, args.max_new,
                    temperature=args.temperature, top_k=args.top_k,
-                   rng=rng, eos_token=eos_token)
+                   rng=rng, eos_token=eos_token, mesh=mesh)
     ids = [int(t) for t in np.asarray(out)[0]]
     if tokenizer is not None:
         print(tokenizer.decode(ids, skip_special_tokens=True))

@@ -34,6 +34,20 @@ def tiny_transformer_lm():
     return model, params
 
 
+def test_tensor_parallel_decode_token_identity(tiny_llama):
+    """Distributed decoding: generate over a tensor=2 mesh (params
+    row/column-parallel, cache head-sharded) must produce exactly the
+    single-device tokens."""
+    from pytorch_distributed_nn_tpu.runtime.mesh import MeshSpec, make_mesh
+
+    model, params = tiny_llama
+    prompt = jnp.asarray([[5, 17, 42], [96, 1, 3]], jnp.int32)
+    want = generate(model, params, prompt, max_new_tokens=6)
+    mesh = make_mesh(MeshSpec(tensor=2, data=4).resolve(8))
+    got = generate(model, params, prompt, max_new_tokens=6, mesh=mesh)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
 def _assert_greedy_matches_recompute(model, params, n_new=6):
     """The strongest oracle: cached decode must produce exactly the
     tokens that brute-force argmax over the growing full context does."""
