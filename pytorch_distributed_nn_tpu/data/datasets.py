@@ -165,8 +165,10 @@ class TokenFileDataset(SyntheticDataset):
 
     def batch(self, step: int) -> tuple[np.ndarray, np.ndarray]:
         rng = self._rng(step)
+        # windows span seq_len + 1 tokens; the largest valid start is
+        # len - (seq_len + 1), so the exclusive high is len - seq_len
         starts = rng.integers(
-            0, len(self.tokens) - self.seq_len - 1, size=self.batch_size
+            0, len(self.tokens) - self.seq_len, size=self.batch_size
         )
         rows = np.stack([
             np.asarray(self.tokens[s:s + self.seq_len + 1])

@@ -29,13 +29,15 @@ def make_schedule(cfg: OptimConfig, total_steps: int) -> optax.Schedule:
         )
     elif cfg.schedule == "step":
         # torch StepLR / torchvision-recipe decay: multiply by
-        # step_gamma at each boundary (fractions of the post-warmup run)
+        # step_gamma at each boundary (fractions of the post-warmup
+        # run). Milestones that round to the same integer boundary
+        # compound (gamma^k) rather than silently collapsing.
         span = max(total_steps - cfg.warmup_steps, 1)
-        base = optax.piecewise_constant_schedule(
-            cfg.lr,
-            {int(span * frac): cfg.step_gamma
-             for frac in cfg.step_milestones},
-        )
+        boundaries: dict[int, float] = {}
+        for frac in cfg.step_milestones:
+            b = max(int(span * frac), 1)
+            boundaries[b] = boundaries.get(b, 1.0) * cfg.step_gamma
+        base = optax.piecewise_constant_schedule(cfg.lr, boundaries)
     else:
         raise ValueError(f"unknown schedule {cfg.schedule!r}")
     if cfg.warmup_steps > 0:

@@ -73,6 +73,19 @@ def main(argv=None) -> int:
                 args.tokenizer
             )
         eos_token = tokenizer.eos_token_id
+        if eos_token is None:
+            # a bare tokenizer.json carries no special-token config;
+            # recover the conventional eos from the vocab so "eos stops
+            # generation" holds, and say so if it can't
+            vocab = tokenizer.get_vocab()
+            for cand in ("</s>", "<|endoftext|>", "<eos>", "[SEP]"):
+                if cand in vocab:
+                    tokenizer.eos_token = cand
+                    eos_token = vocab[cand]
+                    break
+            else:
+                print("[generate] tokenizer defines no eos token; "
+                      "generation will not early-stop", file=sys.stderr)
         ids = tokenizer.encode(args.prompt)
         if not ids:
             print("tokenizer produced an empty prompt", file=sys.stderr)

@@ -108,6 +108,18 @@ def test_array_file_trains_mlp(tmp_path):
     assert losses[-1] < losses[0]
 
 
+def test_token_file_minimum_corpus(tmp_path):
+    # exactly seq_len + 1 tokens: the constructor accepts it, and
+    # batch() must sample the single valid window (start 0)
+    toks = np.arange(17, dtype=np.uint16)
+    path = tmp_path / "tiny.bin"
+    toks.tofile(path)
+    ds = TokenFileDataset(str(path), 0, 4, seq_len=16, vocab_size=32)
+    x, y = ds.batch(0)
+    np.testing.assert_array_equal(x, np.tile(np.arange(16), (4, 1)))
+    np.testing.assert_array_equal(y, np.tile(np.arange(1, 17), (4, 1)))
+
+
 def test_path_required():
     with pytest.raises(ValueError, match="data.path"):
         get_dataset("token_file", seed=0, batch_size=4)

@@ -48,6 +48,15 @@ def test_step_schedule():
     assert float(sched(80)) == pytest.approx(0.01)
 
 
+def test_step_schedule_colliding_milestones_compound():
+    sched = make_schedule(
+        OptimConfig(lr=1.0, schedule="step",
+                    step_milestones=(0.3, 0.33), step_gamma=0.1), 10
+    )
+    # both milestones land on boundary 3: decays compound to 1e-2
+    assert float(sched(5)) == pytest.approx(0.01)
+
+
 def test_decay_mask_skips_1d_params():
     import jax.numpy as jnp
 
