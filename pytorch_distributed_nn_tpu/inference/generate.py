@@ -124,10 +124,10 @@ _decode_step = functools.partial(jax.jit, static_argnums=(0,),
                                  donate_argnums=(2,))(_apply_decode)
 
 
-@functools.partial(jax.jit, static_argnums=(0, 5, 7, 8),
+@functools.partial(jax.jit, static_argnums=(0, 5, 7, 8, 9),
                    donate_argnums=(2,))
 def _decode_loop(model, params, cache, next_logits, rng, n_steps,
-                 temperature, top_k, eos_token):
+                 temperature, top_k, eos_token, top_p):
     """The whole autoregressive loop as ONE device program: ``lax.scan``
     over decode steps (sample → feed → next logits). One dispatch for
     all ``n_steps`` tokens — per-token host round-trips would otherwise
@@ -141,7 +141,7 @@ def _decode_loop(model, params, cache, next_logits, rng, n_steps,
         next_logits, cache, rng, done = carry
         rng, step_rng = jax.random.split(rng)
         tok = _sample(next_logits, temperature=temperature, top_k=top_k,
-                      rng=step_rng)
+                      rng=step_rng, top_p=top_p)
         if eos_token is not None:
             tok = jnp.where(done, eos_token, tok)
             done = done | (tok == eos_token)
@@ -160,12 +160,13 @@ def _decode_loop(model, params, cache, next_logits, rng, n_steps,
     return toks
 
 
-def _sample(logits, *, temperature, top_k: int, rng):
+def _sample(logits, *, temperature, top_k: int, rng, top_p: float = 0.0):
     """logits (B, V) -> tokens (B,). ``temperature`` may be a traced
-    scalar (0 selects greedy via jnp.where — top-k membership is
+    scalar (0 selects greedy via jnp.where — top-k/top-p membership is
     temperature-invariant, so filtering before scaling is equivalent),
     which keeps per-request temperatures from recompiling the decode
-    scan. ``top_k`` stays static (lax.top_k needs a static k)."""
+    scan. ``top_k``/``top_p`` stay static (top_k needs a static k; p
+    changes the masking structure)."""
     greedy = jnp.argmax(logits, axis=-1)
     if rng is None:
         return greedy
@@ -173,13 +174,26 @@ def _sample(logits, *, temperature, top_k: int, rng):
         k = min(top_k, logits.shape[-1])
         kth = jax.lax.top_k(logits, k)[0][..., -1:]
         logits = jnp.where(logits < kth, -jnp.inf, logits)
+    if top_p > 0.0:
+        # nucleus: keep the smallest prefix of the sorted distribution
+        # with cumulative mass >= top_p (the first token always stays)
+        sorted_logits = jnp.sort(logits, axis=-1)[..., ::-1]
+        probs = jax.nn.softmax(sorted_logits, axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        keep = cum - probs < top_p  # mass BEFORE this token < p
+        cutoff = jnp.min(
+            jnp.where(keep, sorted_logits, jnp.inf), axis=-1,
+            keepdims=True,
+        )
+        logits = jnp.where(logits < cutoff, -jnp.inf, logits)
     scaled = logits / jnp.maximum(temperature, 1e-6)
     sampled = jax.random.categorical(rng, scaled, axis=-1)
     return jnp.where(temperature == 0.0, greedy, sampled)
 
 
 def generate(model, params, prompt, max_new_tokens: int, *,
-             temperature: float = 0.0, top_k: int = 0, rng=None,
+             temperature: float = 0.0, top_k: int = 0,
+             top_p: float = 0.0, rng=None,
              eos_token: int | None = None, mesh=None):
     """Generate continuations for ``prompt`` (B, P) int32.
 
@@ -202,6 +216,8 @@ def generate(model, params, prompt, max_new_tokens: int, *,
         )
     if temperature < 0.0:
         raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if not 0.0 <= top_p <= 1.0:
+        raise ValueError(f"top_p must be in [0, 1], got {top_p}")
     if temperature > 0.0 and rng is None:
         raise ValueError("sampling (temperature > 0) needs an rng key")
     if max_new_tokens == 0:
@@ -225,5 +241,5 @@ def generate(model, params, prompt, max_new_tokens: int, *,
     rng0 = rng if rng is not None else jax.random.key(0)
     toks = _decode_loop(model, params, cache, next_logits, rng0,
                         max_new_tokens, jnp.float32(temperature),
-                        int(top_k), eos_token)
+                        int(top_k), eos_token, float(top_p))
     return jnp.concatenate([prompt, toks.T.astype(jnp.int32)], axis=1)

@@ -40,6 +40,8 @@ def main(argv=None) -> int:
     ap.add_argument("--max-new", type=int, default=32)
     ap.add_argument("--temperature", type=float, default=0.0)
     ap.add_argument("--top-k", type=int, default=0)
+    ap.add_argument("--top-p", type=float, default=0.0,
+                    help="nucleus sampling mass (0 = off)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--tokenizer", default="",
                     help="local HF tokenizer dir or tokenizer.json; "
@@ -128,7 +130,8 @@ def main(argv=None) -> int:
            if args.temperature > 0 else None)
     out = generate(model, params, prompt, args.max_new,
                    temperature=args.temperature, top_k=args.top_k,
-                   rng=rng, eos_token=eos_token, mesh=mesh)
+                   top_p=args.top_p, rng=rng, eos_token=eos_token,
+                   mesh=mesh)
     ids = [int(t) for t in np.asarray(out)[0]]
     if tokenizer is not None:
         print(tokenizer.decode(ids, skip_special_tokens=True))

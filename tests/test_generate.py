@@ -97,6 +97,39 @@ def test_sampling_reproducible_and_in_range(tiny_llama):
     assert int(a.max()) < 97 and int(a.min()) >= 0
 
 
+def test_top_p_restricts_to_nucleus():
+    """Unit oracle for nucleus masking: with a known distribution, only
+    tokens inside the top-p mass may ever be sampled."""
+    from pytorch_distributed_nn_tpu.inference.generate import _sample
+
+    # probs ~ [0.6, 0.3, 0.06, 0.04]: top_p=0.7 keeps tokens {0, 1}
+    logits = jnp.log(jnp.asarray([[0.6, 0.3, 0.06, 0.04]]))
+    seen = set()
+    for i in range(64):
+        tok = _sample(logits, temperature=jnp.float32(1.0), top_k=0,
+                      top_p=0.7, rng=jax.random.key(i))
+        seen.add(int(tok[0]))
+    assert seen <= {0, 1} and 0 in seen
+    # top_p=1.0 keeps everything samplable
+    seen = {int(_sample(logits, temperature=jnp.float32(1.0), top_k=0,
+                        top_p=1.0, rng=jax.random.key(i))[0])
+            for i in range(128)}
+    assert seen == {0, 1, 2, 3}
+
+
+def test_top_p_generate_in_vocab(tiny_llama):
+    model, params = tiny_llama
+    prompt = jnp.asarray([[5, 17, 42]], jnp.int32)
+    out = generate(model, params, prompt, max_new_tokens=5,
+                   temperature=0.8, top_p=0.9, rng=jax.random.key(0))
+    arr = np.asarray(out)
+    assert arr.shape == (1, 8)
+    assert (arr >= 0).all() and (arr < 97).all()
+    with pytest.raises(ValueError, match="top_p"):
+        generate(model, params, prompt, 2, temperature=0.5, top_p=1.5,
+                 rng=jax.random.key(0))
+
+
 def test_eos_padding(tiny_llama):
     model, params = tiny_llama
     prompt = jnp.asarray([[1, 2]], jnp.int32)
