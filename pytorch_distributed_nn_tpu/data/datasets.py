@@ -187,12 +187,20 @@ class TokenFileDataset(SyntheticDataset):
 class ArrayFileDataset(SyntheticDataset):
     """Classification data from a ``.npz`` the user brings, with arrays
     ``x`` (N, ...) and integer ``y`` (N,) — the torchvision-Dataset
-    analogue for migrants with exported arrays. ``batch(step)`` samples
-    (seed, step)-deterministic indices with replacement, preserving the
+    analogue for migrants with exported arrays.
+
+    ``sample='shuffle'`` (default) walks a fresh per-epoch permutation —
+    every example exactly once per epoch, torch ``DistributedSampler``
+    semantics (its ``set_epoch`` reshuffle included); ``'replacement'``
+    draws i.i.d. Both are (seed, step)-deterministic, preserving the
     any-topology determinism contract."""
 
-    def __init__(self, path: str, seed: int, batch_size: int) -> None:
+    def __init__(self, path: str, seed: int, batch_size: int, *,
+                 sample: str = "shuffle") -> None:
         super().__init__(seed, batch_size)
+        if sample not in ("shuffle", "replacement"):
+            raise ValueError(f"unknown sample mode {sample!r}")
+        self.sample = sample
         data = np.load(path)
         try:
             self.x, self.y = data["x"], data["y"]
@@ -210,9 +218,27 @@ class ArrayFileDataset(SyntheticDataset):
                               np.dtype(np.int32),
                               int(self.y.max()) + 1)
 
+    def _perm(self, epoch: int) -> np.ndarray:
+        rng = np.random.default_rng(
+            np.random.SeedSequence([self.seed, epoch, 0x5EAF])
+        )
+        return rng.permutation(len(self.x))
+
     def batch(self, step: int) -> tuple[np.ndarray, np.ndarray]:
-        rng = self._rng(step)
-        idx = rng.integers(0, len(self.x), size=self.batch_size)
+        if self.sample == "replacement":
+            rng = self._rng(step)
+            idx = rng.integers(0, len(self.x), size=self.batch_size)
+        else:
+            n = len(self.x)
+            pos = step * self.batch_size
+            parts, remaining = [], self.batch_size
+            while remaining:  # may straddle epoch boundaries
+                epoch, within = divmod(pos, n)
+                take = min(remaining, n - within)
+                parts.append(self._perm(epoch)[within:within + take])
+                pos += take
+                remaining -= take
+            idx = np.concatenate(parts)
         return self.x[idx].astype(np.float32), self.y[idx]
 
 
