@@ -194,7 +194,8 @@ def _sample(logits, *, temperature, top_k: int, rng, top_p: float = 0.0):
 def generate(model, params, prompt, max_new_tokens: int, *,
              temperature: float = 0.0, top_k: int = 0,
              top_p: float = 0.0, rng=None,
-             eos_token: int | None = None, mesh=None):
+             eos_token: int | None = None, mesh=None,
+             prefill_chunk: int = 0):
     """Generate continuations for ``prompt`` (B, P) int32.
 
     Returns (B, P + max_new_tokens) tokens (prompt included). With
@@ -206,6 +207,12 @@ def generate(model, params, prompt, max_new_tokens: int, *,
     its heads dim to match, and the jitted decode program runs SPMD over
     the mesh with XLA-inserted collectives. Token-identical to the
     single-device path.
+
+    ``prefill_chunk``: consume the prompt in chunks of this many tokens
+    instead of one apply. One-shot prefill scores (P, P); chunked
+    prefill bounds live attention scores at (chunk, P) — the difference
+    between a 32k-token prompt fitting or not. Token-identical either
+    way (the decode cache makes chunked prefill exact).
     """
     prompt = jnp.asarray(prompt, jnp.int32)
     if prompt.ndim != 2 or prompt.shape[1] < 1:
@@ -234,8 +241,18 @@ def generate(model, params, prompt, max_new_tokens: int, *,
 
         prompt = global_device_put(prompt, NamedSharding(mesh, P()))
 
-    # prefill: the whole prompt in one chunk
-    next_logits, cache = _decode_step(model, params, cache, prompt)
+    # prefill: the whole prompt in one chunk, or bounded chunks for
+    # long prompts (each chunk attends to the cache prefix, so live
+    # scores are (chunk, filled) instead of (P, P))
+    if prefill_chunk and prefill_chunk < P_len:
+        pos = 0
+        while pos < P_len:
+            chunk = prompt[:, pos:pos + prefill_chunk]
+            next_logits, cache = _decode_step(model, params, cache,
+                                              chunk)
+            pos += chunk.shape[1]
+    else:
+        next_logits, cache = _decode_step(model, params, cache, prompt)
 
     # greedy ignores the key; pass a constant so the trace is uniform
     rng0 = rng if rng is not None else jax.random.key(0)

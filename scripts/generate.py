@@ -42,6 +42,10 @@ def main(argv=None) -> int:
     ap.add_argument("--top-k", type=int, default=0)
     ap.add_argument("--top-p", type=float, default=0.0,
                     help="nucleus sampling mass (0 = off)")
+    ap.add_argument("--prefill-chunk", type=int, default=0,
+                    help="consume the prompt in chunks of N tokens "
+                         "(bounds prefill attention memory for long "
+                         "prompts; 0 = one-shot)")
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--tokenizer", default="",
                     help="local HF tokenizer dir or tokenizer.json; "
@@ -131,7 +135,7 @@ def main(argv=None) -> int:
     out = generate(model, params, prompt, args.max_new,
                    temperature=args.temperature, top_k=args.top_k,
                    top_p=args.top_p, rng=rng, eos_token=eos_token,
-                   mesh=mesh)
+                   mesh=mesh, prefill_chunk=args.prefill_chunk)
     ids = [int(t) for t in np.asarray(out)[0]]
     if tokenizer is not None:
         print(tokenizer.decode(ids, skip_special_tokens=True))

@@ -97,6 +97,19 @@ def test_sampling_reproducible_and_in_range(tiny_llama):
     assert int(a.max()) < 97 and int(a.min()) >= 0
 
 
+def test_chunked_prefill_token_identity(tiny_llama):
+    """Chunked prefill (bounded live scores) must be exactly the
+    one-shot prefill — including a chunk size that doesn't divide the
+    prompt length."""
+    model, params = tiny_llama
+    prompt = jnp.asarray([[5, 17, 42, 7, 9, 3, 11]], jnp.int32)
+    want = generate(model, params, prompt, max_new_tokens=6)
+    for chunk in (1, 2, 3, 16):
+        got = generate(model, params, prompt, max_new_tokens=6,
+                       prefill_chunk=chunk)
+        np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
 def test_top_p_restricts_to_nucleus():
     """Unit oracle for nucleus masking: with a known distribution, only
     tokens inside the top-p mass may ever be sampled."""
