@@ -18,7 +18,7 @@ apply_platform_overrides()
 import jax
 
 from pytorch_distributed_nn_tpu.config import get_config
-from pytorch_distributed_nn_tpu.runtime.mesh import MeshSpec, make_mesh
+from pytorch_distributed_nn_tpu.runtime.mesh import make_mesh
 from pytorch_distributed_nn_tpu.train.trainer import Trainer
 
 print(f"devices: {len(jax.devices())}")

@@ -46,6 +46,9 @@ class DataConfig:
     dataset: str = "mnist"
     path: str = ""  # file for token_file / array_file datasets
     token_dtype: str = "uint16"  # raw .bin token width (token_file)
+    # array_file sampling: 'shuffle' (per-epoch permutation, torch
+    # DistributedSampler semantics) or 'replacement' (i.i.d.)
+    sample: str = "shuffle"
     batch_size: int = 128  # global batch size
     num_workers: int = 2
     seq_len: int = 512

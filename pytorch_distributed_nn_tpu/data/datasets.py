@@ -219,10 +219,17 @@ class ArrayFileDataset(SyntheticDataset):
                               int(self.y.max()) + 1)
 
     def _perm(self, epoch: int) -> np.ndarray:
+        # pure in (seed, epoch) — cached so each step costs O(batch),
+        # not an O(N) reshuffle (N can be millions of rows)
+        cached = getattr(self, "_perm_cache", None)
+        if cached is not None and cached[0] == epoch:
+            return cached[1]
         rng = np.random.default_rng(
             np.random.SeedSequence([self.seed, epoch, 0x5EAF])
         )
-        return rng.permutation(len(self.x))
+        perm = rng.permutation(len(self.x))
+        self._perm_cache = (epoch, perm)
+        return perm
 
     def batch(self, step: int) -> tuple[np.ndarray, np.ndarray]:
         if self.sample == "replacement":
@@ -244,7 +251,8 @@ class ArrayFileDataset(SyntheticDataset):
 
 def get_dataset(name: str, *, seed: int, batch_size: int,
                 seq_len: int = 512, vocab_size: int = 32000,
-                path: str = "", token_dtype: str = "uint16"):
+                path: str = "", token_dtype: str = "uint16",
+                sample: str = "shuffle"):
     if name in ("token_file", "array_file") and not path:
         raise ValueError(f"dataset {name!r} needs data.path")
     if name == "token_file":
@@ -252,7 +260,7 @@ def get_dataset(name: str, *, seed: int, batch_size: int,
                                 vocab_size=vocab_size,
                                 token_dtype=token_dtype)
     if name == "array_file":
-        return ArrayFileDataset(path, seed, batch_size)
+        return ArrayFileDataset(path, seed, batch_size, sample=sample)
     if name == "mnist":
         return ClassTemplateImages(seed, batch_size, shape=(28, 28),
                                    num_classes=10)

@@ -225,6 +225,10 @@ def generate(model, params, prompt, max_new_tokens: int, *,
         raise ValueError(f"temperature must be >= 0, got {temperature}")
     if not 0.0 <= top_p <= 1.0:
         raise ValueError(f"top_p must be in [0, 1], got {top_p}")
+    if prefill_chunk < 0:
+        raise ValueError(
+            f"prefill_chunk must be >= 0, got {prefill_chunk}"
+        )
     if temperature > 0.0 and rng is None:
         raise ValueError("sampling (temperature > 0) needs an rng key")
     if max_new_tokens == 0:

@@ -76,6 +76,7 @@ class Trainer:
             vocab_size=cfg.data.vocab_size,
             path=cfg.data.path,
             token_dtype=cfg.data.token_dtype,
+            sample=cfg.data.sample,
         )
         self.loader = DataLoader(self.dataset, self.mesh,
                                  prefetch=cfg.data.prefetch)
