@@ -452,17 +452,18 @@ _flash_diff.defvjp(_flash_diff_fwd, _flash_diff_bwd)
 
 
 def _pick_block(T: int, want: int) -> int | None:
-    """Largest block size <= want that divides T (v5e sweep at T=32k:
-    512x512 blocks are 3.8x faster than 128x128 — bigger MXU tiles,
-    fewer grid steps). None = no candidate divides T."""
-    for b in (want, 256, 128):
+    """Largest block size <= want that divides T (v5e sweeps: at T=32k,
+    512x512 is 3.8x faster than 128x128 and 1024x1024 another 1.33x over
+    512x512 — bigger MXU tiles, fewer grid steps; 2048 blocks fail to
+    compile at D=128, over VMEM). None = no candidate divides T."""
+    for b in (want, 512, 256, 128):
         if b <= want and T % b == 0:
             return b
     return None
 
 
-def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 512,
-                    block_k: int = 512):
+def flash_attention(q, k, v, *, causal: bool = True, block_q: int = 1024,
+                    block_k: int = 1024):
     """(B, T, H, D) attention; k/v may carry fewer heads (GQA) as long
     as Hkv divides H — grouped KV is streamed natively (each KV tile
     serves its whole Q-head group), cutting streamed KV bytes by
