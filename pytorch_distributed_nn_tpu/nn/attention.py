@@ -51,12 +51,13 @@ def dot_product_attention(
     impl: 'xla' (fused by the compiler; required for padding masks and
     cross-length kv), 'flash' (Pallas kernels in both directions: the
     streamed forward plus the two-pass lse-replay backward), or 'auto'.
-    Measured on v5e (llama-shaped blocks, fwd+bwd): xla wins at T=512,
-    ~tie at 1k (isolated A/B favors flash 1.34x; full-model bench is
-    within noise either way), flash clearly from 2k up (1.59x at 2k,
-    growing with T — xla's (T, T) scores thrash HBM from 8k) — so
-    'auto' picks flash on TPU for self-attention at T >= 2048 with no
-    padding mask.
+    Measured on v5e (llama-shaped blocks, fwd+bwd): xla wins at T=512;
+    T=1k is batch-dependent (a batch-4 isolated A/B favors flash 1.2x,
+    but the batch-1 full-model bench favors xla — too few grid rows to
+    fill the chip), flash clearly from 2k up (1.33x+ with 1024-token
+    blocks, growing with T — xla's (T, T) scores thrash HBM from 8k) —
+    so 'auto' picks flash on TPU for self-attention at T >= 2048 with
+    no padding mask.
     """
     if impl == "auto":
         impl = ("flash" if jax.default_backend() == "tpu"
