@@ -105,6 +105,10 @@ class TrainConfig:
     checkpoint_every: int = 0
     resume: bool = True
     profile_dir: str = ""
+    # structured JSONL metrics (utils/metrics.MetricsLogger): every
+    # log_every step + every eval as machine-readable events, emitted by
+    # the coordinator only ("" = off)
+    metrics_path: str = ""
     mesh: MeshSpec = field(default_factory=MeshSpec)
     optim: OptimConfig = field(default_factory=OptimConfig)
     data: DataConfig = field(default_factory=DataConfig)

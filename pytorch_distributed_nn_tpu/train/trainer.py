@@ -95,6 +95,13 @@ class Trainer:
         self._eval_step = None  # built lazily on first evaluate()
         self._eval_batches: dict[int, tuple] = {}  # device-resident cache
         self.data_step = 0  # next dataset step to consume (resume-aware)
+        self.metrics = None
+        if cfg.metrics_path:
+            from pytorch_distributed_nn_tpu.utils.metrics import (
+                MetricsLogger,
+            )
+
+            self.metrics = MetricsLogger(cfg.metrics_path)
         self.ckpt = None
         if cfg.checkpoint_dir:
             from pytorch_distributed_nn_tpu.train.checkpoint import (
@@ -180,6 +187,14 @@ class Trainer:
                                  seconds=now - t_last)
                 t_last = now
                 self.history.append(rec)
+                if self.metrics is not None:
+                    self.metrics.emit(
+                        "train_step", step=rec.step, loss=rec.loss,
+                        seconds=round(rec.seconds, 4),
+                        samples_per_sec=round(
+                            cfg.log_every * cfg.data.batch_size
+                            / max(rec.seconds, 1e-9), 2),
+                    )
                 if jax.process_index() == 0:
                     log.info("step %d loss %.4f (%.3fs)", g - 1, loss,
                              rec.seconds)
@@ -268,6 +283,9 @@ class Trainer:
                          loss=float(np.mean(losses)),
                          accuracy=float(np.mean(accs)))
         self.eval_history.append(rec)
+        if self.metrics is not None:
+            self.metrics.emit("eval", step=rec.step, loss=rec.loss,
+                              accuracy=rec.accuracy)
         if jax.process_index() == 0:
             log.info("eval @ step %d: loss %.4f acc %.4f",
                      rec.step, rec.loss, rec.accuracy)
@@ -282,6 +300,8 @@ class Trainer:
     def close(self) -> None:
         if self.ckpt is not None:
             self.ckpt.close()
+        if self.metrics is not None:
+            self.metrics.close()
 
     def losses(self) -> list[float]:
         return [r.loss for r in self.history]

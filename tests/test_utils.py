@@ -71,3 +71,28 @@ def test_metrics_logger_jsonl(tmp_path):
     assert lines[0]["event"] == "step" and lines[0]["loss"] == 1.5
     assert lines[1]["metric"] == "samples/sec/chip"
     assert lines[1]["vs_baseline"] == 1.1
+
+
+def test_trainer_emits_metrics_jsonl(tmp_path):
+    import json
+
+    from pytorch_distributed_nn_tpu.config import get_config
+    from pytorch_distributed_nn_tpu.runtime.mesh import MeshSpec, make_mesh
+    from pytorch_distributed_nn_tpu.train.trainer import Trainer
+
+    path = tmp_path / "metrics.jsonl"
+    cfg = get_config("mlp_mnist", steps=4, log_every=2)
+    cfg.data.prefetch = 0
+    cfg.metrics_path = str(path)
+    cfg.eval_every = 4
+    cfg.eval_batches = 1
+    trainer = Trainer(cfg, mesh=make_mesh(MeshSpec(data=8).resolve(8)))
+    trainer.train()
+    trainer.close()
+    events = [json.loads(line) for line in path.read_text().splitlines()]
+    kinds = [e["event"] for e in events]
+    assert "train_step" in kinds and "eval" in kinds
+    step_ev = next(e for e in events if e["event"] == "train_step")
+    assert {"step", "loss", "seconds", "samples_per_sec"} <= set(step_ev)
+    eval_ev = next(e for e in events if e["event"] == "eval")
+    assert {"step", "loss", "accuracy"} <= set(eval_ev)
