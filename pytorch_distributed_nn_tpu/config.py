@@ -101,6 +101,9 @@ class TrainConfig:
     # logits). At long context the (B, T, V) logits are the HBM
     # limiter; chunking keeps one (B, chunk, V) block live instead.
     xent_chunk: int = 0
+    # torch CrossEntropyLoss(label_smoothing=...) semantics; not
+    # combinable with xent_chunk
+    label_smoothing: float = 0.0
     checkpoint_dir: str = ""
     checkpoint_every: int = 0
     resume: bool = True

@@ -95,6 +95,11 @@ def make_train_step(
                 f"xent_chunk {cfg.xent_chunk} — the dense fallback "
                 "would defeat the memory bound"
             )
+        if cfg.label_smoothing:
+            raise ValueError(
+                "label_smoothing is not supported with xent_chunk "
+                "(the chunked loss computes plain nll blockwise)"
+            )
         loss_fn = make_chunked_loss(cfg.xent_chunk)
     accum = cfg.parallel.grad_accum
     if accum < 1:

@@ -146,5 +146,34 @@ _LOSSES = {
 }
 
 
-def get_loss_fn(dataset_name: str):
-    return _LOSSES.get(dataset_name, softmax_xent)
+def _smoothed(base, eps: float):
+    """torch ``CrossEntropyLoss(label_smoothing=eps)`` semantics:
+    per-element loss = (1-eps)·nll + eps·(uniform xent over classes);
+    the -1=ignore masking of :func:`masked_lm_xent` is preserved by
+    applying the same formula under its mask."""
+
+    def loss_fn(logits, labels):
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        uniform = -logp.mean(-1)
+        nll = -jnp.take_along_axis(
+            logp, jnp.maximum(labels, 0)[..., None], axis=-1
+        )[..., 0]
+        per = (1.0 - eps) * nll + eps * uniform
+        if base is masked_lm_xent:
+            valid = labels >= 0
+            per = jnp.where(valid, per, 0.0)
+            return per.sum() / jnp.maximum(valid.sum(), 1)
+        return per.mean()
+
+    return loss_fn
+
+
+def get_loss_fn(dataset_name: str, *, label_smoothing: float = 0.0):
+    base = _LOSSES.get(dataset_name, softmax_xent)
+    if label_smoothing == 0.0:
+        return base
+    if not 0.0 <= label_smoothing < 1.0:
+        raise ValueError(
+            f"label_smoothing must be in [0, 1), got {label_smoothing}"
+        )
+    return _smoothed(base, label_smoothing)

@@ -80,7 +80,9 @@ class Trainer:
         )
         self.loader = DataLoader(self.dataset, self.mesh,
                                  prefetch=cfg.data.prefetch)
-        self.loss_fn = get_loss_fn(cfg.data.dataset)
+        self.loss_fn = get_loss_fn(
+            cfg.data.dataset, label_smoothing=cfg.label_smoothing
+        )
         self.model = get_model(cfg.model)
         self.state = self._init_state()
         step_fn, place_fn = make_train_step(cfg, self.mesh, self.loss_fn,

@@ -1,0 +1,64 @@
+"""Label smoothing — oracle is torch CrossEntropyLoss(label_smoothing=)
+itself (CPU build), the reference semantics being reproduced."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_nn_tpu.train.losses import get_loss_fn
+
+torch = pytest.importorskip("torch")
+
+
+@pytest.mark.parametrize("eps", [0.0, 0.1, 0.3])
+def test_matches_torch_classification(eps):
+    rng = np.random.default_rng(0)
+    logits = rng.normal(size=(16, 10)).astype(np.float32)
+    labels = rng.integers(0, 10, size=16).astype(np.int64)
+    ours = get_loss_fn("mnist", label_smoothing=eps)(
+        jnp.asarray(logits), jnp.asarray(labels.astype(np.int32))
+    )
+    want = torch.nn.functional.cross_entropy(
+        torch.from_numpy(logits), torch.from_numpy(labels),
+        label_smoothing=eps,
+    )
+    np.testing.assert_allclose(float(ours), float(want), rtol=1e-5)
+
+
+@pytest.mark.parametrize("eps", [0.1])
+def test_matches_torch_masked_mlm(eps):
+    rng = np.random.default_rng(1)
+    logits = rng.normal(size=(4, 8, 11)).astype(np.float32)
+    labels = rng.integers(0, 11, size=(4, 8)).astype(np.int64)
+    labels[rng.random(labels.shape) < 0.6] = -1  # ignore positions
+    ours = get_loss_fn("mlm_synthetic", label_smoothing=eps)(
+        jnp.asarray(logits), jnp.asarray(labels.astype(np.int32))
+    )
+    want = torch.nn.functional.cross_entropy(
+        torch.from_numpy(logits).reshape(-1, 11),
+        torch.from_numpy(labels).reshape(-1),
+        ignore_index=-1, label_smoothing=eps,
+    )
+    np.testing.assert_allclose(float(ours), float(want), rtol=1e-5)
+
+
+def test_smoothing_zero_is_base_fn():
+    base = get_loss_fn("lm_synthetic")
+    assert get_loss_fn("lm_synthetic", label_smoothing=0.0) is base
+
+
+def test_invalid_smoothing_rejected():
+    with pytest.raises(ValueError, match="label_smoothing"):
+        get_loss_fn("mnist", label_smoothing=1.0)
+
+
+def test_chunked_xent_rejects_smoothing():
+    from pytorch_distributed_nn_tpu.config import get_config
+    from pytorch_distributed_nn_tpu.parallel import make_train_step
+    from pytorch_distributed_nn_tpu.runtime.mesh import MeshSpec, make_mesh
+
+    cfg = get_config("llama3_longcontext")
+    cfg.label_smoothing = 0.1
+    with pytest.raises(ValueError, match="label_smoothing"):
+        make_train_step(cfg, make_mesh(MeshSpec(data=8).resolve(8)),
+                        lambda a, b: 0.0)
