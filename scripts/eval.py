@@ -1,0 +1,60 @@
+#!/usr/bin/env python
+"""Evaluation entrypoint: checkpoint -> held-out loss/accuracy.
+
+Usage:
+    python scripts/eval.py --preset mlp_mnist --checkpoint-dir runs/ckpt \
+        [--batches 16] [--a.b config overrides ...]
+
+Restores the latest checkpoint into the preset's model and runs the
+held-out evaluation stream (same-task batches from a step range training
+cannot reach — train/trainer.py). Prints one JSON line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+sys.path.insert(0, ".")  # run from repo root without install
+
+from pytorch_distributed_nn_tpu.runtime.platform import (
+    apply_platform_overrides,
+)
+
+apply_platform_overrides()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--preset", required=True)
+    ap.add_argument("--checkpoint-dir", required=True)
+    ap.add_argument("--batches", type=int, default=16)
+    args, rest = ap.parse_known_args(argv)
+
+    from pytorch_distributed_nn_tpu.config import get_config, parse_overrides
+    from pytorch_distributed_nn_tpu.runtime import bootstrap
+    from pytorch_distributed_nn_tpu.train.trainer import Trainer
+
+    bootstrap.initialize()
+    cfg = get_config(args.preset, **parse_overrides(rest))
+    cfg.steps = 0  # restore only; no training
+    cfg.checkpoint_dir = args.checkpoint_dir
+    cfg.resume = True
+    if cfg.parallel.strategy == "pipeline":
+        cfg.parallel.strategy = "dp"  # evaluate() needs unstacked params
+    trainer = Trainer(cfg)
+    if trainer.ckpt is None or trainer.ckpt.latest_step() is None:
+        print(f"no checkpoint found in {args.checkpoint_dir}",
+              file=sys.stderr)
+        return 1
+    rec = trainer.evaluate(num_batches=args.batches)
+    trainer.close()
+    print(json.dumps(dict(step=rec.step, eval_loss=round(rec.loss, 6),
+                          eval_accuracy=round(rec.accuracy, 6),
+                          batches=args.batches)))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))
