@@ -36,6 +36,9 @@ class OptimConfig:
     # skip weight decay on 1-D params (norm scales/biases) — the usual
     # LLM recipe; False reproduces torch's decay-everything default
     decay_mask_norms: bool = False
+    # store adam/adamw/lion first moments in this dtype ("" = param
+    # dtype): "bfloat16" halves that slice of optimizer HBM
+    mu_dtype: str = ""
 
 
 @dataclass

@@ -59,15 +59,19 @@ def make_optimizer(cfg: OptimConfig,
                    total_steps: int = 10_000) -> optax.GradientTransformation:
     schedule = make_schedule(cfg, total_steps)
     mask = _decay_mask if cfg.decay_mask_norms else None
+    mu_dtype = cfg.mu_dtype or None  # bf16 halves first-moment HBM
     if cfg.name == "sgd":
         opt = optax.sgd(schedule)
     elif cfg.name == "momentum":
-        opt = optax.sgd(schedule, momentum=cfg.momentum)
+        opt = optax.sgd(schedule, momentum=cfg.momentum,
+                        accumulator_dtype=mu_dtype)
     elif cfg.name == "adam":
-        opt = optax.adam(schedule, b1=cfg.b1, b2=cfg.b2, eps=cfg.eps)
+        opt = optax.adam(schedule, b1=cfg.b1, b2=cfg.b2, eps=cfg.eps,
+                         mu_dtype=mu_dtype)
     elif cfg.name == "adamw":
         opt = optax.adamw(schedule, b1=cfg.b1, b2=cfg.b2, eps=cfg.eps,
-                          weight_decay=cfg.weight_decay, mask=mask)
+                          weight_decay=cfg.weight_decay, mask=mask,
+                          mu_dtype=mu_dtype)
     elif cfg.name == "adafactor":
         # The TPU-native memory-factored optimizer (Shazeer & Stern): 2nd
         # moments stored as row/col factors, O(n+m) not O(nm) state per
@@ -80,7 +84,8 @@ def make_optimizer(cfg: OptimConfig,
                          weight_decay=cfg.weight_decay, mask=mask)
     elif cfg.name == "lion":
         opt = optax.lion(schedule, b1=cfg.b1, b2=cfg.b2,
-                         weight_decay=cfg.weight_decay, mask=mask)
+                         weight_decay=cfg.weight_decay, mask=mask,
+                         mu_dtype=mu_dtype)
     else:
         raise ValueError(f"unknown optimizer {cfg.name!r}")
 

@@ -80,6 +80,23 @@ def test_decay_mask_skips_1d_params():
     assert np.all(np.asarray(updates["scale"]) == 0)  # masked
 
 
+def test_mu_dtype_halves_first_moment():
+    import jax
+    import jax.numpy as jnp
+
+    tx = make_optimizer(OptimConfig(name="adamw", lr=0.1,
+                                    mu_dtype="bfloat16"), 10)
+    params = {"w": jnp.ones((8, 8), jnp.float32)}
+    state = tx.init(params)
+    mus = [x for x in jax.tree.leaves(state)
+           if hasattr(x, "dtype") and x.dtype == jnp.bfloat16]
+    assert mus, "no bf16 moment found in opt state"
+    # still steps in the descent direction
+    grads = {"w": jnp.full((8, 8), 0.5)}
+    updates, _ = tx.update(grads, state, params)
+    assert np.all(np.asarray(updates["w"], np.float32) < 0)
+
+
 def test_unknown_optimizer():
     with pytest.raises(ValueError):
         make_optimizer(OptimConfig(name="rmsprop"), 10)
