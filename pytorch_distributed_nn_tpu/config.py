@@ -36,8 +36,9 @@ class OptimConfig:
     # skip weight decay on 1-D params (norm scales/biases) — the usual
     # LLM recipe; False reproduces torch's decay-everything default
     decay_mask_norms: bool = False
-    # store adam/adamw/lion first moments in this dtype ("" = param
-    # dtype): "bfloat16" halves that slice of optimizer HBM
+    # store momentum/adam/adamw/lion first moments in this dtype
+    # ("" = param dtype): "bfloat16" halves that slice of optimizer HBM
+    # (rejected for optimizers without moment-dtype control)
     mu_dtype: str = ""
 
 

@@ -60,6 +60,13 @@ def make_optimizer(cfg: OptimConfig,
     schedule = make_schedule(cfg, total_steps)
     mask = _decay_mask if cfg.decay_mask_norms else None
     mu_dtype = cfg.mu_dtype or None  # bf16 halves first-moment HBM
+    if mu_dtype and cfg.name not in ("momentum", "adam", "adamw", "lion"):
+        # optax.lamb/sgd/adafactor expose no moment-dtype control —
+        # silently ignoring the setting would fake the HBM saving
+        raise ValueError(
+            f"mu_dtype is not supported for optimizer {cfg.name!r} "
+            "(momentum/adam/adamw/lion only)"
+        )
     if cfg.name == "sgd":
         opt = optax.sgd(schedule)
     elif cfg.name == "momentum":

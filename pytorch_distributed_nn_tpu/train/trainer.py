@@ -166,6 +166,7 @@ class Trainer:
         self.loader.start_step = self.data_step  # don't replay batches
         it = iter(self.loader)
         t_last = time.perf_counter()
+        g_last = self.data_step  # step count behind each logged record
         for i in range(steps):
             x, y = next(it)
             self.data_step += 1
@@ -190,13 +191,15 @@ class Trainer:
                 t_last = now
                 self.history.append(rec)
                 if self.metrics is not None:
+                    covered = g - g_last  # actual steps in this record
                     self.metrics.emit(
                         "train_step", step=rec.step, loss=rec.loss,
                         seconds=round(rec.seconds, 4),
                         samples_per_sec=round(
-                            cfg.log_every * cfg.data.batch_size
+                            covered * cfg.data.batch_size
                             / max(rec.seconds, 1e-9), 2),
                     )
+                g_last = g
                 if jax.process_index() == 0:
                     log.info("step %d loss %.4f (%.3fs)", g - 1, loss,
                              rec.seconds)

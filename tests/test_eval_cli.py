@@ -1,0 +1,45 @@
+"""scripts/eval.py: checkpoint -> held-out metrics, including the
+pipeline path (stacked stage params restored against a stacked
+template, unstacked, evaluated under dp)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+PIPE_ARGS = ["--data.batch_size", "16", "--data.seq_len", "16",
+             "--data.vocab_size", "101", "--model.remat", "false",
+             "--model.extra",
+             '{"num_layers":4,"d_model":32,"num_heads":2,"mlp_dim":64,'
+             '"vocab_size":101,"max_len":64}',
+             "--parallel.microbatches", "2", "--mesh.pipe", "2",
+             "--mesh.data", "4", "--data.prefetch", "0"]
+
+
+def run_cli(script, *args):
+    env = dict(os.environ, JAX_PLATFORMS="cpu", JAX_NUM_CPU_DEVICES="8")
+    return subprocess.run(
+        [sys.executable, script, *args], env=env, cwd="/root/repo",
+        capture_output=True, text=True, timeout=420,
+    )
+
+
+def test_eval_cli_pipeline_checkpoint(tmp_path):
+    ckpt = tmp_path / "ckpt"
+    r = run_cli("scripts/train.py", "--preset", "transformer_lm_pp",
+                "--steps", "60", "--log_every", "0",
+                "--optim.lr", "0.003", "--optim.warmup_steps", "0",
+                "--checkpoint_dir", str(ckpt), "--checkpoint_every",
+                "60", *PIPE_ARGS)
+    assert r.returncode == 0, r.stderr
+    r = run_cli("scripts/eval.py", "--preset", "transformer_lm_pp",
+                "--checkpoint-dir", str(ckpt), "--batches", "2",
+                *PIPE_ARGS)
+    assert r.returncode == 0, r.stderr
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    # random init scores ~ln(101)=4.6; 60 trained steps reach ~3.4
+    # (measured) — well below proves the stacked checkpoint's weights
+    # actually loaded, not a fresh init
+    assert rec["eval_loss"] < 4.0, rec
