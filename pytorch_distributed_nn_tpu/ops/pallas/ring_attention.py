@@ -174,8 +174,17 @@ def _ring_block_reference(q, k_blk, v_blk, m, l, acc, offs, *,
     return bcast(m_new), bcast(l_new), acc_new
 
 
+def _fit_block(want: int, n: int) -> int:
+    """Largest candidate <= want dividing n (v5e A/B at Tl=8k: 512x512
+    blocks are 1.8x faster than 128x128; 1024 exceeds VMEM)."""
+    for b in (want, 256, 128, 64, 32, 16, 8):
+        if b <= want and n % b == 0:
+            return b
+    return 0  # no divisor — caller falls back to the jnp reference
+
+
 def ring_block_update(q, k_blk, v_blk, m, l, acc, offs, *, causal: bool,
-                      block_q: int = 128, block_k: int = 128,
+                      block_q: int = 512, block_k: int = 512,
                       interpret: bool = False):
     """Dispatch one ring step's block update: Pallas on TPU (or interpret
     mode for CPU correctness runs), jnp oracle otherwise.
@@ -187,9 +196,9 @@ def ring_block_update(q, k_blk, v_blk, m, l, acc, offs, *, causal: bool,
     Tl, D = q.shape[1], q.shape[2]
     on_tpu = jax.default_backend() == "tpu"
     use_pallas = on_tpu or interpret
-    block_q = min(block_q, Tl)
-    block_k = min(block_k, k_blk.shape[1])
-    if Tl % block_q or k_blk.shape[1] % block_k:
+    block_q = _fit_block(min(block_q, Tl), Tl)
+    block_k = _fit_block(min(block_k, k_blk.shape[1]), k_blk.shape[1])
+    if not block_q or not block_k:
         use_pallas = False
     if not use_pallas:
         log.warning(
