@@ -31,6 +31,7 @@ def get_model(cfg: ModelConfig):
         moe_lm,
         resnet,
         transformer_lm,
+        vit,
     )
 
     if cfg.name not in _REGISTRY:
@@ -49,6 +50,7 @@ def available_models() -> list[str]:
         moe_lm,
         resnet,
         transformer_lm,
+        vit,
     )
 
     return sorted(_REGISTRY)

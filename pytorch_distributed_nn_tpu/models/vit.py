@@ -1,0 +1,118 @@
+"""Vision Transformer — beyond the reference's model list (SURVEY.md §2a
+names MLP/LeNet/ResNet for vision), included for zoo breadth: the
+transformer stack a reference user would reach for next, built from the
+same attention module as the LM families so TP sharding rules and flash
+attention apply unchanged.
+
+Pre-LN encoder (ViT-style), learned positional embeddings, CLS token,
+patchify via a non-overlapping Conv — all MXU-friendly shapes.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from pytorch_distributed_nn_tpu.config import ModelConfig
+from pytorch_distributed_nn_tpu.models import register
+from pytorch_distributed_nn_tpu.nn.attention import MultiHeadAttention
+from pytorch_distributed_nn_tpu.nn.dtypes import get_policy
+
+
+class EncoderBlock(nn.Module):
+    num_heads: int
+    mlp_dim: int
+    dropout: float = 0.0
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        d = x.shape[-1]
+        y = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="ln1")(x)
+        y = MultiHeadAttention(
+            num_heads=self.num_heads, head_dim=d // self.num_heads,
+            causal=False, dtype=self.dtype,
+            param_dtype=self.param_dtype, name="attn",
+        )(y)
+        if self.dropout:
+            y = nn.Dropout(self.dropout, deterministic=not train)(y)
+        x = x + y
+        y = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="ln2")(x)
+        y = nn.Dense(self.mlp_dim, dtype=self.dtype,
+                     param_dtype=self.param_dtype, name="mlp_in")(y)
+        y = nn.gelu(y)
+        y = nn.Dense(d, dtype=self.dtype, param_dtype=self.param_dtype,
+                     name="mlp_out")(y)
+        if self.dropout:
+            y = nn.Dropout(self.dropout, deterministic=not train)(y)
+        return x + y
+
+
+class ViT(nn.Module):
+    num_classes: int = 10
+    patch_size: int = 4
+    num_layers: int = 6
+    d_model: int = 192
+    num_heads: int = 3
+    mlp_dim: int = 768
+    dropout: float = 0.0
+    dtype: jnp.dtype = jnp.float32
+    param_dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, *, train: bool = False):
+        if x.ndim == 3:  # grayscale (B, H, W) → NHWC
+            x = x[..., None]
+        H, W = x.shape[1], x.shape[2]
+        p = self.patch_size
+        if H % p or W % p:
+            raise ValueError(
+                f"image {H}x{W} not divisible by patch_size {p}"
+            )
+        x = nn.Conv(self.d_model, (p, p), strides=(p, p),
+                    padding="VALID", dtype=self.dtype,
+                    param_dtype=self.param_dtype,
+                    name="patch_embed")(x.astype(self.dtype))
+        B = x.shape[0]
+        x = x.reshape(B, -1, self.d_model)  # (B, N, D)
+        cls = self.param("cls", nn.initializers.zeros,
+                         (1, 1, self.d_model), self.param_dtype)
+        x = jnp.concatenate(
+            [jnp.broadcast_to(cls, (B, 1, self.d_model)).astype(
+                self.dtype), x], axis=1)
+        pos = self.param("pos_embed",
+                         nn.initializers.normal(stddev=0.02),
+                         (1, x.shape[1], self.d_model),
+                         self.param_dtype)
+        x = x + pos.astype(self.dtype)
+        for i in range(self.num_layers):
+            x = EncoderBlock(
+                num_heads=self.num_heads, mlp_dim=self.mlp_dim,
+                dropout=self.dropout, dtype=self.dtype,
+                param_dtype=self.param_dtype, name=f"layer{i}",
+            )(x, train=train)
+        x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
+                         name="ln_f")(x)
+        return nn.Dense(self.num_classes, dtype=jnp.float32,
+                        param_dtype=self.param_dtype, name="head")(
+            x[:, 0])  # CLS token
+
+
+@register("vit")
+def build_vit(cfg: ModelConfig) -> ViT:
+    policy = get_policy(cfg.dtype, cfg.compute_dtype)
+    e = cfg.extra
+    return ViT(
+        num_classes=e.get("num_classes", 10),
+        patch_size=e.get("patch_size", 4),
+        num_layers=e.get("num_layers", 6),
+        d_model=e.get("d_model", 192),
+        num_heads=e.get("num_heads", 3),
+        mlp_dim=e.get("mlp_dim", 768),
+        dropout=e.get("dropout", 0.0),
+        dtype=policy.compute_dtype,
+        param_dtype=policy.param_dtype,
+    )

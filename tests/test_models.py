@@ -22,12 +22,15 @@ TINY = {
                       mlp_dim=64, vocab_size=101),
     "moe_lm": dict(num_layers=2, d_model=32, num_heads=2, mlp_dim=64,
                    num_experts=4, k=2, vocab_size=101, max_len=64),
+    "vit": dict(num_layers=2, d_model=32, num_heads=2, mlp_dim=64,
+                patch_size=4),
 }
 
 IMAGE_INPUT = {
     "mlp": (28, 28),
     "lenet": (28, 28),
     "resnet50": (32, 32, 3),
+    "vit": (32, 32, 3),
 }
 
 
@@ -77,6 +80,12 @@ def _tiny_train(preset, model_name, dataset, steps=4, **data_kw):
 
 def test_resnet_trains():
     losses = _tiny_train("resnet50_dp", "resnet50", "cifar10")
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+
+
+def test_vit_trains():
+    losses = _tiny_train("lenet_cifar10", "vit", "cifar10", steps=6)
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0]
 
