@@ -102,6 +102,18 @@ def main(argv=None) -> int:
         state_dict = torch.load(args.torch_checkpoint,
                                 map_location="cpu", weights_only=True)
         converted = _converted_params(args.arch, state_dict, cfg.model)
+        if cfg.parallel.strategy == "pipeline":
+            # pipeline checkpoints hold STACKED stage params — restack
+            # the flat converted tree so train.py --resume consumes it
+            from pytorch_distributed_nn_tpu.parallel.pipeline import (
+                partition_for,
+                stack_stage_params,
+            )
+
+            converted = stack_stage_params(
+                converted, partition_for(trainer.model),
+                max(cfg.mesh.pipe, 1),
+            )
         template = trainer.state.params
         try:
             placed = jax.tree.map(
@@ -130,8 +142,17 @@ def main(argv=None) -> int:
         raise SystemExit("export currently supports --arch llama3 only")
     from pytorch_distributed_nn_tpu.utils import torch_interop as ti
 
+    params = state.params
+    if cfg.parallel.strategy == "pipeline":
+        from pytorch_distributed_nn_tpu.parallel.pipeline import (
+            partition_for,
+            unstack_stage_params,
+        )
+
+        params = unstack_stage_params(jax.device_get(params),
+                                      partition_for(trainer.model))
     host_params = jax.tree.map(
-        lambda x: np.asarray(jax.device_get(x), np.float32), state.params
+        lambda x: np.asarray(jax.device_get(x), np.float32), params
     )
     torch.save(ti.llama_params_to_torch(host_params),
                args.torch_checkpoint)

@@ -22,7 +22,7 @@ OVERRIDES = ["--model.extra", EXTRA, "--data.vocab_size", "256",
 
 
 def run_cli(script, *args):
-    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env = dict(os.environ, JAX_PLATFORMS="cpu", JAX_NUM_CPU_DEVICES="8")
     return subprocess.run(
         [sys.executable, script, *args], env=env, cwd="/root/repo",
         capture_output=True, text=True, timeout=300,
@@ -69,3 +69,38 @@ def test_convert_import_generate_export(tmp_path):
     for key, tensor in exported.items():
         np.testing.assert_allclose(tensor.numpy(), sd[key].numpy(),
                                    rtol=0, atol=0, err_msg=key)
+
+
+PIPE_EXTRA = ('{"num_layers":4,"d_model":48,"num_heads":4,"mlp_dim":192,'
+              '"vocab_size":128,"max_len":64,"ln_eps":1e-5}')
+PIPE_OV = ["--model.extra", PIPE_EXTRA, "--data.vocab_size", "128",
+           "--data.seq_len", "16", "--data.batch_size", "16",
+           "--model.remat", "false", "--mesh.pipe", "2",
+           "--mesh.data", "4", "--parallel.microbatches", "2",
+           "--data.prefetch", "0"]
+
+
+def test_convert_gpt2_into_pipeline_preset(tmp_path):
+    """Converted weights for a PIPELINE preset must be saved in the
+    stacked stage layout so train.py --resume consumes them."""
+    transformers = pytest.importorskip("transformers")
+    cfg = transformers.GPT2Config(
+        vocab_size=128, n_positions=64, n_embd=48, n_layer=4, n_head=4,
+        layer_norm_epsilon=1e-5, activation_function="gelu_new",
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+    torch.manual_seed(0)
+    hf = transformers.GPT2LMHeadModel(cfg)
+    pt = tmp_path / "gpt2.pt"
+    torch.save(hf.state_dict(), pt)
+
+    ckpt = tmp_path / "ckpt"
+    r = run_cli("scripts/convert.py", "--arch", "gpt2", "--preset",
+                "transformer_lm_pp", "--torch-checkpoint", str(pt),
+                "--out", str(ckpt), *PIPE_OV)
+    assert r.returncode == 0, r.stderr
+
+    r = run_cli("scripts/train.py", "--preset", "transformer_lm_pp",
+                "--steps", "2", "--log_every", "1",
+                "--checkpoint_dir", str(ckpt), *PIPE_OV)
+    assert r.returncode == 0, r.stderr
+    assert "final: step=1" in r.stdout, r.stdout
