@@ -23,6 +23,7 @@ class EncoderBlock(nn.Module):
     num_heads: int
     mlp_dim: int
     dropout: float = 0.0
+    attn_impl: str = "xla"
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
 
@@ -33,7 +34,7 @@ class EncoderBlock(nn.Module):
                          name="ln1")(x)
         y = MultiHeadAttention(
             num_heads=self.num_heads, head_dim=d // self.num_heads,
-            causal=False, dtype=self.dtype,
+            causal=False, impl=self.attn_impl, dtype=self.dtype,
             param_dtype=self.param_dtype, name="attn",
         )(y)
         if self.dropout:
@@ -59,6 +60,10 @@ class ViT(nn.Module):
     num_heads: int = 3
     mlp_dim: int = 768
     dropout: float = 0.0
+    # 'xla' default: ViT patch counts are short sequences (e.g. 65 at
+    # 32px/4) where the einsum path wins; 'auto'/'flash' available for
+    # high-resolution patch grids
+    attn_impl: str = "xla"
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
 
@@ -91,7 +96,8 @@ class ViT(nn.Module):
         for i in range(self.num_layers):
             x = EncoderBlock(
                 num_heads=self.num_heads, mlp_dim=self.mlp_dim,
-                dropout=self.dropout, dtype=self.dtype,
+                dropout=self.dropout, attn_impl=self.attn_impl,
+                dtype=self.dtype,
                 param_dtype=self.param_dtype, name=f"layer{i}",
             )(x, train=train)
         x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
@@ -113,6 +119,7 @@ def build_vit(cfg: ModelConfig) -> ViT:
         num_heads=e.get("num_heads", 3),
         mlp_dim=e.get("mlp_dim", 768),
         dropout=e.get("dropout", 0.0),
+        attn_impl=e.get("attn_impl", "xla"),
         dtype=policy.compute_dtype,
         param_dtype=policy.param_dtype,
     )

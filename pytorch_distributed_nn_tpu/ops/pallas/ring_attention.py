@@ -177,7 +177,7 @@ def _ring_block_reference(q, k_blk, v_blk, m, l, acc, offs, *,
 def _fit_block(want: int, n: int) -> int:
     """Largest candidate <= want dividing n (v5e A/B at Tl=8k: 512x512
     blocks are 1.8x faster than 128x128; 1024 exceeds VMEM)."""
-    for b in (want, 256, 128, 64, 32, 16, 8):
+    for b in (want, 512, 256, 128, 64, 32, 16, 8):
         if b <= want and n % b == 0:
             return b
     return 0  # no divisor — caller falls back to the jnp reference
