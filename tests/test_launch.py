@@ -198,14 +198,17 @@ def test_staggered_clean_finish_is_not_a_hang(tmp_path):
         hb = failure.maybe_start_heartbeat()
         assert hb is not None
         if rank == 1:
-            time.sleep(12)  # keeps running well past the 8s timeout
+            time.sleep(15)  # keeps running well past the 10s timeout
         with open(f"{sys.argv[1]}/done{rank}", "w") as f:
             f.write("ok")
         hb.stop()
     """)
+    # timeout sized with headroom: a loaded CI host can starve the
+    # 0.2s-interval heartbeat thread for seconds — the property under
+    # test only needs sleep > timeout, not a tight margin
     result = launch(
         [script, str(tmp_path)],
-        LaunchConfig(nprocs=2, max_restarts=1, heartbeat_timeout_s=8.0,
+        LaunchConfig(nprocs=2, max_restarts=1, heartbeat_timeout_s=10.0,
                      heartbeat_interval_s=0.2,
                      env={"PYTHONPATH": os.path.dirname(os.path.dirname(
                          os.path.abspath(__file__)))}),
