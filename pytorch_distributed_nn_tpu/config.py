@@ -54,11 +54,9 @@ class DataConfig:
     # DistributedSampler semantics) or 'replacement' (i.i.d.)
     sample: str = "shuffle"
     batch_size: int = 128  # global batch size
-    num_workers: int = 2
     seq_len: int = 512
     vocab_size: int = 32000
-    synthetic: bool = True  # zero-egress environment: synthetic by default
-    prefetch: int = 2
+    prefetch: int = 2  # background host batches kept ready (0 = sync)
 
 
 @dataclass
