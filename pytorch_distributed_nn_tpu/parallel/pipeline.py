@@ -169,6 +169,47 @@ def unstack_stage_params(params: dict, part: StagePartition) -> dict:
     return out
 
 
+def restore_unstacked_params(cfg, checkpoint_dir: str):
+    """Restore a pipeline checkpoint (STACKED stage params) and return
+    the flat per-block tree on host, or None when no checkpoint exists.
+
+    Builds the stacked template from a fresh init — no pipeline mesh is
+    needed (restore places to the template's single-device layout), so
+    this works on hosts with fewer devices than ``cfg.mesh.pipe``. The
+    shared mechanism behind ``scripts/eval.py`` (evaluate a pipeline
+    run under dp) and checkpoint export."""
+    from pytorch_distributed_nn_tpu.data import get_dataset
+    from pytorch_distributed_nn_tpu.models import get_model
+    from pytorch_distributed_nn_tpu.train.checkpoint import (
+        CheckpointManager,
+    )
+    from pytorch_distributed_nn_tpu.train.optim import make_optimizer
+    from pytorch_distributed_nn_tpu.train.state import TrainState
+
+    mgr = CheckpointManager(checkpoint_dir, async_save=False)
+    try:
+        if mgr.latest_step() is None:
+            return None
+        model = get_model(cfg.model)
+        ds = get_dataset(cfg.data.dataset, seed=cfg.seed, batch_size=1,
+                         seq_len=cfg.data.seq_len,
+                         vocab_size=cfg.data.vocab_size)
+        x0, _ = ds.batch(0)
+        flat = model.init(jax.random.key(cfg.seed), jnp.asarray(x0),
+                          train=False)["params"]
+        part = partition_for(model)
+        stacked = stack_stage_params(flat, part, max(cfg.mesh.pipe, 1))
+        template = TrainState.create(
+            apply_fn=model.apply, params=stacked,
+            tx=make_optimizer(cfg.optim, total_steps=max(cfg.steps, 1)),
+            rng=jax.random.key(cfg.seed + 1),
+        )
+        state, _ = mgr.restore(template)
+        return unstack_stage_params(jax.device_get(state.params), part)
+    finally:
+        mgr.close()
+
+
 def _stage_apply(part: StagePartition, stage_params, x):
     """Run this device's K blocks sequentially (scan over the stacked
     leading dim)."""

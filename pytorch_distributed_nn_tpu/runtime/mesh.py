@@ -222,6 +222,18 @@ def global_device_put(tree, shardings):
     return jax.tree.map(put, tree, shardings)
 
 
+def place_like(tree, template):
+    """``device_put`` each leaf of ``tree`` with the dtype and sharding
+    of the matching ``template`` leaf (host values → a live state's
+    layout; used by the convert/eval CLIs to install restored or
+    converted weights)."""
+    return jax.tree.map(
+        lambda a, t: jax.device_put(
+            np.asarray(a, dtype=t.dtype), t.sharding),
+        tree, template,
+    )
+
+
 def make_abstract_mesh(spec: MeshSpec, n_devices: int) -> AbstractMesh:
     """Shape-only mesh for compile-only checks (no devices needed)."""
     resolved = spec.resolve(n_devices)

@@ -114,13 +114,10 @@ def main(argv=None) -> int:
                 converted, partition_for(trainer.model),
                 max(cfg.mesh.pipe, 1),
             )
-        template = trainer.state.params
+        from pytorch_distributed_nn_tpu.runtime.mesh import place_like
+
         try:
-            placed = jax.tree.map(
-                lambda a, t: jax.device_put(
-                    np.asarray(a, dtype=t.dtype), t.sharding),
-                converted, template,
-            )
+            placed = place_like(converted, trainer.state.params)
         except ValueError as e:
             raise SystemExit(
                 f"converted weights do not fit the configured model "

@@ -25,49 +25,6 @@ from pytorch_distributed_nn_tpu.runtime.platform import (
 apply_platform_overrides()
 
 
-def _restore_pipeline_params(cfg, checkpoint_dir):
-    """Stacked pipeline checkpoint → flat (unstacked) param tree on
-    host, or None if no checkpoint exists."""
-    import jax
-    import jax.numpy as jnp
-
-    from pytorch_distributed_nn_tpu.data import get_dataset
-    from pytorch_distributed_nn_tpu.models import get_model
-    from pytorch_distributed_nn_tpu.parallel.pipeline import (
-        partition_for,
-        stack_stage_params,
-        unstack_stage_params,
-    )
-    from pytorch_distributed_nn_tpu.train.checkpoint import (
-        CheckpointManager,
-    )
-    from pytorch_distributed_nn_tpu.train.optim import make_optimizer
-    from pytorch_distributed_nn_tpu.train.state import TrainState
-
-    mgr = CheckpointManager(checkpoint_dir, async_save=False)
-    if mgr.latest_step() is None:
-        mgr.close()
-        return None
-    model = get_model(cfg.model)
-    ds = get_dataset(cfg.data.dataset, seed=cfg.seed, batch_size=1,
-                     seq_len=cfg.data.seq_len,
-                     vocab_size=cfg.data.vocab_size)
-    x0, _ = ds.batch(0)
-    flat = model.init(jax.random.key(cfg.seed), jnp.asarray(x0),
-                      train=False)["params"]
-    part = partition_for(model)
-    n_stages = max(cfg.mesh.pipe, 1)
-    stacked = stack_stage_params(flat, part, n_stages)
-    template = TrainState.create(
-        apply_fn=model.apply, params=stacked,
-        tx=make_optimizer(cfg.optim, total_steps=max(cfg.steps, 1)),
-        rng=jax.random.key(cfg.seed + 1),
-    )
-    state, _ = mgr.restore(template)
-    mgr.close()
-    return unstack_stage_params(jax.device_get(state.params), part)
-
-
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--preset", required=True)
@@ -75,12 +32,9 @@ def main(argv=None) -> int:
     ap.add_argument("--batches", type=int, default=16)
     args, rest = ap.parse_known_args(argv)
 
-    import jax
-    import numpy as np
-
     from pytorch_distributed_nn_tpu.config import get_config, parse_overrides
     from pytorch_distributed_nn_tpu.runtime import bootstrap
-    from pytorch_distributed_nn_tpu.runtime.mesh import MeshSpec
+    from pytorch_distributed_nn_tpu.runtime.mesh import MeshSpec, place_like
     from pytorch_distributed_nn_tpu.train.trainer import Trainer
 
     bootstrap.initialize()
@@ -94,7 +48,11 @@ def main(argv=None) -> int:
         # stacked template built from a fresh init (no pipeline mesh
         # needed — restore places to the template's single-device
         # layout), unstack, and evaluate under plain dp.
-        pipeline_params = _restore_pipeline_params(
+        from pytorch_distributed_nn_tpu.parallel.pipeline import (
+            restore_unstacked_params,
+        )
+
+        pipeline_params = restore_unstacked_params(
             cfg, args.checkpoint_dir
         )
         if pipeline_params is None:
@@ -110,12 +68,9 @@ def main(argv=None) -> int:
 
     trainer = Trainer(cfg)
     if pipeline_params is not None:
-        placed = jax.tree.map(
-            lambda a, t: jax.device_put(
-                np.asarray(a, dtype=t.dtype), t.sharding),
-            pipeline_params, trainer.state.params,
+        trainer.state = trainer.state.replace(
+            params=place_like(pipeline_params, trainer.state.params)
         )
-        trainer.state = trainer.state.replace(params=placed)
     elif trainer.ckpt is None or trainer.ckpt.latest_step() is None:
         print(f"no checkpoint found in {args.checkpoint_dir}",
               file=sys.stderr)
