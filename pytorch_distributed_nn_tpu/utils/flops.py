@@ -1,0 +1,127 @@
+"""Analytic model-FLOPs counting and MFU.
+
+MFU (model FLOPs utilization) = achieved model FLOPs/s divided by the
+chip's peak dense FLOPs/s. "Model FLOPs" is the *algorithmic* cost of a
+training step — forward FLOPs x 3 (the backward pass costs ~2x forward
+for matmul/conv networks: one pass for dL/dW, one for dL/dx) — counted
+on the un-rematerialized forward. Recompute inserted by
+``jax.checkpoint`` is real hardware work but NOT useful model work, so
+it does not count (the PaLM-appendix / MLPerf convention); MFU therefore
+penalizes remat exactly as it should.
+
+Forward FLOPs come from XLA's own cost model applied to the lowered
+(pre-optimization) HLO of the forward pass: the compiler literally
+counts every conv and dot at the traced shapes. This is the "counted
+convs" number for ResNet and agrees with the ``6N + 12*L*T^2*d`` closed
+form for transformer LMs (cross-checked in tests/test_flops.py). Note
+XLA counts a multiply-accumulate as 2 FLOPs, so ResNet-50 fwd at 224^2
+is ~8.2 GFLOPs here, not the "4.1 GFLOPs" MAC-count papers quote.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+# Peak dense bf16 FLOP/s per chip, keyed by substring of
+# ``device.device_kind`` (lowercased). Public figures from the TPU
+# product pages / "How to Scale Your Model".
+PEAK_BF16_FLOPS = {
+    "v6e": 918e12,
+    "v6 lite": 918e12,
+    "v5p": 459e12,
+    "v5e": 197e12,
+    "v5 lite": 197e12,
+    "v5litepod": 197e12,
+    "v4": 275e12,
+    "v3": 123e12,
+    "v2": 46e12,
+}
+
+
+def peak_flops_per_chip(device=None) -> float | None:
+    """Peak dense bf16 FLOP/s for ``device`` (default: jax.devices()[0]),
+    or None when the chip is unknown (CPU test platform)."""
+    if device is None:
+        device = jax.devices()[0]
+    kind = (getattr(device, "device_kind", "") or "").lower()
+    for key, val in PEAK_BF16_FLOPS.items():
+        if key in kind:
+            return val
+    return None
+
+
+def fwd_flops(model, x_shape: tuple, x_dtype) -> float:
+    """XLA-counted forward FLOPs of ``model.apply`` on one batch of
+    shape ``x_shape``.
+
+    Lowering is fully abstract (no params are materialized, nothing
+    executes); the count is exact for the traced shapes and scales
+    linearly in the leading batch dim for every model here, so callers
+    can count at batch 1 and multiply.
+    """
+    x = jax.ShapeDtypeStruct(tuple(x_shape), x_dtype)
+
+    def init():
+        return model.init(jax.random.key(0),
+                          jnp.zeros(x.shape, x.dtype), train=False)
+
+    variables = jax.eval_shape(init)
+
+    def fwd(v, xb):
+        return model.apply(v, xb, train=False)
+
+    lowered = jax.jit(fwd).lower(variables, x)
+    analysis = lowered.cost_analysis()
+    if not isinstance(analysis, dict) or "flops" not in analysis:
+        raise RuntimeError(
+            f"XLA cost analysis returned no flops: {analysis!r}"
+        )
+    return float(analysis["flops"])
+
+
+def train_flops_per_sample(cfg) -> float:
+    """Analytic training FLOPs for ONE sample of ``cfg``'s model on
+    ``cfg``'s data shapes: 3 x forward (see module docstring).
+
+    For LMs a "sample" is one full sequence of ``cfg.data.seq_len``
+    tokens, matching how the bench counts samples/sec.
+    """
+    from pytorch_distributed_nn_tpu.data import get_dataset
+    from pytorch_distributed_nn_tpu.models import get_model
+
+    import dataclasses
+
+    # Count the *algorithm*, not the benched implementation: remat off
+    # (recompute isn't model work), and dense-XLA attention — a Pallas
+    # flash/ring kernel is a custom call the HLO cost model scores as 0
+    # FLOPs, which would silently drop the dominant T^2 term at long
+    # context.
+    model_cfg = dataclasses.replace(
+        cfg.model, remat=False,
+        extra={**cfg.model.extra, "attn_impl": "xla"},
+    )
+    model = get_model(model_cfg)
+    spec = get_dataset(
+        cfg.data.dataset, seed=0, batch_size=1,
+        seq_len=cfg.data.seq_len, vocab_size=cfg.data.vocab_size,
+        path=cfg.data.path, token_dtype=cfg.data.token_dtype,
+    ).spec
+    return 3.0 * fwd_flops(model, (1, *spec.x_shape), spec.x_dtype)
+
+
+def lm_train_flops_per_token(n_params: int, n_layers: int,
+                             seq_len: int, d_model: int) -> float:
+    """The 6N + 12*L*T*d closed form (PaLM appendix B): per-token
+    training FLOPs of a dense transformer LM with N matmul-participating
+    params. Used as the independent cross-check of the XLA count."""
+    return 6.0 * n_params + 12.0 * n_layers * seq_len * d_model
+
+
+def mfu(samples_per_sec_chip: float, flops_per_sample: float,
+        device=None) -> float | None:
+    """Achieved / peak FLOPs for one chip; None off-TPU."""
+    peak = peak_flops_per_chip(device)
+    if peak is None:
+        return None
+    return samples_per_sec_chip * flops_per_sample / peak

@@ -52,11 +52,13 @@ class MetricsLogger:
         self._fh.flush()
 
     def emit_benchmark(self, metric: str, value: float, unit: str,
-                       vs_baseline: float | None = None) -> dict:
+                       vs_baseline: float | None = None,
+                       **extra: Any) -> dict:
         """The BASELINE.json schema line the driver's bench harness
-        expects; returned so callers can also print it bare."""
+        expects (plus any extra fields, e.g. mfu); returned so callers
+        can also print it bare."""
         rec = {"metric": metric, "value": value, "unit": unit,
-               "vs_baseline": vs_baseline}
+               "vs_baseline": vs_baseline, **extra}
         self.emit("benchmark", **rec)
         return rec
 

@@ -1,0 +1,116 @@
+"""bench.py availability hardening (VERDICT.md round-1 Missing #1).
+
+Round 1's only hard failure was bench.py dying with a raw traceback when
+the axon tunnel flapped; these tests pin the probe/backoff/structured-
+failure contract without needing a dead tunnel to reproduce.
+"""
+
+import json
+import subprocess
+import sys
+import types
+
+import pytest
+
+import bench
+
+
+def test_wait_for_backend_ok(monkeypatch):
+    calls = []
+
+    def fake_run(cmd, **kw):
+        calls.append(cmd)
+        return types.SimpleNamespace(returncode=0, stdout="8\n", stderr="")
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    assert bench.wait_for_backend(attempts=3) is None
+    assert len(calls) == 1  # no retries when the first probe answers
+
+
+def test_wait_for_backend_hang_then_recover(monkeypatch):
+    state = {"n": 0}
+
+    def fake_run(cmd, **kw):
+        state["n"] += 1
+        if state["n"] == 1:
+            raise subprocess.TimeoutExpired(cmd, kw.get("timeout", 0))
+        return types.SimpleNamespace(returncode=0, stdout="1\n", stderr="")
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    assert bench.wait_for_backend(attempts=3, probe_timeout=1) is None
+    assert state["n"] == 2
+
+
+def test_wait_for_backend_persistent_failure(monkeypatch):
+    def fake_run(cmd, **kw):
+        return types.SimpleNamespace(
+            returncode=1, stdout="",
+            stderr="RuntimeError: Unable to initialize backend 'axon': "
+                   "UNAVAILABLE",
+        )
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    detail = bench.wait_for_backend(attempts=2)
+    assert detail is not None and "UNAVAILABLE" in detail
+
+
+def test_wait_for_backend_unknown_transient_is_retried(monkeypatch):
+    # gRPC faults come in many spellings (INTERNAL: failed to connect,
+    # Socket closed, ...); anything that isn't a clear code bug must be
+    # retried, not raised — misclassifying a transient reintroduces the
+    # round-1 rc=1 crash.
+    state = {"n": 0}
+
+    def fake_run(cmd, **kw):
+        state["n"] += 1
+        return types.SimpleNamespace(
+            returncode=1, stdout="",
+            stderr="RuntimeError: Unable to initialize backend 'axon': "
+                   "INTERNAL: failed to connect to all addresses",
+        )
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    monkeypatch.setattr(bench.time, "sleep", lambda s: None)
+    detail = bench.wait_for_backend(attempts=3)
+    assert detail is not None and "failed to connect" in detail
+    assert state["n"] == 3
+
+
+def test_wait_for_backend_deterministic_failure_raises(monkeypatch):
+    # An ImportError in the probed path is a bug, not a tunnel blip —
+    # masking it as "unavailable" would green-out the bench forever.
+    def fake_run(cmd, **kw):
+        return types.SimpleNamespace(
+            returncode=1, stdout="",
+            stderr="ImportError: cannot import name 'platform'",
+        )
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    with pytest.raises(RuntimeError, match="deterministically"):
+        bench.wait_for_backend(attempts=3)
+
+
+def test_emit_unavailable_is_structured_json(capsys):
+    args = types.SimpleNamespace(metric="throughput", preset="resnet50_dp")
+    rc = bench.emit_unavailable(args, "probe hung >120s")
+    assert rc == 0  # parsed record instead of a voided round
+    rec = json.loads(capsys.readouterr().out.strip())
+    assert rec["value"] is None
+    # failure records key to the same series the run would have filled
+    assert rec["metric"] == "samples/sec/chip (resnet50_dp)"
+    assert "probe hung" in rec["error"]
+
+
+def test_probe_succeeds_on_cpu_platform(monkeypatch):
+    # The real probe subprocess honors JAX_PLATFORMS via
+    # apply_platform_overrides (sitecustomize would otherwise force the
+    # axon plugin and hang when the tunnel is down).
+    monkeypatch.setenv("JAX_PLATFORMS", "cpu")
+    monkeypatch.setenv("JAX_NUM_CPU_DEVICES", "1")
+    r = subprocess.run([sys.executable, "-c", bench._PROBE],
+                       cwd=bench.os.path.dirname(bench.__file__) or ".",
+                       capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert r.stdout.strip() == "1"
