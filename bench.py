@@ -81,7 +81,11 @@ def wait_for_backend(attempts: int = 5, probe_timeout: float = 120.0,
             err = (r.stderr or r.stdout).strip()
             tail = err.splitlines()
             last = tail[-1] if tail else f"probe exited rc={r.returncode}"
-            if any(s in err for s in
+            # classify on the FINAL exception line only: a transient
+            # tunnel outage may chain through inner AttributeError
+            # frames before the UNAVAILABLE line, and misclassifying a
+            # transient reintroduces the round-1 rc=1 crash
+            if any(last.startswith(s) for s in
                    ("ImportError", "ModuleNotFoundError", "SyntaxError",
                     "AttributeError", "NameError")):
                 # Clearly-deterministic failure (a code bug in the

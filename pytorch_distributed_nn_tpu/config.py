@@ -53,6 +53,11 @@ class DataConfig:
     # array_file sampling: 'shuffle' (per-epoch permutation, torch
     # DistributedSampler semantics) or 'replacement' (i.i.d.)
     sample: str = "shuffle"
+    # token_file/array_file: fraction of the file reserved for held-out
+    # eval (0 = none; file-dataset eval is then IN-SAMPLE — it reports
+    # training-set performance). Synthetic streams are infinite and
+    # always genuinely held out.
+    holdout_frac: float = 0.0
     batch_size: int = 128  # global batch size
     seq_len: int = 512
     vocab_size: int = 32000
@@ -259,6 +264,12 @@ def _llama3_8b_zero() -> TrainConfig:
                         vocab_size=128256),
         model=ModelConfig(name="llama3_8b", remat=True),
         parallel=ParallelConfig(strategy="zero", zero_stage=3),
+        # at V=128k the dense (B, T, V) f32 logits + their cotangent are
+        # the per-chip HBM limiter (~4 GiB at B=16/T=4096 over 16 chips
+        # — scripts/validate_8b_layout.py); chunking keeps one
+        # (B, 2048, V) block live. Falls back to dense when T <= chunk
+        # (the scaled single-chip bench).
+        xent_chunk=2048,
     )
 
 

@@ -19,6 +19,14 @@ import dataclasses
 
 import numpy as np
 
+# Evaluation batches are requested at step >= this offset (Trainer's
+# held-out stream convention). Synthetic streams are infinite, so the
+# offset range alone is genuinely unseen data; FILE datasets are finite
+# and need holdout_frac > 0 to actually reserve rows/tokens — with
+# holdout_frac == 0 their "eval" draws from the training examples
+# (in-sample) and scripts/eval.py reports train-set performance.
+EVAL_STEP_OFFSET = 1 << 30
+
 
 @dataclasses.dataclass(frozen=True)
 class BatchSpec:
@@ -136,18 +144,25 @@ class TokenFileDataset(SyntheticDataset):
     ``seq_len + 1`` tokens at (seed, step)-deterministic random offsets
     (the standard random-window LM pretraining sampler), so the
     determinism contract (same global batch on any topology) holds
-    exactly as for the synthetic streams. Held-out evaluation draws from
-    the same window distribution (windows, not documents, are the unit —
-    overlap with training windows is possible, as in any random-window
-    sampler)."""
+    exactly as for the synthetic streams.
+
+    ``holdout_frac > 0`` reserves the file's TAIL fraction for held-out
+    evaluation: training windows draw from the head region only, eval
+    requests (step >= EVAL_STEP_OFFSET) from the tail only, so eval
+    tokens are never trained on. With ``holdout_frac == 0`` eval draws
+    from the same (training) token range — in-sample."""
 
     def __init__(self, path: str, seed: int, batch_size: int, *,
                  seq_len: int, vocab_size: int,
-                 token_dtype: str = "uint16") -> None:
+                 token_dtype: str = "uint16",
+                 holdout_frac: float = 0.0) -> None:
         super().__init__(seed, batch_size)
         self.seq_len = seq_len
         self.spec = BatchSpec((seq_len,), np.dtype(np.int32), (seq_len,),
                               np.dtype(np.int32), vocab_size)
+        if not 0.0 <= holdout_frac < 1.0:
+            raise ValueError(f"holdout_frac must be in [0, 1), got "
+                             f"{holdout_frac}")
         if str(path).endswith(".npy"):
             self.tokens = np.load(path, mmap_mode="r")
         else:
@@ -162,14 +177,33 @@ class TokenFileDataset(SyntheticDataset):
                 f"token file has {len(self.tokens)} tokens; need at "
                 f"least seq_len + 1 = {seq_len + 1}"
             )
+        n = len(self.tokens)
+        self._eval_start = n - int(n * holdout_frac) if holdout_frac else n
+        if holdout_frac:
+            # both regions must hold at least one full window
+            if self._eval_start < seq_len + 1:
+                raise ValueError(
+                    f"holdout_frac {holdout_frac} leaves no full "
+                    f"training window (train region {self._eval_start} "
+                    f"tokens < seq_len + 1)"
+                )
+            if n - self._eval_start < seq_len + 1:
+                raise ValueError(
+                    f"holdout_frac {holdout_frac} reserves only "
+                    f"{n - self._eval_start} tokens — not one full "
+                    f"eval window (need seq_len + 1 = {seq_len + 1})"
+                )
 
     def batch(self, step: int) -> tuple[np.ndarray, np.ndarray]:
         rng = self._rng(step)
         # windows span seq_len + 1 tokens; the largest valid start is
-        # len - (seq_len + 1), so the exclusive high is len - seq_len
-        starts = rng.integers(
-            0, len(self.tokens) - self.seq_len, size=self.batch_size
-        )
+        # region_end - (seq_len + 1), so the exclusive high is
+        # region_end - seq_len
+        if step >= EVAL_STEP_OFFSET and self._eval_start < len(self.tokens):
+            lo, hi = self._eval_start, len(self.tokens) - self.seq_len
+        else:
+            lo, hi = 0, self._eval_start - self.seq_len
+        starts = rng.integers(lo, hi, size=self.batch_size)
         rows = np.stack([
             np.asarray(self.tokens[s:s + self.seq_len + 1])
             for s in starts
@@ -193,13 +227,22 @@ class ArrayFileDataset(SyntheticDataset):
     every example exactly once per epoch, torch ``DistributedSampler``
     semantics (its ``set_epoch`` reshuffle included); ``'replacement'``
     draws i.i.d. Both are (seed, step)-deterministic, preserving the
-    any-topology determinism contract."""
+    any-topology determinism contract.
+
+    ``holdout_frac > 0`` reserves a (seed-deterministic, uniformly drawn)
+    row subset for held-out evaluation: training never visits those rows,
+    eval requests (step >= EVAL_STEP_OFFSET) visit only them. With
+    ``holdout_frac == 0`` eval draws from the training rows — in-sample."""
 
     def __init__(self, path: str, seed: int, batch_size: int, *,
-                 sample: str = "shuffle") -> None:
+                 sample: str = "shuffle",
+                 holdout_frac: float = 0.0) -> None:
         super().__init__(seed, batch_size)
         if sample not in ("shuffle", "replacement"):
             raise ValueError(f"unknown sample mode {sample!r}")
+        if not 0.0 <= holdout_frac < 1.0:
+            raise ValueError(f"holdout_frac must be in [0, 1), got "
+                             f"{holdout_frac}")
         self.sample = sample
         data = np.load(path)
         try:
@@ -217,32 +260,55 @@ class ArrayFileDataset(SyntheticDataset):
                               np.dtype(np.float32), (),
                               np.dtype(np.int32),
                               int(self.y.max()) + 1)
+        n = len(self.x)
+        n_eval = int(n * holdout_frac)
+        if holdout_frac and (n_eval == 0 or n_eval == n):
+            raise ValueError(
+                f"holdout_frac {holdout_frac} of {n} rows leaves an "
+                "empty train or eval split"
+            )
+        # the split is keyed on seed only (not step), so it is the same
+        # partition for every batch of the run
+        split = np.random.default_rng(
+            np.random.SeedSequence([self.seed, 0x401D])
+        ).permutation(n)
+        self._eval_rows = np.sort(split[:n_eval])
+        self._train_rows = np.sort(split[n_eval:])
+        self._perm_cache: dict[str, tuple[int, np.ndarray]] = {}
 
-    def _perm(self, epoch: int) -> np.ndarray:
+    def _perm(self, which: str, rows: np.ndarray,
+              epoch: int) -> np.ndarray:
         # pure in (seed, epoch) — cached so each step costs O(batch),
         # not an O(N) reshuffle (N can be millions of rows)
-        cached = getattr(self, "_perm_cache", None)
+        cached = self._perm_cache.get(which)
         if cached is not None and cached[0] == epoch:
             return cached[1]
         rng = np.random.default_rng(
             np.random.SeedSequence([self.seed, epoch, 0x5EAF])
         )
-        perm = rng.permutation(len(self.x))
-        self._perm_cache = (epoch, perm)
+        perm = rows[rng.permutation(len(rows))]
+        self._perm_cache[which] = (epoch, perm)
         return perm
 
     def batch(self, step: int) -> tuple[np.ndarray, np.ndarray]:
+        if step >= EVAL_STEP_OFFSET and len(self._eval_rows):
+            which, rows = "eval", self._eval_rows
+            step = step - EVAL_STEP_OFFSET
+        else:
+            which, rows = "train", self._train_rows
         if self.sample == "replacement":
             rng = self._rng(step)
-            idx = rng.integers(0, len(self.x), size=self.batch_size)
+            idx = rows[rng.integers(0, len(rows), size=self.batch_size)]
         else:
-            n = len(self.x)
+            n = len(rows)
             pos = step * self.batch_size
             parts, remaining = [], self.batch_size
             while remaining:  # may straddle epoch boundaries
                 epoch, within = divmod(pos, n)
                 take = min(remaining, n - within)
-                parts.append(self._perm(epoch)[within:within + take])
+                parts.append(
+                    self._perm(which, rows, epoch)[within:within + take]
+                )
                 pos += take
                 remaining -= take
             idx = np.concatenate(parts)
@@ -252,15 +318,17 @@ class ArrayFileDataset(SyntheticDataset):
 def get_dataset(name: str, *, seed: int, batch_size: int,
                 seq_len: int = 512, vocab_size: int = 32000,
                 path: str = "", token_dtype: str = "uint16",
-                sample: str = "shuffle"):
+                sample: str = "shuffle", holdout_frac: float = 0.0):
     if name in ("token_file", "array_file") and not path:
         raise ValueError(f"dataset {name!r} needs data.path")
     if name == "token_file":
         return TokenFileDataset(path, seed, batch_size, seq_len=seq_len,
                                 vocab_size=vocab_size,
-                                token_dtype=token_dtype)
+                                token_dtype=token_dtype,
+                                holdout_frac=holdout_frac)
     if name == "array_file":
-        return ArrayFileDataset(path, seed, batch_size, sample=sample)
+        return ArrayFileDataset(path, seed, batch_size, sample=sample,
+                                holdout_frac=holdout_frac)
     if name == "mnist":
         return ClassTemplateImages(seed, batch_size, shape=(28, 28),
                                    num_classes=10)

@@ -23,7 +23,7 @@ class EncoderBlock(nn.Module):
     num_heads: int
     mlp_dim: int
     dropout: float = 0.0
-    ln_eps: float = 1e-6
+    ln_eps: float = 1e-12
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
 
@@ -61,9 +61,9 @@ class Bert(nn.Module):
     max_len: int = 512
     type_vocab: int = 2
     dropout: float = 0.0
-    # HF BERT checkpoints use layer_norm_eps=1e-12; converted weights
-    # must set extra["ln_eps"]=1e-12 to reproduce the original
-    ln_eps: float = 1e-6
+    # HF-conventional (BertConfig.layer_norm_eps): converted checkpoints
+    # reproduce the original's logits without remembering an override
+    ln_eps: float = 1e-12
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
 
@@ -116,7 +116,7 @@ def build_bert_base(cfg: ModelConfig) -> Bert:
         mlp_dim=e.get("mlp_dim", 3072),
         max_len=e.get("max_len", 512),
         dropout=e.get("dropout", 0.0),
-        ln_eps=e.get("ln_eps", 1e-6),
+        ln_eps=e.get("ln_eps", 1e-12),
         dtype=policy.compute_dtype,
         param_dtype=policy.param_dtype,
     )

@@ -24,7 +24,7 @@ class DecoderBlock(nn.Module):
     num_heads: int
     mlp_dim: int
     dropout: float = 0.0
-    ln_eps: float = 1e-6
+    ln_eps: float = 1e-5
     attn_impl: str = "auto"
     # FFN override hook: (block, y, train) -> y, creating its submodules in
     # the block's scope. None = the standard dense MLP. This is how the MoE
@@ -70,10 +70,9 @@ class TransformerLM(nn.Module):
     mlp_dim: int = 3072
     max_len: int = 2048
     dropout: float = 0.0
-    # HF GPT-2 checkpoints use layer_norm_epsilon=1e-5; flax's default is
-    # 1e-6 — converted checkpoints must set extra["ln_eps"]=1e-5 to
-    # reproduce the original's numbers (utils/torch_interop.py)
-    ln_eps: float = 1e-6
+    # HF-conventional (GPT2Config.layer_norm_epsilon): converted
+    # checkpoints reproduce the original's logits without an override
+    ln_eps: float = 1e-5
     remat: bool = False
     attn_impl: str = "auto"
     dtype: jnp.dtype = jnp.float32
@@ -164,7 +163,7 @@ def build_transformer_lm(cfg: ModelConfig) -> TransformerLM:
         mlp_dim=e.get("mlp_dim", 3072),
         max_len=e.get("max_len", 2048),
         dropout=e.get("dropout", 0.0),
-        ln_eps=e.get("ln_eps", 1e-6),
+        ln_eps=e.get("ln_eps", 1e-5),
         remat=cfg.remat,
         attn_impl=e.get("attn_impl", "auto"),
         dtype=policy.compute_dtype,

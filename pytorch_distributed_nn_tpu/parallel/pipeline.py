@@ -191,9 +191,15 @@ def restore_unstacked_params(cfg, checkpoint_dir: str):
         if mgr.latest_step() is None:
             return None
         model = get_model(cfg.model)
+        # full data args: a pipeline run trained on token_file/array_file
+        # must be restorable too (the init batch only provides shapes,
+        # but file datasets refuse to construct without their path)
         ds = get_dataset(cfg.data.dataset, seed=cfg.seed, batch_size=1,
                          seq_len=cfg.data.seq_len,
-                         vocab_size=cfg.data.vocab_size)
+                         vocab_size=cfg.data.vocab_size,
+                         path=cfg.data.path,
+                         token_dtype=cfg.data.token_dtype,
+                         sample=cfg.data.sample)
         x0, _ = ds.batch(0)
         flat = model.init(jax.random.key(cfg.seed), jnp.asarray(x0),
                           train=False)["params"]

@@ -55,6 +55,13 @@ EP_RULES: list[tuple[re.Pattern, int]] = [
     (re.compile(r"moe/(wi|wo)$"), 0),
 ]
 
+# Tables whose fsdp shard must ride the vocab dim (tupled with tensor
+# when TP is on), never the d_model dim — see the comment in spec_for.
+_VOCAB_TABLES: list[tuple[re.Pattern, int]] = [
+    (re.compile(r"(tok_embed|pos_embed|type_embed)/embedding$"), 0),
+    (re.compile(r"(lm_head|mlm_decoder|head)/kernel$"), 1),
+]
+
 
 def spec_for(path: str, shape: tuple[int, ...], *, tensor: int = 1,
              fsdp: int = 1, expert: int = 1,
@@ -76,13 +83,29 @@ def spec_for(path: str, shape: tuple[int, ...], *, tensor: int = 1,
                 axes[dim] = AXIS_TENSOR
                 break
     if fsdp > 1 and int(np.prod(shape or (1,))) >= min_elems:
-        candidates = [
-            (size, i) for i, size in enumerate(shape)
-            if axes[i] is None and size % fsdp == 0
-        ]
-        if candidates:
-            _, best = max(candidates)
-            axes[best] = AXIS_FSDP
+        # Embedding/head tables: co-shard fsdp WITH tensor on the vocab
+        # dim instead of sharding d_model. Sharding their d dim forces
+        # the SPMD partitioner to reshard activation cotangents from
+        # batch-sharding to feature-sharding inside the backward, a
+        # transition it can only do by full rematerialization
+        # (spmd_partitioner.cc "Involuntary full rematerialization" —
+        # VERDICT.md round-1 Weak #2).
+        for pattern, dim in _VOCAB_TABLES:
+            if (pattern.search(path) and dim < ndim
+                    and axes[dim] in (AXIS_TENSOR, None)
+                    and shape[dim] % ((tensor if axes[dim] else 1)
+                                      * fsdp) == 0):
+                axes[dim] = ((AXIS_TENSOR, AXIS_FSDP)
+                             if axes[dim] else AXIS_FSDP)
+                break
+        else:
+            candidates = [
+                (size, i) for i, size in enumerate(shape)
+                if axes[i] is None and size % fsdp == 0
+            ]
+            if candidates:
+                _, best = max(candidates)
+                axes[best] = AXIS_FSDP
     if all(a is None for a in axes):
         return P()
     return P(*axes)

@@ -99,22 +99,11 @@ def _split_microbatches(x, accum: int, n_shards: int, micro_sh):
     return jax.lax.with_sharding_constraint(m, micro_sh)
 
 
-def make_zero_train_step(mesh: Mesh, loss_fn: Callable, *, stage: int = 3,
-                         accum: int = 1):
-    """Returns (step, place_state). The step body is identical to DP —
-    sharded DP is purely a layout change (SURVEY.md §3.4 'expressed
-    declaratively as shardings').
-
-    ``accum > 1`` runs gradient accumulation: the global batch is split
-    into ``accum`` microbatches scanned sequentially (``lax.scan``),
-    per-microbatch grads summed in f32, one optimizer step on the mean.
-    Peak activation memory drops ~accum×. For deterministic stateless
-    models the gradient is the same global-batch mean the accum=1 step
-    computes; dropout models re-draw masks per microbatch and BatchNorm
-    stats update sequentially per microbatch (the same semantics as a
-    torch accumulation loop), which differs slightly from one full-batch
-    step.
-    """
+def _build_step(mesh: Mesh, loss_fn: Callable, *, stage: int,
+                accum: int):
+    """The zero/DP step function plus its batch shardings — shared by
+    the runtime path (:func:`make_zero_train_step`) and the AOT layout
+    validation path (:func:`lower_zero_train_step`)."""
     if stage not in (0, 1, 3):
         raise ValueError(f"zero_stage must be 0, 1 or 3, got {stage}")
     if accum < 1:
@@ -176,20 +165,41 @@ def make_zero_train_step(mesh: Mesh, loss_fn: Callable, *, stage: int = 3,
         )
         return new_state, {"loss": lsum / accum}
 
-    if accum > 1:
-        step = step_accum
+    return (step_accum if accum > 1 else step), batch_sh
 
+
+def _jit_step(step, shardings, batch_sh, mesh):
+    return jax.jit(
+        step,
+        in_shardings=(shardings, batch_sh, batch_sh),
+        out_shardings=(shardings, NamedSharding(mesh, P())),
+        donate_argnums=(0,),
+    )
+
+
+def make_zero_train_step(mesh: Mesh, loss_fn: Callable, *, stage: int = 3,
+                         accum: int = 1):
+    """Returns (step, place_state). The step body is identical to DP —
+    sharded DP is purely a layout change (SURVEY.md §3.4 'expressed
+    declaratively as shardings').
+
+    ``accum > 1`` runs gradient accumulation: the global batch is split
+    into ``accum`` microbatches scanned sequentially (``lax.scan``),
+    per-microbatch grads summed in f32, one optimizer step on the mean.
+    Peak activation memory drops ~accum×. For deterministic stateless
+    models the gradient is the same global-batch mean the accum=1 step
+    computes; dropout models re-draw masks per microbatch and BatchNorm
+    stats update sequentially per microbatch (the same semantics as a
+    torch accumulation loop), which differs slightly from one full-batch
+    step.
+    """
+    step, batch_sh = _build_step(mesh, loss_fn, stage=stage, accum=accum)
     compiled: dict = {}
 
     def place_state(state: TrainState) -> TrainState:
         shardings = state_shardings(state, mesh, stage=stage)
         placed = global_device_put(state, shardings)
-        compiled["step"] = jax.jit(
-            step,
-            in_shardings=(shardings, batch_sh, batch_sh),
-            out_shardings=(shardings, NamedSharding(mesh, P())),
-            donate_argnums=(0,),
-        )
+        compiled["step"] = _jit_step(step, shardings, batch_sh, mesh)
         return placed
 
     def step_dispatch(state, x, y):
@@ -198,3 +208,27 @@ def make_zero_train_step(mesh: Mesh, loss_fn: Callable, *, stage: int = 3,
         return compiled["step"](state, x, y)
 
     return step_dispatch, place_state
+
+
+def lower_zero_train_step(mesh: Mesh, loss_fn: Callable,
+                          abstract_state: TrainState,
+                          x_spec, y_spec, *, stage: int = 3,
+                          accum: int = 1):
+    """AOT-lower the zero train step for ABSTRACT inputs — nothing is
+    materialized on any device, so arbitrarily large layouts (the true
+    8B config 5) lower on a virtual topology. Returns the jax Lowered;
+    callers ``.compile()`` it for the SPMD partitioner's verdict and
+    per-chip memory analysis (scripts/validate_8b_layout.py)."""
+    step, batch_sh = _build_step(mesh, loss_fn, stage=stage, accum=accum)
+    shardings = state_shardings(abstract_state, mesh, stage=stage)
+    state_arg = jax.tree.map(
+        lambda s, sh: jax.ShapeDtypeStruct(s.shape, s.dtype, sharding=sh),
+        abstract_state, shardings,
+    )
+    def arg(spec):
+        return jax.ShapeDtypeStruct(spec.shape, spec.dtype,
+                                    sharding=batch_sh)
+
+    return _jit_step(step, shardings, batch_sh, mesh).lower(
+        state_arg, arg(x_spec), arg(y_spec)
+    )

@@ -47,8 +47,12 @@ class EvalRecord:
 # training — same class templates / token process, i.e. the same task —
 # but from a step range training can never reach, so the samples are
 # held out. (A different *seed* would change the templates themselves:
-# a different task, on which no trained model can score.)
-_EVAL_STEP_OFFSET = 1 << 30
+# a different task, on which no trained model can score.) File-backed
+# datasets additionally honor data.holdout_frac for a true row/token
+# split — see data/datasets.py.
+from pytorch_distributed_nn_tpu.data.datasets import (
+    EVAL_STEP_OFFSET as _EVAL_STEP_OFFSET,
+)
 
 
 class Trainer:
@@ -77,6 +81,7 @@ class Trainer:
             path=cfg.data.path,
             token_dtype=cfg.data.token_dtype,
             sample=cfg.data.sample,
+            holdout_frac=cfg.data.holdout_frac,
         )
         self.loader = DataLoader(self.dataset, self.mesh,
                                  prefetch=cfg.data.prefetch)

@@ -80,6 +80,35 @@ def fwd_flops(model, x_shape: tuple, x_dtype) -> float:
     return float(analysis["flops"])
 
 
+# Input shapes of the synthetic/token datasets, derivable from config
+# alone — counting FLOPs must not re-read a multi-GB data file just for
+# .spec (tests cross-check these against the real dataset specs).
+_IMAGE_SPECS = {
+    "mnist": (28, 28),
+    "cifar10": (32, 32, 3),
+    "imagenet_synthetic": (224, 224, 3),
+}
+_TOKEN_DATASETS = ("lm_synthetic", "mlm_synthetic", "token_file")
+
+
+def _input_spec(cfg):
+    import numpy as np
+
+    if cfg.data.dataset in _IMAGE_SPECS:
+        return _IMAGE_SPECS[cfg.data.dataset], np.float32
+    if cfg.data.dataset in _TOKEN_DATASETS:
+        return (cfg.data.seq_len,), np.int32
+    # array_file and friends: the shape lives in the file
+    from pytorch_distributed_nn_tpu.data import get_dataset
+
+    spec = get_dataset(
+        cfg.data.dataset, seed=0, batch_size=1,
+        seq_len=cfg.data.seq_len, vocab_size=cfg.data.vocab_size,
+        path=cfg.data.path, token_dtype=cfg.data.token_dtype,
+    ).spec
+    return spec.x_shape, spec.x_dtype
+
+
 def train_flops_per_sample(cfg) -> float:
     """Analytic training FLOPs for ONE sample of ``cfg``'s model on
     ``cfg``'s data shapes: 3 x forward (see module docstring).
@@ -87,7 +116,6 @@ def train_flops_per_sample(cfg) -> float:
     For LMs a "sample" is one full sequence of ``cfg.data.seq_len``
     tokens, matching how the bench counts samples/sec.
     """
-    from pytorch_distributed_nn_tpu.data import get_dataset
     from pytorch_distributed_nn_tpu.models import get_model
 
     import dataclasses
@@ -102,12 +130,8 @@ def train_flops_per_sample(cfg) -> float:
         extra={**cfg.model.extra, "attn_impl": "xla"},
     )
     model = get_model(model_cfg)
-    spec = get_dataset(
-        cfg.data.dataset, seed=0, batch_size=1,
-        seq_len=cfg.data.seq_len, vocab_size=cfg.data.vocab_size,
-        path=cfg.data.path, token_dtype=cfg.data.token_dtype,
-    ).spec
-    return 3.0 * fwd_flops(model, (1, *spec.x_shape), spec.x_dtype)
+    x_shape, x_dtype = _input_spec(cfg)
+    return 3.0 * fwd_flops(model, (1, *x_shape), x_dtype)
 
 
 def lm_train_flops_per_token(n_params: int, n_layers: int,

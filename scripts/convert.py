@@ -1,10 +1,11 @@
 #!/usr/bin/env python
 """Checkpoint conversion: torch/HF weights ↔ framework checkpoints.
 
-Import (torch → here): load a ``torch.save``'d state_dict (or any
-pickle/safetensors file torch.load understands), map it onto the
-preset's model via utils/torch_interop, and write a framework checkpoint
-that ``scripts/train.py --resume`` / ``scripts/generate.py
+Import (torch → here): load a ``torch.save``'d state_dict (torch pickle
+zip via ``torch.load(weights_only=True)``) or an HF ``.safetensors``
+file (via ``safetensors.torch``), map it onto the preset's model via
+utils/torch_interop, and write a framework checkpoint that
+``scripts/train.py --resume`` / ``scripts/generate.py
 --checkpoint-dir`` consume directly:
 
     python scripts/convert.py --arch llama3 --preset llama3_8b_zero \
@@ -34,6 +35,16 @@ from pytorch_distributed_nn_tpu.runtime.platform import (
 )
 
 apply_platform_overrides()
+
+
+def _load_state_dict(path: str):
+    if str(path).endswith(".safetensors"):
+        from safetensors.torch import load_file
+
+        return load_file(path)
+    import torch
+
+    return torch.load(path, map_location="cpu", weights_only=True)
 
 
 def _converted_params(arch: str, state_dict, model_cfg):
@@ -96,11 +107,15 @@ def main(argv=None) -> int:
     cfg = get_config(args.preset, **parse_overrides(rest))
     cfg.steps = 0
     cfg.checkpoint_dir = ""  # Trainer must not auto-resume anything
+    # Norm epsilons need no special handling: the model builders default
+    # to the HF-conventional values (bert 1e-12, gpt2 1e-5, llama3
+    # 1e-5), so every consumer of the converted checkpoint — convert,
+    # eval, generate, resume — reconstructs the same model. Checkpoints
+    # trained with nonstandard eps still need --model.extra everywhere.
     trainer = Trainer(cfg)
 
     if args.out:
-        state_dict = torch.load(args.torch_checkpoint,
-                                map_location="cpu", weights_only=True)
+        state_dict = _load_state_dict(args.torch_checkpoint)
         converted = _converted_params(args.arch, state_dict, cfg.model)
         if cfg.parallel.strategy == "pipeline":
             # pipeline checkpoints hold STACKED stage params — restack

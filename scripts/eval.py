@@ -8,6 +8,12 @@ Usage:
 Restores the latest checkpoint into the preset's model and runs the
 held-out evaluation stream (same-task batches from a step range training
 cannot reach — train/trainer.py). Prints one JSON line.
+
+NOTE: for token_file/array_file datasets the eval stream is IN-SAMPLE
+(drawn from the training rows/tokens) unless the run set
+``--data.holdout_frac`` > 0 to reserve a true held-out split — use the
+same value here that training used, or the "held-out" rows were trained
+on. Synthetic streams are infinite and always genuinely held out.
 """
 
 from __future__ import annotations

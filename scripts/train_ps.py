@@ -47,7 +47,11 @@ def main(argv: list[str]) -> int:
     dataset = get_dataset(cfg.data.dataset, seed=cfg.seed,
                           batch_size=cfg.data.batch_size,
                           seq_len=cfg.data.seq_len,
-                          vocab_size=cfg.data.vocab_size)
+                          vocab_size=cfg.data.vocab_size,
+                          path=cfg.data.path,
+                          token_dtype=cfg.data.token_dtype,
+                          sample=cfg.data.sample,
+                          holdout_frac=cfg.data.holdout_frac)
     model = get_model(cfg.model)
     loss_fn = get_loss_fn(cfg.data.dataset)
     x0, _ = dataset.batch(0)

@@ -1,0 +1,51 @@
+"""scripts/validate_8b_layout.py — AOT validation of the true config-5
+layout (VERDICT.md round-1 Missing #5): the full 8B step must lower +
+compile through the SPMD partitioner on a virtual 16-chip mesh, the
+sharding math must agree with the compiler's buffer assignment, and the
+analytic per-chip memory must fit v5e HBM.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(*args, timeout):
+    return subprocess.run(
+        [sys.executable, "scripts/validate_8b_layout.py", *args],
+        cwd=_REPO, capture_output=True, text=True, timeout=timeout,
+    )
+
+
+def test_true_8b_layout_fits_v5e16_analytic():
+    # the real 8.03B-param preset, abstract state only — fast
+    r = _run("--analytic-only", timeout=300)
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["n_params_b"] > 8.0
+    assert rec["fits"] is True
+    # the known budget composition: state is the largest single slice
+    # and chunked xent keeps the logits block under ~2 GiB
+    assert 5.0 < rec["state_exact_gib"] < 7.0
+    assert rec["activations_gib"]["logits_block"] < 2.5
+
+
+def test_layout_compile_cross_checks_sharding_math():
+    # scaled dims so the CPU compile stays quick; same code path,
+    # including the compiled SPMD proof and the drift cross-check
+    r = _run(
+        "--devices", "8",
+        "--model.extra",
+        '{"num_layers":2,"d_model":256,"num_heads":8,"num_kv_heads":4,'
+        '"mlp_dim":512,"vocab_size":1024}',
+        "--data.batch_size", "8", "--data.seq_len", "128",
+        "--data.vocab_size", "1024",
+        timeout=420,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["compiled"]["spmd_partitioning"] == "ok"
+    assert rec["compiled"]["state_bytes_drift"] < 0.02

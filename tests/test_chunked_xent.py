@@ -109,3 +109,16 @@ def test_trainer_eval_uses_chunked_path():
 def test_rejected_when_seq_not_divisible():
     with pytest.raises(ValueError, match="divisible"):
         Trainer(_tiny_lm_cfg(xent_chunk=5))  # 32 % 5 != 0
+
+
+def test_label_smoothing_ok_when_sequence_fits_one_chunk():
+    # the 8B preset now ships xent_chunk=2048; a scaled run with T=32
+    # engages the dense fallback, which DOES support label smoothing
+    trainer = Trainer(_tiny_lm_cfg(label_smoothing=0.1))
+    recs = trainer.train(1)
+    assert np.isfinite(recs[-1].loss)
+
+
+def test_label_smoothing_rejected_with_engaged_chunking():
+    with pytest.raises(ValueError, match="label_smoothing"):
+        Trainer(_tiny_lm_cfg(xent_chunk=16, label_smoothing=0.1))

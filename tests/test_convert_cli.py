@@ -104,3 +104,30 @@ def test_convert_gpt2_into_pipeline_preset(tmp_path):
                 "--checkpoint_dir", str(ckpt), *PIPE_OV)
     assert r.returncode == 0, r.stderr
     assert "final: step=1" in r.stdout, r.stdout
+
+
+def test_convert_safetensors_and_eps_default(tmp_path):
+    """HF .safetensors inputs load via safetensors.torch. (Norm eps
+    needs no override: the model builders default to the HF-conventional
+    values, so all consumers of the checkpoint agree — the generate-
+    parity test above proves the llama eps end to end.)"""
+    transformers = pytest.importorskip("transformers")
+    st_mod = pytest.importorskip("safetensors.torch")
+    cfg = transformers.LlamaConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+        max_position_embeddings=128, rms_norm_eps=1e-5,
+        rope_theta=500000.0, tie_word_embeddings=False,
+        attention_bias=False)
+    torch.manual_seed(0)
+    hf = transformers.LlamaForCausalLM(cfg).eval()
+    st = tmp_path / "llama.safetensors"
+    st_mod.save_file(
+        {k: v.contiguous() for k, v in hf.state_dict().items()}, str(st)
+    )
+    ckpt = tmp_path / "ckpt"
+    r = run_cli("scripts/convert.py", "--arch", "llama3", "--preset",
+                "llama3_8b_zero", "--torch-checkpoint", str(st),
+                "--out", str(ckpt), *OVERRIDES)
+    assert r.returncode == 0, r.stderr
+    assert (ckpt / "0").exists()

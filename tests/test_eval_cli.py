@@ -43,3 +43,33 @@ def test_eval_cli_pipeline_checkpoint(tmp_path):
     # (measured) — well below proves the stacked checkpoint's weights
     # actually loaded, not a fresh init
     assert rec["eval_loss"] < 4.0, rec
+
+
+def test_eval_cli_pipeline_token_file_checkpoint(tmp_path):
+    """Regression: restore_unstacked_params must construct file-backed
+    datasets with their path — a pipeline run trained on token_file used
+    to crash eval.py with ValueError('dataset needs data.path')."""
+    import numpy as np
+
+    v, n = 101, 5000
+    toks = np.empty(n, dtype=np.uint16)
+    toks[0] = 1
+    for i in range(1, n):
+        toks[i] = (31 * int(toks[i - 1]) + 17) % v
+    corpus = tmp_path / "corpus.bin"
+    toks.tofile(corpus)
+
+    data_args = ["--data.dataset", "token_file",
+                 "--data.path", str(corpus)]
+    ckpt = tmp_path / "ckpt"
+    r = run_cli("scripts/train.py", "--preset", "transformer_lm_pp",
+                "--steps", "2", "--log_every", "0",
+                "--checkpoint_dir", str(ckpt), "--checkpoint_every", "2",
+                *PIPE_ARGS, *data_args)
+    assert r.returncode == 0, r.stderr
+    r = run_cli("scripts/eval.py", "--preset", "transformer_lm_pp",
+                "--checkpoint-dir", str(ckpt), "--batches", "1",
+                *PIPE_ARGS, *data_args)
+    assert r.returncode == 0, r.stderr
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert np.isfinite(rec["eval_loss"])

@@ -124,7 +124,10 @@ def test_array_file_epoch_shuffle_covers_every_example(tmp_path):
     for s in range(3):
         np.testing.assert_array_equal(ds.batch(s)[1], ds2.batch(s)[1])
     # each epoch reshuffles (torch set_epoch semantics)
-    assert not np.array_equal(ds._perm(0), ds._perm(1))
+    assert not np.array_equal(
+        ds._perm("train", ds._train_rows, 0),
+        ds._perm("train", ds._train_rows, 1),
+    )
 
 
 def test_token_file_minimum_corpus(tmp_path):
@@ -142,3 +145,95 @@ def test_token_file_minimum_corpus(tmp_path):
 def test_path_required():
     with pytest.raises(ValueError, match="data.path"):
         get_dataset("token_file", seed=0, batch_size=4)
+
+
+def test_token_file_holdout_split(token_bin):
+    path, v = token_bin
+    from pytorch_distributed_nn_tpu.data.datasets import EVAL_STEP_OFFSET
+
+    ds = TokenFileDataset(path, 0, 8, seq_len=32, vocab_size=v,
+                          holdout_frac=0.1)
+    n = 20000
+    boundary = n - int(n * 0.1)
+    # training windows never touch the reserved tail; eval windows
+    # never leave it — so eval tokens are genuinely unseen
+    for step in range(20):
+        rng = ds._rng(step)
+        starts = rng.integers(0, boundary - 32, size=8)
+        assert (starts + 33 <= boundary).all()
+    xe, ye = ds.batch(EVAL_STEP_OFFSET)
+    tail = np.asarray(ds.tokens[boundary:]).astype(np.int64)
+    # every eval window must be a subsequence of the tail region
+    first_cols = xe[:, 0]
+    for row, t0 in zip(xe, first_cols):
+        hits = np.where(tail[:-32] == t0)[0]
+        assert any(
+            np.array_equal(tail[h:h + 32], row) for h in hits
+        )
+
+
+def test_token_file_holdout_rejects_degenerate_split(token_bin):
+    path, v = token_bin
+    with pytest.raises(ValueError, match="holdout_frac"):
+        TokenFileDataset(path, 0, 8, seq_len=32, vocab_size=v,
+                         holdout_frac=0.00001)  # tail < one window
+    with pytest.raises(ValueError, match="holdout_frac"):
+        TokenFileDataset(path, 0, 8, seq_len=32, vocab_size=v,
+                         holdout_frac=1.5)
+
+
+def test_array_file_holdout_rows_disjoint(tmp_path):
+    from pytorch_distributed_nn_tpu.data.datasets import EVAL_STEP_OFFSET
+
+    n = 200
+    x = np.arange(n, dtype=np.float32)[:, None]  # row i holds value i
+    y = (np.arange(n) % 7).astype(np.int64)
+    path = tmp_path / "d.npz"
+    np.savez(path, x=x, y=y)
+    ds = ArrayFileDataset(str(path), 3, 16, holdout_frac=0.2)
+    train_seen = set()
+    for step in range(20):  # > one epoch over the 160 train rows
+        xb, _ = ds.batch(step)
+        train_seen.update(int(v) for v in xb[:, 0])
+    eval_seen = set()
+    for step in range(10):
+        xb, _ = ds.batch(EVAL_STEP_OFFSET + step)
+        eval_seen.update(int(v) for v in xb[:, 0])
+    assert train_seen.isdisjoint(eval_seen)
+    assert len(train_seen) == 160  # full epoch coverage still holds
+    assert len(eval_seen) == 40
+    # same split on a fresh instance (seed-keyed, not step-keyed)
+    ds2 = ArrayFileDataset(str(path), 3, 16, holdout_frac=0.2)
+    xb2, _ = ds2.batch(EVAL_STEP_OFFSET)
+    xb1, _ = ds.batch(EVAL_STEP_OFFSET)
+    np.testing.assert_array_equal(xb1, xb2)
+
+
+def test_array_file_holdout_zero_matches_old_behavior(tmp_path):
+    # holdout_frac=0 must reproduce the historical stream bit-for-bit
+    # (resume-compatibility for existing runs)
+    n = 64
+    x = np.arange(n, dtype=np.float32)[:, None]
+    y = (np.arange(n) % 5).astype(np.int64)
+    path = tmp_path / "d.npz"
+    np.savez(path, x=x, y=y)
+    ds = ArrayFileDataset(str(path), 0, 8)
+    # reference implementation of the pre-holdout sampler
+    def old_batch(step):
+        pos = step * 8
+        parts, remaining = [], 8
+        while remaining:
+            epoch, within = divmod(pos, n)
+            rng = np.random.default_rng(
+                np.random.SeedSequence([0, epoch, 0x5EAF])
+            )
+            perm = rng.permutation(n)
+            take = min(remaining, n - within)
+            parts.append(perm[within:within + take])
+            pos += take
+            remaining -= take
+        return np.concatenate(parts)
+    for step in (0, 3, 7, 11):
+        xb, _ = ds.batch(step)
+        np.testing.assert_array_equal(xb[:, 0].astype(int),
+                                      old_batch(step))

@@ -129,3 +129,19 @@ def test_peak_lookup_and_mfu():
 
     assert flops_mod.peak_flops_per_chip(Cpu()) is None
     assert flops_mod.mfu(100.0, 1e12, device=Cpu()) is None
+
+
+def test_static_input_specs_match_real_datasets():
+    # flops counting derives input shapes from config alone (no file
+    # I/O); the static table must track the real dataset specs
+    from pytorch_distributed_nn_tpu.data import get_dataset
+
+    for name, shape in flops_mod._IMAGE_SPECS.items():
+        spec = get_dataset(name, seed=0, batch_size=1).spec
+        assert spec.x_shape == shape, name
+        assert spec.x_dtype == np.float32
+    for name in ("lm_synthetic", "mlm_synthetic"):
+        spec = get_dataset(name, seed=0, batch_size=1, seq_len=64,
+                           vocab_size=128).spec
+        assert spec.x_shape == (64,)
+        assert spec.x_dtype == np.int32

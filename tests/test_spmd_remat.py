@@ -1,0 +1,53 @@
+"""Regression: the composed TP x FSDP x DP grad-accum step must compile
+without XLA SPMD "Involuntary full rematerialization" warnings
+(VERDICT.md round-1 Weak #2 / Next #2).
+
+The warning is emitted by C++ absl logging at compile time, so the
+compile runs in a subprocess and the test greps its stderr. Harmless at
+toy size, that warning means the partitioner replicates a tensor to move
+between incompatible shardings — a per-microbatch full replication of
+real tensors at 8B scale.
+"""
+
+import os
+import subprocess
+import sys
+
+_SCRIPT = r"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+from pytorch_distributed_nn_tpu.config import get_config, MeshSpec
+from pytorch_distributed_nn_tpu.runtime.mesh import make_mesh
+from pytorch_distributed_nn_tpu.train.trainer import Trainer
+
+cfg = get_config("llama3_8b_zero", **{"steps": "1", "log_every": "1",
+                                      "data.prefetch": "0"})
+cfg.model.extra = dict(num_layers=2, d_model=64, num_heads=4,
+                       num_kv_heads=2, mlp_dim=128, vocab_size=256)
+cfg.model.remat = False
+cfg.data.batch_size = 8
+cfg.data.seq_len = 32
+cfg.data.vocab_size = 256
+cfg.parallel.strategy = "zero"
+cfg.parallel.zero_stage = 3
+cfg.parallel.grad_accum = 2
+cfg.mesh = MeshSpec(tensor=2, fsdp=2, data=2)
+mesh = make_mesh(cfg.mesh.resolve(8))
+trainer = Trainer(cfg, mesh=mesh)
+trainer.train(1)  # compiles jit(step_accum) and runs one real step
+print("STEP_ACCUM_OK")
+"""
+
+
+def test_composed_grad_accum_step_has_no_involuntary_remat():
+    r = subprocess.run(
+        [sys.executable, "-c", _SCRIPT],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        capture_output=True, text=True, timeout=420,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "STEP_ACCUM_OK" in r.stdout
+    assert "Involuntary full rematerialization" not in r.stderr, (
+        "\n".join(l for l in r.stderr.splitlines() if "spmd" in l.lower())
+    )
