@@ -89,9 +89,11 @@ class ParallelConfig:
     # (torch-accumulation-loop semantics), so those curves differ
     # slightly from the one-shot step
     grad_accum: int = 1
-    # Only "gpipe" exists: the backward schedule is AD-derived (the scan
-    # transpose IS the reverse fill-drain), so a manually interleaved
-    # 1F1B would be a different construction, not a flag.
+    # "gpipe": AD-transposed fill-drain — simplest, but the scan
+    # transpose saves residuals for every in-flight tick, so activation
+    # memory grows with `microbatches`. "1f1b": PipeDream-flush with a
+    # manual per-stage backward (parallel/pipeline.py::_make_1f1b_step)
+    # — activation memory bounded by ~2*stages, dropout supported.
     pipeline_schedule: str = "gpipe"
     quantized_allreduce: str = ""  # "" | "bf16" | "int8" (EQuARX-style)
 

@@ -268,20 +268,30 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_scr[...].astype(dv_ref.dtype)
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k"))
+@functools.partial(jax.jit, static_argnames=("causal", "block_q",
+                                             "block_k", "out_dtype",
+                                             "interpret"))
 def _flash_bwd_pallas(q, k, v, g, lse, delta, *, causal: bool,
-                      block_q: int, block_k: int):
+                      block_q: int, block_k: int, out_dtype=None,
+                      interpret: bool = False):
     """(dq, dk, dv) via the two-pass Pallas backward. GQA-native like the
     forward: k/v (BKV, T, D) with BKV | BH; dk/dv come back grouped —
     the dk/dv grid iterates the group's Q heads inside each KV block so
     their contributions sum in the VMEM accumulator, which is exactly
     the head-group reduction an expanded-KV backward would need a
-    separate sum for."""
+    separate sum for.
+
+    ``out_dtype`` overrides the gradient dtype (ring attention
+    accumulates per-step contributions in f32 across ring rounds);
+    ``interpret`` runs the kernels under the Pallas interpreter (CPU
+    correctness path for the ring backward)."""
     BH, T, D = q.shape
     BKV = k.shape[0]
     q_per_kv = BH // BKV
     nq = pl.cdiv(T, block_q)
     nk = pl.cdiv(T, block_k)
+    dq_dtype = out_dtype or q.dtype
+    dkv_dtype = out_dtype or k.dtype
 
     q_map = lambda bh, qi, kj: (bh, qi, 0)  # noqa: E731
     # stats: whole (nq, block_q) plane resident (128 KB f32 at T=32k)
@@ -309,11 +319,12 @@ def _flash_bwd_pallas(q, k, v, g, lse, delta, *, causal: bool,
         ],
         out_specs=pl.BlockSpec((1, block_q, D), q_map,
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((BH, T, D), q.dtype),
+        out_shape=jax.ShapeDtypeStruct((BH, T, D), dq_dtype),
         scratch_shapes=[pltpu.VMEM((block_q, D), jnp.float32)],
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
+        interpret=interpret,
     )(q, k, v, g, lse, delta)
 
     # dk/dv pass: for a fixed K/V block, the inner grid dim walks the
@@ -350,8 +361,8 @@ def _flash_bwd_pallas(q, k, v, g, lse, delta, *, causal: bool,
             pl.BlockSpec((1, block_k, D), kv_fix, memory_space=pltpu.VMEM),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BKV, T, D), k.dtype),
-            jax.ShapeDtypeStruct((BKV, T, D), v.dtype),
+            jax.ShapeDtypeStruct((BKV, T, D), dkv_dtype),
+            jax.ShapeDtypeStruct((BKV, T, D), dkv_dtype),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, D), jnp.float32),
@@ -360,6 +371,7 @@ def _flash_bwd_pallas(q, k, v, g, lse, delta, *, causal: bool,
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "parallel", "arbitrary"),
         ),
+        interpret=interpret,
     )(q, k, v, g, lse, delta)
     return dq, dk, dv
 

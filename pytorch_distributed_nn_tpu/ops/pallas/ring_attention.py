@@ -29,9 +29,13 @@ the -inf running-max never produces a spurious ``exp(0)`` on later
 fully-masked blocks.
 
 Differentiation: ``pallas_call`` has no automatic VJP, so callers wrap
-the whole ring in ``jax.custom_vjp`` with a recompute backward through
-the jnp schedule (parallel/sequence.py) — flash-attention-style
-recomputation, trading one extra forward for not materialising scores.
+the whole ring in ``jax.custom_vjp`` (parallel/sequence.py). The
+backward replays p from the forward's saved logsumexp and dispatches
+the flash two-pass Pallas kernels per ring step (each local-Q x
+visiting-KV pair is causally either the diagonal, fully past, or fully
+future), so the backward never materialises scores either; tiny shards
+with no viable block tiling fall back to recompute through the jnp
+schedule.
 """
 
 from __future__ import annotations

@@ -52,7 +52,7 @@ class StagePartition:
 
     block_names: list[str]  # ordered param-tree keys of the block stack
     embed: Callable  # (params, tokens) -> activations
-    block: Callable  # (one_block_params, x) -> x
+    block: Callable  # (one_block_params, x, *, train, rng) -> x
     head: Callable  # (params, x) -> logits
 
 
@@ -94,8 +94,10 @@ def partition_for(model) -> StagePartition:
                               jnp.arange(T)[None])
             return x.astype(model.dtype)
 
-        def block(p, x):
-            return block_mod.apply({"params": p}, x, train=True)
+        def block(p, x, *, train=True, rng=None):
+            rngs = None if rng is None else {"dropout": rng}
+            return block_mod.apply({"params": p}, x, train=train,
+                                   rngs=rngs)
 
         def head(params, x):
             x = ln_f.apply({"params": params["ln_f"]}, x)
@@ -122,8 +124,10 @@ def partition_for(model) -> StagePartition:
             x = tok.apply({"params": params["tok_embed"]}, tokens)
             return x.astype(model.dtype)
 
-        def block(p, x):
-            return block_mod.apply({"params": p}, x, train=True)
+        def block(p, x, *, train=True, rng=None):
+            rngs = None if rng is None else {"dropout": rng}
+            return block_mod.apply({"params": p}, x, train=train,
+                                   rngs=rngs)
 
         def head(params, x):
             x = norm.apply({"params": params["final_norm"]}, x)
@@ -216,41 +220,44 @@ def restore_unstacked_params(cfg, checkpoint_dir: str):
         mgr.close()
 
 
-def _stage_apply(part: StagePartition, stage_params, x):
+def _stage_apply(part: StagePartition, stage_params, x, *,
+                 train: bool = True, rng=None):
     """Run this device's K blocks sequentially (scan over the stacked
-    leading dim)."""
-    def body(h, p):
-        return part.block(p, h), None
+    leading dim). ``rng`` (dropout): folded per layer so every block
+    draws a distinct mask — callers fold in microbatch and stage first,
+    making the stream deterministic for backward recompute."""
+    K = jax.tree.leaves(stage_params)[0].shape[0]
 
-    out, _ = lax.scan(body, x, stage_params)
+    if rng is None:
+        def body(h, p):
+            return part.block(p, h, train=train), None
+
+        out, _ = lax.scan(body, x, stage_params)
+    else:
+        def body(h, xs):
+            p, i = xs
+            return part.block(p, h, train=train,
+                              rng=jax.random.fold_in(rng, i)), None
+
+        out, _ = lax.scan(body, x, (stage_params, jnp.arange(K)))
     return out
 
 
-def make_pipeline_train_step(cfg: TrainConfig, mesh: Mesh,
-                             loss_fn: Callable, model):
-    S = mesh.shape[AXIS_PIPE]
-    M = max(cfg.parallel.microbatches, 1)
-    if S < 2:
-        raise ValueError("pipeline strategy needs mesh.pipe >= 2")
-    if cfg.parallel.pipeline_schedule != "gpipe":
-        raise ValueError(
-            f"unknown pipeline_schedule "
-            f"{cfg.parallel.pipeline_schedule!r}; only 'gpipe' exists "
-            "(the backward fill-drain is AD-derived from the forward scan)"
-        )
-    if getattr(model, "dropout", 0.0):
-        raise ValueError(
-            "pipeline strategy does not support dropout yet; set "
-            "model dropout to 0"
-        )
-    part = partition_for(model)
+_DATA_SPEC = batch_pspec()  # P(('data','fsdp')) — mesh.py owns this
+_X_MB_SPEC = P(None, *_DATA_SPEC)  # (M, mb, ...)
+_STAGE_SPEC = P(AXIS_PIPE)
 
+
+def _pipelined_forward(part: StagePartition, mesh: Mesh, S: int, M: int,
+                       *, train: bool):
+    """The GPipe fill-drain FORWARD as a shard_map over ``pipe``:
+    (stage_params, x_mb (M, mb, T, D)) -> last-stage outputs, broadcast
+    to every stage for the replicated head. Differentiable (the AD
+    transpose is the reverse fill-drain) and reused verbatim by the
+    forward-only pipeline eval path (train=False)."""
     fwd_edges = [(i, i + 1) for i in range(S - 1)]  # no wraparound
 
     def pipelined_blocks(stage_params, x_mb):
-        """Inside shard_map over `pipe` (and the data axes). stage_params:
-        local (1, K, ...) tree — squeeze the pipe dim; x_mb: (M, mb, T, D)
-        local batch shard."""
         stage_params = jax.tree.map(lambda p: p.squeeze(0), stage_params)
         idx = lax.axis_index(AXIS_PIPE)
         mb_shape = x_mb.shape[1:]
@@ -261,7 +268,7 @@ def make_pipeline_train_step(cfg: TrainConfig, mesh: Mesh,
             buf, outputs = carry
             feed = x_mb[jnp.clip(t, 0, M - 1)]
             x_in = jnp.where(idx == 0, feed, buf)
-            y = _stage_apply(part, stage_params, x_in)
+            y = _stage_apply(part, stage_params, x_in, train=train)
             sent = lax.ppermute(y, AXIS_PIPE, fwd_edges)
             out_t = t - (S - 1)
             write = jnp.logical_and(idx == S - 1, out_t >= 0)
@@ -286,43 +293,35 @@ def make_pipeline_train_step(cfg: TrainConfig, mesh: Mesh,
         )
         return outputs
 
-    data_spec = batch_pspec()  # P(('data','fsdp'))
-    x_mb_spec = P(None, ("data", "fsdp"))  # (M, mb, T, D)
-    stage_spec = P(AXIS_PIPE)
-
-    sharded_pipeline = jax.shard_map(
+    return jax.shard_map(
         pipelined_blocks,
         mesh=mesh,
-        in_specs=(stage_spec, x_mb_spec),
-        out_specs=x_mb_spec,
+        in_specs=(_STAGE_SPEC, _X_MB_SPEC),
+        out_specs=_X_MB_SPEC,
         check_vma=False,
     )
 
-    def step(state: TrainState, tokens, targets):
-        B = tokens.shape[0]
-        if B % M:
-            raise ValueError(f"batch {B} not divisible by {M} microbatches")
 
-        def compute(params):
-            h = part.embed(params["rest"], tokens)  # (B, T, D)
-            h_mb = h.reshape((M, B // M) + h.shape[1:])
-            h_mb = sharded_pipeline(params["stages"], h_mb)
-            h = h_mb.reshape((B,) + h_mb.shape[2:])
-            logits = part.head(params["rest"], h)
-            return loss_fn(logits, targets)
-
-        loss, grads = jax.value_and_grad(compute)(state.params)
-        new_state = state.apply_gradients(grads)
-        return new_state, {"loss": loss}
-
+def _state_placement(mesh: Mesh, part: StagePartition, S: int, step):
+    """(step_dispatch, place_state) for a pipeline step function:
+    stacks the flat params, shards stages over ``pipe``, replicates the
+    rest, jits with donation."""
     replicated = NamedSharding(mesh, P())
-    batch_sh = NamedSharding(mesh, data_spec)
+    batch_sh = NamedSharding(mesh, _DATA_SPEC)
+
+    def _opt_shardings(opt_state):
+        # optimizer moments mirror param shapes: shard any leaf whose
+        # leading dims match the stacked (S, K, ...) pattern
+        def spec_of(x):
+            if hasattr(x, "ndim") and x.ndim >= 2 and x.shape[0] == S:
+                return NamedSharding(mesh, _STAGE_SPEC)
+            return replicated
+
+        return jax.tree.map(spec_of, opt_state)
 
     def shardings_of(state):
-        # stages sharded over pipe (leading dim); everything else
-        # replicated
         stage_sh = jax.tree.map(
-            lambda _: NamedSharding(mesh, stage_spec),
+            lambda _: NamedSharding(mesh, _STAGE_SPEC),
             state.params["stages"],
         )
         param_sh = {"stages": stage_sh,
@@ -334,18 +333,8 @@ def make_pipeline_train_step(cfg: TrainConfig, mesh: Mesh,
             params=param_sh,
             model_state=jax.tree.map(lambda _: replicated,
                                      state.model_state),
-            opt_state=_opt_shardings(state.opt_state, mesh),
+            opt_state=_opt_shardings(state.opt_state),
         )
-
-    def _opt_shardings(opt_state, mesh):
-        # optimizer moments mirror param shapes: shard any leaf whose
-        # leading dims match the stacked (S, K, ...) pattern
-        def spec_of(x):
-            if hasattr(x, "ndim") and x.ndim >= 2 and x.shape[0] == S:
-                return NamedSharding(mesh, stage_spec)
-            return replicated
-
-        return jax.tree.map(spec_of, opt_state)
 
     compiled: dict = {}
 
@@ -371,3 +360,294 @@ def make_pipeline_train_step(cfg: TrainConfig, mesh: Mesh,
         return compiled["step"](state, x, y)
 
     return step_dispatch, place_state
+
+
+def make_pipeline_train_step(cfg: TrainConfig, mesh: Mesh,
+                             loss_fn: Callable, model):
+    S = mesh.shape[AXIS_PIPE]
+    M = max(cfg.parallel.microbatches, 1)
+    if S < 2:
+        raise ValueError("pipeline strategy needs mesh.pipe >= 2")
+    schedule = cfg.parallel.pipeline_schedule
+    if schedule == "1f1b":
+        return _make_1f1b_step(cfg, mesh, loss_fn, model, S, M)
+    if schedule != "gpipe":
+        raise ValueError(
+            f"unknown pipeline_schedule {schedule!r}; have 'gpipe' "
+            "(AD-transposed fill-drain) and '1f1b' (PipeDream-flush, "
+            "manual backward, depth-bounded activation memory)"
+        )
+    if getattr(model, "dropout", 0.0):
+        raise ValueError(
+            "the gpipe schedule does not support dropout; use "
+            "pipeline_schedule='1f1b' (deterministic per-microbatch "
+            "rng, recomputed in its manual backward) or set model "
+            "dropout to 0"
+        )
+    part = partition_for(model)
+    sharded_pipeline = _pipelined_forward(part, mesh, S, M, train=True)
+
+    def step(state: TrainState, tokens, targets):
+        B = tokens.shape[0]
+        if B % M:
+            raise ValueError(f"batch {B} not divisible by {M} microbatches")
+
+        def compute(params):
+            h = part.embed(params["rest"], tokens)  # (B, T, D)
+            h_mb = h.reshape((M, B // M) + h.shape[1:])
+            h_mb = sharded_pipeline(params["stages"], h_mb)
+            h = h_mb.reshape((B,) + h_mb.shape[2:])
+            logits = part.head(params["rest"], h)
+            return loss_fn(logits, targets)
+
+        loss, grads = jax.value_and_grad(compute)(state.params)
+        new_state = state.apply_gradients(grads)
+        return new_state, {"loss": loss}
+
+    return _state_placement(mesh, part, S, step)
+
+
+def _make_1f1b_step(cfg: TrainConfig, mesh: Mesh, loss_fn: Callable,
+                    model, S: int, M: int):
+    """The 1F1B (PipeDream-flush) pipeline step: manual backward.
+
+    GPipe above lets AD transpose the forward scan, which forces the
+    scan to save residuals for every in-flight tick — activation memory
+    grows with the microbatch count M. Here the backward is explicit:
+    the tick body holds a ring buffer of at most ``2S - 1`` saved stage
+    INPUTS, and a backward unit re-linearizes its stage from the saved
+    input (``jax.vjp``) at the tick the schedule dictates — per-stage
+    recompute, exactly one extra forward, O(S) activation memory
+    (pipeline_schedule.py has the schedule math).
+
+    Structure per tick (all stages run the same traced body):
+    - forward unit: consume previous tick's ppermute (stage 0: embed
+      the scheduled token microbatch), save the input, send the output
+      right. Masked by the fwd table.
+    - backward unit: three device-varying flavors via ``lax.switch`` —
+      stage 0 differentiates (blocks∘embed) and accumulates embed
+      grads; middle stages differentiate blocks against the received
+      cotangent; the last stage differentiates (loss∘head∘blocks)
+      from its saved input (no received cotangent — the loss grad is
+      born here). Cotangents are sent left. Masked by the bwd table.
+    - both ppermutes run unconditionally — collectives never sit in
+      divergent control flow; the tables guarantee sender/receiver
+      liveness matches.
+
+    Dropout is supported (unlike gpipe): each microbatch/stage/layer
+    folds a deterministic rng, so the backward's recompute sees the
+    identical masks its forward drew.
+    """
+    from pytorch_distributed_nn_tpu.parallel.pipeline_schedule import (
+        NO_OP,
+        one_f_one_b,
+    )
+
+    part = partition_for(model)
+    sched = one_f_one_b(S, M)
+    depth = sched.max_in_flight
+    fwd_tbl = jnp.asarray(sched.fwd)  # (N, S) int32
+    bwd_tbl = jnp.asarray(sched.bwd)
+    n_ticks = sched.n_ticks
+    fwd_edges = [(i, i + 1) for i in range(S - 1)]
+    bwd_edges = [(i + 1, i) for i in range(S - 1)]
+    use_dropout = bool(getattr(model, "dropout", 0.0))
+
+    def body(stage_params, rest_params, tok_mb, tgt_mb, rng):
+        """Inside shard_map. stage_params local (1, K, ...); tok_mb
+        (M, mb, T) int tokens; tgt_mb the matching targets; rng the
+        per-step dropout key (unused when the model has no dropout)."""
+        sp = jax.tree.map(lambda p: p.squeeze(0), stage_params)
+        idx = lax.axis_index(AXIS_PIPE)
+        probe = part.embed(rest_params, tok_mb[0])  # shape/dtype probe
+        mb_shape, act_dtype = probe.shape, probe.dtype
+
+        def mb_rng(b):
+            if not use_dropout:
+                return None
+            # decorrelate over (step-folded base rng, microbatch,
+            # stage); _stage_apply folds the in-stage layer index
+            return jax.random.fold_in(jax.random.fold_in(rng, b), idx)
+
+        def stage_fwd(sp_, x, b):
+            return _stage_apply(part, sp_, x, train=True, rng=mb_rng(b))
+
+        def tick(carry, t):
+            recv_f, recv_b, act, sg, rg, loss_sum = carry
+            f_mb = fwd_tbl[t, idx]
+            b_mb = bwd_tbl[t, idx]
+            f_idx = jnp.clip(f_mb, 0, M - 1)
+            b_idx = jnp.clip(b_mb, 0, M - 1)
+            # Read the backward's saved input BEFORE the forward unit
+            # writes: at stage 0 in steady state f - b == depth, so
+            # this tick's forward lands in exactly the slot the
+            # backward needs (ring reuse is tight by construction).
+            x_saved = act[b_idx % depth]
+
+            # ---- forward unit (dead warmup/drain ticks skip the
+            # stage compute entirely — local cond, no collectives) ----
+            def fwd_unit(_):
+                x_in = lax.cond(
+                    idx == 0,
+                    lambda: part.embed(rest_params, tok_mb[f_idx])
+                    .astype(act_dtype),
+                    lambda: recv_f,
+                )
+                slot = f_idx % depth
+                act_new = lax.dynamic_update_index_in_dim(
+                    act, x_in, slot, 0
+                )
+                # the last stage's forward output feeds nobody (its
+                # backward re-linearizes from the saved input): skip
+                y = lax.cond(
+                    idx == S - 1,
+                    lambda: jnp.zeros(mb_shape, act_dtype),
+                    lambda: stage_fwd(sp, x_in, f_idx).astype(act_dtype),
+                )
+                return act_new, y
+
+            act, y = lax.cond(
+                f_mb != NO_OP, fwd_unit,
+                lambda _: (act, jnp.zeros(mb_shape, act_dtype)), None,
+            )
+
+            # ---- backward unit (three flavors; dead ticks skip both
+            # the vjp and the dense grad-tree accumulate) -------------
+            def bwd_unit(_):
+                def bwd_first(_):
+                    def f(sp_, rp_):
+                        x0 = part.embed(rp_, tok_mb[b_idx]) \
+                            .astype(act_dtype)
+                        return stage_fwd(sp_, x0, b_idx).astype(act_dtype)
+
+                    _, vjp = jax.vjp(f, sp, rest_params)
+                    dsp, drp = vjp(recv_b)
+                    return (jnp.zeros((), jnp.float32), dsp, drp,
+                            jnp.zeros(mb_shape, act_dtype))
+
+                def bwd_mid(_):
+                    def f(sp_, x):
+                        return stage_fwd(sp_, x, b_idx).astype(act_dtype)
+
+                    _, vjp = jax.vjp(f, sp, x_saved)
+                    dsp, dx = vjp(recv_b)
+                    zeros_rest = jax.tree.map(jnp.zeros_like, rest_params)
+                    return (jnp.zeros((), jnp.float32), dsp, zeros_rest,
+                            dx)
+
+                def bwd_last(_):
+                    tgt = tgt_mb[b_idx]
+
+                    def f(sp_, rp_, x):
+                        yl = stage_fwd(sp_, x, b_idx)
+                        logits = part.head(rp_, yl)
+                        # mean of per-mb means == global batch mean
+                        return (loss_fn(logits, tgt) / M) \
+                            .astype(jnp.float32)
+
+                    lv, vjp = jax.vjp(f, sp, rest_params, x_saved)
+                    dsp, drp, dx = vjp(jnp.ones((), jnp.float32))
+                    return lv, dsp, drp, dx
+
+                branch = jnp.where(idx == 0, 0,
+                                   jnp.where(idx == S - 1, 2, 1))
+                lv, dsp, drp, dx = lax.switch(
+                    branch, (bwd_first, bwd_mid, bwd_last), None
+                )
+                sg_new = jax.tree.map(jnp.add, sg, dsp)
+                rg_new = jax.tree.map(jnp.add, rg, drp)
+                return sg_new, rg_new, loss_sum + lv, dx
+
+            sg, rg, loss_sum, dx = lax.cond(
+                b_mb != NO_OP, bwd_unit,
+                lambda _: (sg, rg, loss_sum,
+                           jnp.zeros(mb_shape, act_dtype)), None,
+            )
+
+            # ---- unconditional sends -------------------------------
+            recv_f = lax.ppermute(y, AXIS_PIPE, fwd_edges)
+            recv_b = lax.ppermute(dx, AXIS_PIPE, bwd_edges)
+            return (recv_f, recv_b, act, sg, rg, loss_sum), None
+
+        zeros_act = jnp.zeros(mb_shape, act_dtype)
+        init = (
+            zeros_act,
+            zeros_act,
+            jnp.zeros((depth,) + mb_shape, act_dtype),
+            jax.tree.map(jnp.zeros_like, sp),
+            jax.tree.map(jnp.zeros_like, rest_params),
+            jnp.zeros((), jnp.float32),
+        )
+        init = jax.tree.map(lambda x: lax.pvary(x, AXIS_PIPE), init)
+        (_, _, _, sg, rg, loss_sum), _ = lax.scan(
+            tick, init, jnp.arange(n_ticks)
+        )
+        # Everything so far is PER DATA SHARD (the whole loss/backward
+        # runs inside shard_map, unlike gpipe where jit-level SPMD
+        # averages across the batch axes automatically): take the mean
+        # over the data axes explicitly. Stage grads then live with
+        # their stage (out spec: pipe-sharded); rest grads were
+        # accumulated on stages 0 (embed) and S-1 (head) only — the
+        # pipe-sum makes them replicated like the params they update.
+        data_axes = ("data", "fsdp")
+        sg = jax.tree.map(
+            lambda g: lax.pmean(g, data_axes)[None], sg
+        )
+        rg = jax.tree.map(
+            lambda g: lax.pmean(lax.psum(g, AXIS_PIPE), data_axes), rg
+        )
+        loss = lax.pmean(lax.psum(loss_sum, AXIS_PIPE), data_axes)
+        return sg, rg, loss
+
+    sharded = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(_STAGE_SPEC, P(), _X_MB_SPEC, _X_MB_SPEC, P()),
+        out_specs=(_STAGE_SPEC, P(), P()),
+        check_vma=False,
+    )
+
+    def step(state: TrainState, tokens, targets):
+        B = tokens.shape[0]
+        if B % M:
+            raise ValueError(f"batch {B} not divisible by {M} microbatches")
+        tok_mb = tokens.reshape((M, B // M) + tokens.shape[1:])
+        tgt_mb = targets.reshape((M, B // M) + targets.shape[1:])
+        rng = jax.random.fold_in(state.rng, state.step)
+        sg, rg, loss = sharded(state.params["stages"],
+                               state.params["rest"], tok_mb, tgt_mb, rng)
+        grads = {"stages": sg, "rest": rg}
+        new_state = state.apply_gradients(grads)
+        return new_state, {"loss": loss}
+
+    return _state_placement(mesh, part, S, step)
+
+
+def make_pipeline_eval_step(cfg: TrainConfig, mesh: Mesh,
+                            loss_fn: Callable, model):
+    """Forward-only pipelined evaluation on STACKED stage params: the
+    fill-drain forward with train=False, then head + loss + masked
+    accuracy — lifting round 1's 'evaluate with strategy=dp on
+    unstacked params instead' restriction."""
+    S = mesh.shape[AXIS_PIPE]
+    M = max(cfg.parallel.microbatches, 1)
+    part = partition_for(model)
+    fwd = _pipelined_forward(part, mesh, S, M, train=False)
+
+    def eval_step(state: TrainState, x, y):
+        B = x.shape[0]
+        if B % M:
+            raise ValueError(f"batch {B} not divisible by {M} microbatches")
+        params = state.params
+        h = part.embed(params["rest"], x)
+        h_mb = h.reshape((M, B // M) + h.shape[1:])
+        h_mb = fwd(params["stages"], h_mb)
+        h = h_mb.reshape((B,) + h_mb.shape[2:])
+        logits = part.head(params["rest"], h)
+        loss = loss_fn(logits, y)
+        valid = y >= 0
+        hit = jnp.logical_and(logits.argmax(-1) == y, valid)
+        acc = hit.sum() / jnp.maximum(valid.sum(), 1)
+        return loss.astype(jnp.float32), acc.astype(jnp.float32)
+
+    return jax.jit(eval_step)

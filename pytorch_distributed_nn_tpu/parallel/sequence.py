@@ -9,8 +9,9 @@ axis, both exact (not approximations):
   online-softmax accumulates (flash-attention math, blockwise over
   devices). O(T/s) memory per device; comm fully overlappable with the
   per-block matmuls. ``impl='pallas'`` fuses each block update into the
-  ops/pallas/ring_attention kernel (the TPU path — scores never touch
-  HBM; backward recomputes through the jnp schedule via custom_vjp);
+  ops/pallas/ring_attention kernel, and the backward runs the flash
+  two-pass Pallas kernels per ring step with f32 dk/dv accumulators
+  riding the ring — scores never touch HBM in either direction;
   ``impl='xla'`` is the jnp reference and the CPU test path.
 
 - :func:`ulysses_attention` — head-scatter: two ``all_to_all``s reshard
@@ -138,7 +139,9 @@ def _ring_attention_xla(q, k, v, *, axis: str = AXIS_SEQ,
 def _ring_fused_impl(q, k, v, axis: str, causal: bool, interpret: bool):
     """Forward ring schedule with the fused Pallas block kernel
     (ops/pallas/ring_attention): same math as :func:`_ring_attention_xla`
-    but each block update runs in one kernel, (BH, Tl, D) layout."""
+    but each block update runs in one kernel, (BH, Tl, D) layout.
+    Returns (out, lse) — the per-row logsumexp is the softmax stat the
+    Pallas ring backward replays p from."""
     from pytorch_distributed_nn_tpu.ops.pallas.ring_attention import (
         STAT_LANES,
         ring_block_update,
@@ -194,29 +197,130 @@ def _ring_fused_impl(q, k, v, axis: str, causal: bool, interpret: bool):
         qb, expand_bh(kb), expand_bh(vb), m, l, acc, offs,
         causal=causal, interpret=interpret,
     )
-    out = acc / jnp.maximum(l[..., 0:1], 1e-30)
-    return out.reshape(B, H, Tl, D).transpose(0, 2, 1, 3).astype(q.dtype)
+    l0c = jnp.maximum(l[..., 0:1], 1e-30)
+    out = acc / l0c
+    lse = m[..., 0] + jnp.log(l0c[..., 0])  # (BH, Tl) f32
+    out = out.reshape(B, H, Tl, D).transpose(0, 2, 1, 3).astype(q.dtype)
+    return out, lse
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
 def _ring_attention_fused(q, k, v, axis, causal, interpret):
-    return _ring_fused_impl(q, k, v, axis, causal, interpret)
+    return _ring_fused_impl(q, k, v, axis, causal, interpret)[0]
 
 
 def _ring_fused_fwd(q, k, v, axis, causal, interpret):
-    return _ring_fused_impl(q, k, v, axis, causal, interpret), (q, k, v)
+    out, lse = _ring_fused_impl(q, k, v, axis, causal, interpret)
+    return out, (q, k, v, out, lse)
 
 
 def _ring_fused_bwd(axis, causal, interpret, res, g):
-    # flash-style recompute: rerun the (differentiable) jnp schedule and
-    # pull its VJP — no (T, T) scores or per-block residuals ever stored
-    q, k, v = res
-    _, vjp = jax.vjp(
-        lambda a, b, c: _ring_attention_xla(a, b, c, axis=axis,
-                                            causal=causal),
-        q, k, v,
+    """Pallas ring backward: dk/dv accumulators ride the KV ring.
+
+    Every ring step pairs the local Q shard with the visiting KV shard;
+    under global causality that pair is one of exactly three flavors —
+    the diagonal (src == idx: ordinary causal self-attention geometry),
+    the past (src < idx: dense, no mask), or the future (src > idx:
+    zero gradient). The first two are precisely what the flash
+    two-pass backward kernels already compute, with p replayed from the
+    forward's saved lse — so each step dispatches those kernels instead
+    of re-running the jnp schedule, and no (Tl, Tl) score block ever
+    reaches HBM in either direction (VERDICT.md round-1 Weak #3).
+
+    Gradients accumulate in f32: dq stays resident with Q; dk/dv travel
+    one hop behind their KV block and take a final ppermute home.
+    """
+    q, k, v, out, lse = res
+    from pytorch_distributed_nn_tpu.ops.pallas.flash_attention import (
+        _flash_bwd_pallas,
+        _pick_block,
     )
-    return vjp(g.astype(q.dtype))
+
+    B, Tl, H, D = q.shape
+    Hkv = k.shape[2]
+    on_tpu = jax.default_backend() == "tpu"
+    bq = _pick_block(Tl, min(512, Tl))
+    bk = _pick_block(Tl, min(512, Tl))
+    if bq is None or bk is None or not (on_tpu or interpret):
+        # no viable block tiling (tiny shards) or CPU without interpret:
+        # recompute through the differentiable jnp schedule
+        _, vjp = jax.vjp(
+            lambda a, b, c: _ring_attention_xla(a, b, c, axis=axis,
+                                                causal=causal),
+            q, k, v,
+        )
+        return vjp(g.astype(q.dtype))
+
+    s = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+
+    def to_bh(x):
+        h = x.shape[2]
+        return x.transpose(0, 2, 1, 3).reshape(B * h, Tl, D)
+
+    qb, gb, outb = to_bh(q), to_bh(g.astype(q.dtype)), to_bh(out)
+    kb, vb = to_bh(k), to_bh(v)  # grouped (B*Hkv, Tl, D) — never expanded
+    delta = jnp.sum(outb.astype(jnp.float32) * gb.astype(jnp.float32), -1)
+    nq = Tl // bq
+    lse_r = lse.reshape(B * H, nq, bq)
+    delta_r = delta.reshape(B * H, nq, bq)
+    interp = bool(interpret and not on_tpu)
+
+    def pair_bwd(kv, pair_causal):
+        return _flash_bwd_pallas(
+            qb, kv[0], kv[1], gb, lse_r, delta_r, causal=pair_causal,
+            block_q=bq, block_k=bk, out_dtype=jnp.float32,
+            interpret=interp,
+        )
+
+    def contrib(k_blk, v_blk, src):
+        if not causal:
+            return pair_bwd((k_blk, v_blk), False)
+
+        def future(kv):
+            zq = jnp.zeros((B * H, Tl, D), jnp.float32)
+            zkv = jnp.zeros((B * Hkv, Tl, D), jnp.float32)
+            return tuple(lax.pvary(t, axis) for t in (zq, zkv, zkv))
+
+        return lax.cond(
+            src == idx,
+            lambda kv: pair_bwd(kv, True),
+            lambda kv: lax.cond(src < idx,
+                                lambda kv2: pair_bwd(kv2, False),
+                                future, kv),
+            (k_blk, v_blk),
+        )
+
+    def step(carry, i):
+        k_blk, v_blk, dk, dv, dq = carry
+        src = (idx - i) % s
+        dqc, dkc, dvc = contrib(k_blk, v_blk, src)
+        dq, dk, dv = dq + dqc, dk + dkc, dv + dvc
+        k_blk = cc.shift_right(k_blk, axis)
+        v_blk = cc.shift_right(v_blk, axis)
+        dk = cc.shift_right(dk, axis)  # accumulators follow their block
+        dv = cc.shift_right(dv, axis)
+        return (k_blk, v_blk, dk, dv, dq), None
+
+    dq0 = jnp.zeros((B * H, Tl, D), jnp.float32)
+    dk0 = jnp.zeros((B * Hkv, Tl, D), jnp.float32)
+    dv0 = jnp.zeros_like(dk0)
+    dq0, dk0, dv0 = (lax.pvary(t, axis) for t in (dq0, dk0, dv0))
+    (kb, vb, dk, dv, dq), _ = lax.scan(
+        step, (kb, vb, dk0, dv0, dq0), jnp.arange(s - 1)
+    )
+    # last round outside the scan: KV needs no further rotation, but the
+    # visiting block's accumulators are one hop from home
+    dqc, dkc, dvc = contrib(kb, vb, (idx - (s - 1)) % s)
+    dq = dq + dqc
+    dk = cc.shift_right(dk + dkc, axis)
+    dv = cc.shift_right(dv + dvc, axis)
+
+    def from_bh(x, h, dtype):
+        return x.reshape(B, h, Tl, D).transpose(0, 2, 1, 3).astype(dtype)
+
+    return (from_bh(dq, H, q.dtype), from_bh(dk, Hkv, k.dtype),
+            from_bh(dv, Hkv, v.dtype))
 
 
 _ring_attention_fused.defvjp(_ring_fused_fwd, _ring_fused_bwd)

@@ -57,13 +57,6 @@ from pytorch_distributed_nn_tpu.data.datasets import (
 
 class Trainer:
     def __init__(self, cfg: TrainConfig, mesh=None) -> None:
-        if cfg.eval_every and cfg.parallel.strategy == "pipeline":
-            # knowable from cfg alone: fail at construction, not at the
-            # first eval tick mid-run
-            raise ValueError(
-                "eval_every is not supported under the pipeline strategy "
-                "(stage params are stacked); evaluate with strategy='dp'"
-            )
         self.cfg = cfg
         self.mesh = mesh if mesh is not None else make_mesh(
             cfg.mesh.resolve(len(jax.devices()))
@@ -220,17 +213,23 @@ class Trainer:
 
         cfg = self.cfg
         if cfg.parallel.strategy == "pipeline":
-            raise RuntimeError(
-                "evaluate() is not supported under the pipeline strategy "
-                "(stage params are stacked); evaluate with strategy='dp' "
-                "on unstacked params instead"
+            # forward-only pipelined eval on the stacked stage params
+            from pytorch_distributed_nn_tpu.parallel.pipeline import (
+                make_pipeline_eval_step,
             )
+
+            self._eval_step = make_pipeline_eval_step(
+                cfg, self.mesh, self.loss_fn, self.model
+            )
+            return
         from pytorch_distributed_nn_tpu.parallel.dp import forward
 
         loss_fn = self.loss_fn
         xent_chunk = self.cfg.xent_chunk
 
-        if xent_chunk:
+        # mirror api.make_train_step: when the whole sequence fits in
+        # one chunk, training used the dense loss — eval must too
+        if xent_chunk and self.cfg.data.seq_len > xent_chunk:
             # long-context LM: dense (B, T, V) eval logits would OOM the
             # same way training would — evaluate chunked too
             from pytorch_distributed_nn_tpu.train.losses import (
