@@ -120,7 +120,79 @@ _METRIC_NAMES = {
     "throughput": "samples/sec/chip ({preset})",
     "bus_bw": "grad-allreduce bus-bw ({preset})",
     "decode": "decode tokens/sec (llama3_8b_zero)",
+    "loader": "input-pipeline samples/sec ({preset})",
 }
+
+# Measured single-chip training consumption (BASELINE.md) — the rate
+# the input pipeline must beat for the chip never to starve.
+CHIP_CONSUMPTION = {
+    "resnet50_dp": 2550.0,
+    "bert_base_buckets": 1300.0,
+}
+
+
+def bench_loader(args) -> int:
+    """Input-pipeline throughput (SURVEY.md §7 hard part (d)): host
+    batch generation/decoding + per-host shard assembly into global
+    jax.Arrays, through the DataLoader's background-prefetch pipeline.
+
+    vs_baseline = loader samples/s ÷ the chip's measured TRAINING
+    consumption for the preset (CHIP_CONSUMPTION): > 1.0 proves the
+    pipeline feeds the chip faster than it consumes. Run under
+    JAX_PLATFORMS=cpu for a pure host-side number (on the default
+    backend the assembly includes the device transfer).
+
+    --loader-dataset/--data-path swap in the real on-disk readers
+    (mnist_idx / cifar10_bin / image_folder) for the preset's synthetic
+    stream.
+    """
+    import jax
+
+    from pytorch_distributed_nn_tpu.config import get_config
+    from pytorch_distributed_nn_tpu.data import DataLoader, get_dataset
+    from pytorch_distributed_nn_tpu.runtime.mesh import MeshSpec, make_mesh
+    from pytorch_distributed_nn_tpu.utils.metrics import MetricsLogger
+
+    cfg = get_config(args.preset)
+    if args.loader_dataset:
+        cfg.data.dataset = args.loader_dataset
+    if args.data_path:
+        cfg.data.path = args.data_path
+    n_chips = len(jax.devices())
+    per_chip = args.per_chip_batch or PER_CHIP_BATCH[args.preset]
+    cfg.data.batch_size = per_chip * n_chips
+    mesh = make_mesh(MeshSpec(data=-1).resolve(n_chips))
+    dataset = get_dataset(
+        cfg.data.dataset, seed=cfg.seed, batch_size=cfg.data.batch_size,
+        seq_len=cfg.data.seq_len, vocab_size=cfg.data.vocab_size,
+        path=cfg.data.path, token_dtype=cfg.data.token_dtype,
+        sample=cfg.data.sample, image_size=cfg.data.image_size,
+    )
+    loader = DataLoader(dataset, mesh, prefetch=max(cfg.data.prefetch, 2))
+    it = iter(loader)
+    for _ in range(max(args.warmup, 1)):
+        x, y = next(it)
+    jax.block_until_ready((x, y))
+    steps = max(args.steps, 1)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        x, y = next(it)
+    jax.block_until_ready((x, y))
+    dt = time.perf_counter() - t0
+    rate = steps * cfg.data.batch_size / dt
+    consume = CHIP_CONSUMPTION.get(args.preset)
+    with open(os.devnull, "w") as sink:
+        rec = MetricsLogger(stream=sink).emit_benchmark(
+            metric=_METRIC_NAMES["loader"].format(preset=args.preset),
+            value=round(rate, 1), unit="samples/sec",
+            vs_baseline=(round(rate / consume, 2) if consume else None),
+            detail=f"dataset={cfg.data.dataset}, global batch "
+                   f"{cfg.data.batch_size}, prefetch "
+                   f"{max(cfg.data.prefetch, 2)}, backend "
+                   f"{jax.default_backend()}",
+        )
+    print(json.dumps(rec))
+    return 0
 
 
 def emit_unavailable(args, detail: str) -> int:
@@ -264,10 +336,16 @@ def main(argv=None) -> int:
     ap.add_argument("--preset", default="resnet50_dp",
                     choices=sorted(PER_CHIP_BATCH))
     ap.add_argument("--metric", default="throughput",
-                    choices=("throughput", "bus_bw", "decode"),
+                    choices=("throughput", "bus_bw", "decode", "loader"),
                     help="bus_bw: BASELINE's grad-allreduce bus-bandwidth "
                          "metric (use with --preset bert_base_buckets); "
-                         "decode: KV-cache generation tokens/s")
+                         "decode: KV-cache generation tokens/s; loader: "
+                         "input-pipeline samples/s vs chip consumption")
+    ap.add_argument("--loader-dataset", default="",
+                    help="loader metric: swap the preset's dataset "
+                         "(e.g. image_folder, cifar10_bin, mnist_idx)")
+    ap.add_argument("--data-path", default="",
+                    help="loader metric: data.path for file datasets")
     ap.add_argument("--steps", type=int, default=30,
                     help="timed steps (after warmup)")
     ap.add_argument("--warmup", type=int, default=5,
@@ -299,6 +377,8 @@ def main(argv=None) -> int:
         return bench_bus_bw(args)
     if args.metric == "decode":
         return bench_decode(args)
+    if args.metric == "loader":
+        return bench_loader(args)
 
     import jax
 

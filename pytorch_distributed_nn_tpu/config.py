@@ -47,8 +47,14 @@ class DataConfig:
     # mnist | cifar10 | imagenet_synthetic | lm_synthetic | mlm_synthetic
     # | token_file (causal LM from a memory-mapped .bin/.npy token dump)
     # | array_file (classification from a .npz with arrays x, y)
+    # | mnist_idx (LeCun idx files; t10k-* pair = real eval split)
+    # | cifar10_bin (data_batch_*.bin; test_batch.bin = real eval split)
+    # | image_folder (torchvision layout root/<class>/<img>, lazy PIL
+    #   decode; train/ + val/ dirs honored as the split)
     dataset: str = "mnist"
-    path: str = ""  # file for token_file / array_file datasets
+    path: str = ""  # file/directory for the file-backed datasets
+    image_size: int = 224  # image_folder: decode target (short side +
+    #                        center crop, torchvision eval transform)
     token_dtype: str = "uint16"  # raw .bin token width (token_file)
     # array_file sampling: 'shuffle' (per-epoch permutation, torch
     # DistributedSampler semantics) or 'replacement' (i.i.d.)

@@ -57,12 +57,20 @@ class SyntheticDataset:
 
 class ClassTemplateImages(SyntheticDataset):
     """Class-conditional template + noise images: y ~ uniform(classes),
-    x = template[y] + N(0, noise). Linearly separable enough that small
-    nets learn it fast, hard enough that loss curves are informative."""
+    x = template[y] + noise drawn from a pre-generated N(0, noise) pool.
+    Linearly separable enough that small nets learn it fast, hard
+    enough that loss curves are informative.
+
+    The noise POOL (finite, like any real dataset's finite noise) is
+    what makes the host pipeline feed a chip: fresh per-pixel gaussians
+    for a 224^2 batch cost ~0.25 s/batch of single-core numpy — an
+    input-bound pipeline — while indexing the pool is a gather+add.
+    Per-batch draws stay (seed, step)-keyed: pool row choice and class
+    labels are deterministic, preserving the any-topology contract."""
 
     def __init__(self, seed: int, batch_size: int, *,
                  shape: tuple[int, ...], num_classes: int,
-                 noise: float = 0.35) -> None:
+                 noise: float = 0.35, noise_pool: int = 256) -> None:
         super().__init__(seed, batch_size)
         self.noise = noise
         self.spec = BatchSpec(shape, np.dtype(np.float32), (),
@@ -70,18 +78,22 @@ class ClassTemplateImages(SyntheticDataset):
         tmpl_rng = np.random.default_rng(
             np.random.SeedSequence([seed, 0xC1A55])
         )
-        self.templates = tmpl_rng.normal(
-            0.0, 1.0, size=(num_classes, *shape)
-        ).astype(np.float32)
+        self.templates = tmpl_rng.standard_normal(
+            (num_classes, *shape), dtype=np.float32
+        )
+        pool = tmpl_rng.standard_normal(
+            (max(noise_pool, 2), *shape), dtype=np.float32
+        )
+        pool *= noise
+        self._pool = pool
 
     def batch(self, step: int) -> tuple[np.ndarray, np.ndarray]:
         rng = self._rng(step)
         y = rng.integers(0, self.spec.num_classes, size=self.batch_size,
                          dtype=np.int32)
-        x = self.templates[y] + rng.normal(
-            0.0, self.noise, size=(self.batch_size, *self.spec.x_shape)
-        ).astype(np.float32)
-        return x.astype(np.float32), y
+        idx = rng.integers(0, len(self._pool), size=self.batch_size)
+        x = self.templates[y] + self._pool[idx]
+        return x, y
 
 
 class SyntheticLM(SyntheticDataset):
@@ -218,10 +230,10 @@ class TokenFileDataset(SyntheticDataset):
                 rows[:, 1:].astype(np.int32))
 
 
-class ArrayFileDataset(SyntheticDataset):
-    """Classification data from a ``.npz`` the user brings, with arrays
-    ``x`` (N, ...) and integer ``y`` (N,) — the torchvision-Dataset
-    analogue for migrants with exported arrays.
+class ArraySampler(SyntheticDataset):
+    """Epoch-shuffle / replacement sampling over an in-memory example
+    index — the engine behind every finite dataset here (npz arrays,
+    MNIST idx, CIFAR binaries, image folders).
 
     ``sample='shuffle'`` (default) walks a fresh per-epoch permutation —
     every example exactly once per epoch, torch ``DistributedSampler``
@@ -229,52 +241,69 @@ class ArrayFileDataset(SyntheticDataset):
     draws i.i.d. Both are (seed, step)-deterministic, preserving the
     any-topology determinism contract.
 
-    ``holdout_frac > 0`` reserves a (seed-deterministic, uniformly drawn)
-    row subset for held-out evaluation: training never visits those rows,
-    eval requests (step >= EVAL_STEP_OFFSET) visit only them. With
-    ``holdout_frac == 0`` eval draws from the training rows — in-sample."""
+    Held-out evaluation (eval requests arrive at step >=
+    EVAL_STEP_OFFSET), strongest available source first:
+    - subclasses with a REAL test split (MNIST t10k, CIFAR test_batch,
+      an image folder's val/ dir) pass ``n_eval_tail`` > 0: the last
+      ``n_eval_tail`` rows are that split, never trained on;
+    - else ``holdout_frac > 0`` reserves a seed-deterministic uniform
+      row subset;
+    - else eval draws from the training rows — in-sample.
 
-    def __init__(self, path: str, seed: int, batch_size: int, *,
-                 sample: str = "shuffle",
-                 holdout_frac: float = 0.0) -> None:
+    Subclasses override :meth:`_gather` when examples need per-batch
+    materialisation (image decode); the default is array indexing of
+    ``self.x`` / ``self.y``.
+    """
+
+    def __init__(self, x, y, seed: int, batch_size: int, *,
+                 sample: str = "shuffle", holdout_frac: float = 0.0,
+                 n_eval_tail: int = 0) -> None:
         super().__init__(seed, batch_size)
         if sample not in ("shuffle", "replacement"):
             raise ValueError(f"unknown sample mode {sample!r}")
         if not 0.0 <= holdout_frac < 1.0:
             raise ValueError(f"holdout_frac must be in [0, 1), got "
                              f"{holdout_frac}")
+        if len(x) != len(y):
+            raise ValueError(
+                f"x has {len(x)} rows but y has {len(y)}"
+            )
         self.sample = sample
-        data = np.load(path)
-        try:
-            self.x, self.y = data["x"], data["y"]
-        except KeyError as e:
-            raise ValueError(
-                f"{path} must contain arrays 'x' and 'y'"
-            ) from e
-        if len(self.x) != len(self.y):
-            raise ValueError(
-                f"x has {len(self.x)} rows but y has {len(self.y)}"
-            )
-        self.y = self.y.astype(np.int32)
-        self.spec = BatchSpec(tuple(self.x.shape[1:]),
-                              np.dtype(np.float32), (),
-                              np.dtype(np.int32),
-                              int(self.y.max()) + 1)
+        self.x = x
+        self.y = np.asarray(y).astype(np.int32)
         n = len(self.x)
-        n_eval = int(n * holdout_frac)
-        if holdout_frac and (n_eval == 0 or n_eval == n):
-            raise ValueError(
-                f"holdout_frac {holdout_frac} of {n} rows leaves an "
-                "empty train or eval split"
-            )
-        # the split is keyed on seed only (not step), so it is the same
-        # partition for every batch of the run
-        split = np.random.default_rng(
-            np.random.SeedSequence([self.seed, 0x401D])
-        ).permutation(n)
-        self._eval_rows = np.sort(split[:n_eval])
-        self._train_rows = np.sort(split[n_eval:])
+        if n_eval_tail:
+            if holdout_frac:
+                raise ValueError(
+                    "holdout_frac is redundant when a real test split "
+                    "exists (n_eval_tail > 0)"
+                )
+            if not 0 < n_eval_tail < n:
+                raise ValueError(
+                    f"n_eval_tail {n_eval_tail} out of range for {n} rows"
+                )
+            self._eval_rows = np.arange(n - n_eval_tail, n)
+            self._train_rows = np.arange(n - n_eval_tail)
+        else:
+            n_eval = int(n * holdout_frac)
+            if holdout_frac and (n_eval == 0 or n_eval == n):
+                raise ValueError(
+                    f"holdout_frac {holdout_frac} of {n} rows leaves an "
+                    "empty train or eval split"
+                )
+            # the split is keyed on seed only (not step), so it is the
+            # same partition for every batch of the run
+            split = np.random.default_rng(
+                np.random.SeedSequence([self.seed, 0x401D])
+            ).permutation(n)
+            self._eval_rows = np.sort(split[:n_eval])
+            self._train_rows = np.sort(split[n_eval:])
         self._perm_cache: dict[str, tuple[int, np.ndarray]] = {}
+
+    def _gather(self, idx: np.ndarray) -> tuple[np.ndarray, np.ndarray]:
+        # fancy indexing already copies; copy=False skips a second pass
+        # when x is stored as float32
+        return self.x[idx].astype(np.float32, copy=False), self.y[idx]
 
     def _perm(self, which: str, rows: np.ndarray,
               epoch: int) -> np.ndarray:
@@ -312,15 +341,58 @@ class ArrayFileDataset(SyntheticDataset):
                 pos += take
                 remaining -= take
             idx = np.concatenate(parts)
-        return self.x[idx].astype(np.float32), self.y[idx]
+        return self._gather(idx)
+
+
+class ArrayFileDataset(ArraySampler):
+    """Classification data from a ``.npz`` the user brings, with arrays
+    ``x`` (N, ...) and integer ``y`` (N,) — the torchvision-Dataset
+    analogue for migrants with exported arrays. Sampling/holdout
+    semantics: :class:`ArraySampler`."""
+
+    def __init__(self, path: str, seed: int, batch_size: int, *,
+                 sample: str = "shuffle",
+                 holdout_frac: float = 0.0) -> None:
+        data = np.load(path)
+        try:
+            x, y = data["x"], data["y"]
+        except KeyError as e:
+            raise ValueError(
+                f"{path} must contain arrays 'x' and 'y'"
+            ) from e
+        super().__init__(x, y, seed, batch_size, sample=sample,
+                         holdout_frac=holdout_frac)
+        self.spec = BatchSpec(tuple(self.x.shape[1:]),
+                              np.dtype(np.float32), (),
+                              np.dtype(np.int32),
+                              int(self.y.max()) + 1)
+
+
+_FILE_DATASETS = ("token_file", "array_file", "mnist_idx",
+                  "cifar10_bin", "image_folder")
 
 
 def get_dataset(name: str, *, seed: int, batch_size: int,
                 seq_len: int = 512, vocab_size: int = 32000,
                 path: str = "", token_dtype: str = "uint16",
-                sample: str = "shuffle", holdout_frac: float = 0.0):
-    if name in ("token_file", "array_file") and not path:
+                sample: str = "shuffle", holdout_frac: float = 0.0,
+                image_size: int = 224):
+    if name in _FILE_DATASETS and not path:
         raise ValueError(f"dataset {name!r} needs data.path")
+    if name in ("mnist_idx", "cifar10_bin", "image_folder"):
+        from pytorch_distributed_nn_tpu.data import readers
+
+        if name == "mnist_idx":
+            return readers.MnistIdxDataset(
+                path, seed, batch_size, sample=sample,
+                holdout_frac=holdout_frac)
+        if name == "cifar10_bin":
+            return readers.Cifar10BinDataset(
+                path, seed, batch_size, sample=sample,
+                holdout_frac=holdout_frac)
+        return readers.ImageFolderDataset(
+            path, seed, batch_size, sample=sample,
+            holdout_frac=holdout_frac, image_size=image_size)
     if name == "token_file":
         return TokenFileDataset(path, seed, batch_size, seq_len=seq_len,
                                 vocab_size=vocab_size,

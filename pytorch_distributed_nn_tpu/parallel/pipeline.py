@@ -203,7 +203,8 @@ def restore_unstacked_params(cfg, checkpoint_dir: str):
                          vocab_size=cfg.data.vocab_size,
                          path=cfg.data.path,
                          token_dtype=cfg.data.token_dtype,
-                         sample=cfg.data.sample)
+                         sample=cfg.data.sample,
+                         image_size=cfg.data.image_size)
         x0, _ = ds.batch(0)
         flat = model.init(jax.random.key(cfg.seed), jnp.asarray(x0),
                           train=False)["params"]

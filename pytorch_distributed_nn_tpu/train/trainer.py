@@ -75,6 +75,7 @@ class Trainer:
             token_dtype=cfg.data.token_dtype,
             sample=cfg.data.sample,
             holdout_frac=cfg.data.holdout_frac,
+            image_size=cfg.data.image_size,
         )
         self.loader = DataLoader(self.dataset, self.mesh,
                                  prefetch=cfg.data.prefetch)

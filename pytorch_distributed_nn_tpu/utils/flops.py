@@ -98,13 +98,14 @@ def _input_spec(cfg):
         return _IMAGE_SPECS[cfg.data.dataset], np.float32
     if cfg.data.dataset in _TOKEN_DATASETS:
         return (cfg.data.seq_len,), np.int32
-    # array_file and friends: the shape lives in the file
+    # array_file and friends: the shape lives in the file/config
     from pytorch_distributed_nn_tpu.data import get_dataset
 
     spec = get_dataset(
         cfg.data.dataset, seed=0, batch_size=1,
         seq_len=cfg.data.seq_len, vocab_size=cfg.data.vocab_size,
         path=cfg.data.path, token_dtype=cfg.data.token_dtype,
+        image_size=cfg.data.image_size,
     ).spec
     return spec.x_shape, spec.x_dtype
 

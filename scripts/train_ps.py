@@ -51,7 +51,8 @@ def main(argv: list[str]) -> int:
                           path=cfg.data.path,
                           token_dtype=cfg.data.token_dtype,
                           sample=cfg.data.sample,
-                          holdout_frac=cfg.data.holdout_frac)
+                          holdout_frac=cfg.data.holdout_frac,
+                          image_size=cfg.data.image_size)
     model = get_model(cfg.model)
     loss_fn = get_loss_fn(cfg.data.dataset)
     x0, _ = dataset.batch(0)

@@ -1,0 +1,245 @@
+"""On-disk dataset readers (MNIST idx / CIFAR-10 binary / image folder):
+fixtures are generated offline in the exact upstream formats, then real
+models train from them end to end (VERDICT.md round-1 Missing #3)."""
+
+import gzip
+import struct
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_nn_tpu.data.datasets import (
+    EVAL_STEP_OFFSET,
+    get_dataset,
+)
+
+
+# ---------------------------------------------------------------------
+# fixture writers — byte-exact upstream formats
+# ---------------------------------------------------------------------
+
+def write_idx(path, arr: np.ndarray, *, compress=False):
+    code = {np.dtype(np.uint8): 0x08, np.dtype(np.int32): 0x0C}[arr.dtype]
+    head = struct.pack(">HBB", 0, code, arr.ndim)
+    head += struct.pack(f">{arr.ndim}I", *arr.shape)
+    payload = head + arr.astype(arr.dtype.newbyteorder(">")).tobytes()
+    if compress:
+        path = str(path) + ".gz"
+        with gzip.open(path, "wb") as f:
+            f.write(payload)
+    else:
+        with open(path, "wb") as f:
+            f.write(payload)
+
+
+def mnist_dir(tmp_path, *, n_train=256, n_test=64, compress=False):
+    rng = np.random.default_rng(0)
+    y = (np.arange(n_train) % 10).astype(np.uint8)
+    x = (rng.integers(0, 256, (n_train, 28, 28))).astype(np.uint8)
+    # class-dependent stripe so tiny models genuinely learn
+    for i, yi in enumerate(y):
+        x[i, yi * 2:yi * 2 + 3, :] = 255
+    write_idx(tmp_path / "train-images-idx3-ubyte", x, compress=compress)
+    write_idx(tmp_path / "train-labels-idx1-ubyte", y, compress=compress)
+    ty = (np.arange(n_test) % 10).astype(np.uint8)
+    tx = (rng.integers(0, 256, (n_test, 28, 28))).astype(np.uint8)
+    for i, yi in enumerate(ty):
+        tx[i, yi * 2:yi * 2 + 3, :] = 255
+    write_idx(tmp_path / "t10k-images-idx3-ubyte", tx, compress=compress)
+    write_idx(tmp_path / "t10k-labels-idx1-ubyte", ty, compress=compress)
+    return tmp_path
+
+
+def cifar_dir(tmp_path, *, n_per_batch=64, n_batches=2, n_test=32):
+    rng = np.random.default_rng(1)
+
+    def records(n, seed_off):
+        y = (np.arange(n) % 10).astype(np.uint8)
+        x = rng.integers(0, 256, (n, 3, 32, 32)).astype(np.uint8)
+        for i, yi in enumerate(y):
+            x[i, :, yi:yi + 3, :] = 255  # learnable stripe (CHW)
+        return np.concatenate([y[:, None], x.reshape(n, -1)], 1)
+
+    for b in range(n_batches):
+        (tmp_path / f"data_batch_{b + 1}.bin").write_bytes(
+            records(n_per_batch, b).tobytes())
+    (tmp_path / "test_batch.bin").write_bytes(
+        records(n_test, 99).tobytes())
+    return tmp_path
+
+
+def image_folder(tmp_path, *, n_per_class=8, classes=("cat", "dog"),
+                 size=40):
+    from PIL import Image
+
+    rng = np.random.default_rng(2)
+    for ci, cname in enumerate(sorted(classes)):
+        d = tmp_path / cname
+        d.mkdir()
+        for i in range(n_per_class):
+            arr = rng.integers(0, 256, (size, size, 3)).astype(np.uint8)
+            arr[:, ci * 10:ci * 10 + 8] = 255  # class stripe
+            Image.fromarray(arr).save(d / f"img_{i:03d}.png")
+    return tmp_path
+
+
+# ---------------------------------------------------------------------
+# format round-trips + split semantics
+# ---------------------------------------------------------------------
+
+@pytest.mark.parametrize("compress", [False, True])
+def test_mnist_idx_reads_and_splits(tmp_path, compress):
+    mnist_dir(tmp_path, compress=compress)
+    ds = get_dataset("mnist_idx", seed=0, batch_size=16,
+                     path=str(tmp_path))
+    x, y = ds.batch(0)
+    assert x.shape == (16, 28, 28) and x.dtype == np.float32
+    assert 0.0 <= x.min() and x.max() <= 1.0
+    assert ds.spec.num_classes == 10
+    # the t10k pair is the eval stream: train rows never include it
+    assert len(ds._train_rows) == 256 and len(ds._eval_rows) == 64
+    xe, ye = ds.batch(EVAL_STEP_OFFSET)
+    assert xe.shape == (16, 28, 28)
+    # determinism across instances
+    ds2 = get_dataset("mnist_idx", seed=0, batch_size=16,
+                      path=str(tmp_path))
+    np.testing.assert_array_equal(x, ds2.batch(0)[0])
+
+
+def test_cifar10_bin_reads_and_splits(tmp_path):
+    cifar_dir(tmp_path)
+    ds = get_dataset("cifar10_bin", seed=0, batch_size=8,
+                     path=str(tmp_path))
+    x, y = ds.batch(3)
+    assert x.shape == (8, 32, 32, 3) and x.dtype == np.float32
+    assert len(ds._train_rows) == 128 and len(ds._eval_rows) == 32
+    # CHW -> HWC by pixel VALUE: the fixture writes a saturated stripe
+    # at CHW rows [y, y+3) across all channels; a correct transpose
+    # shows it as HWC rows [y, y+3) == 1.0 everywhere
+    for xi, yi in zip(x, y):
+        stripe = xi[yi:yi + 3, :, :]
+        np.testing.assert_array_equal(stripe, np.ones_like(stripe))
+
+
+def test_image_folder_reads_lazily(tmp_path):
+    image_folder(tmp_path)
+    ds = get_dataset("image_folder", seed=0, batch_size=4,
+                     path=str(tmp_path), image_size=32)
+    assert ds.classes == ["cat", "dog"]
+    x, y = ds.batch(0)
+    assert x.shape == (4, 32, 32, 3) and x.dtype == np.float32
+    assert set(np.unique(ds.y)) == {0, 1}
+    # epoch-shuffle coverage: one epoch (16 imgs / batch 4) visits every
+    # file exactly once
+    seen = []
+    for s in range(4):
+        idx_batch = ds.batch(s)
+        seen.extend(idx_batch[1].tolist())
+    assert len(ds.x) == 16 and len(seen) == 16
+    assert sorted(np.bincount(seen)) == [8, 8]  # 8 of each class
+
+
+def test_image_folder_train_val_split(tmp_path):
+    (tmp_path / "train").mkdir()
+    (tmp_path / "val").mkdir()
+    image_folder(tmp_path / "train", n_per_class=8)
+    image_folder(tmp_path / "val", n_per_class=2)
+    ds = get_dataset("image_folder", seed=0, batch_size=4,
+                     path=str(tmp_path), image_size=32)
+    assert len(ds._train_rows) == 16 and len(ds._eval_rows) == 4
+
+
+def test_bad_files_fail_loudly(tmp_path):
+    (tmp_path / "train-images-idx3-ubyte").write_bytes(b"junkjunk")
+    with pytest.raises(ValueError, match="idx"):
+        get_dataset("mnist_idx", seed=0, batch_size=4,
+                    path=str(tmp_path))
+    with pytest.raises(ValueError, match="data_batch"):
+        get_dataset("cifar10_bin", seed=0, batch_size=4,
+                    path=str(tmp_path))
+
+
+def test_read_idx_multibyte_big_endian(tmp_path):
+    # idx stores int32 big-endian; a wrong decode returns byte-swapped
+    # values (1 -> 16777216)
+    from pytorch_distributed_nn_tpu.data.readers import read_idx
+
+    arr = np.array([1, 2, 3], np.int32)
+    write_idx(tmp_path / "vals-idx1-int", arr)
+    got = read_idx(tmp_path / "vals-idx1-int")
+    np.testing.assert_array_equal(got, arr)
+    assert got.dtype == np.int32
+
+
+# ---------------------------------------------------------------------
+# end-to-end: real models train from the real on-disk formats
+# ---------------------------------------------------------------------
+
+def _train(cfg_overrides, tmp_dir):
+    from pytorch_distributed_nn_tpu.config import get_config
+    from pytorch_distributed_nn_tpu.train.trainer import Trainer
+
+    cfg = get_config("mlp_mnist", **{"log_every": "1",
+                                     "data.prefetch": "0"})
+    for k, v in cfg_overrides.items():
+        parts = k.split(".")
+        obj = cfg
+        for p in parts[:-1]:
+            obj = getattr(obj, p)
+        setattr(obj, parts[-1], v)
+    cfg.data.path = str(tmp_dir)
+    trainer = Trainer(cfg)
+    trainer.train()
+    return trainer
+
+
+def test_mlp_trains_from_mnist_idx(tmp_path):
+    mnist_dir(tmp_path)
+    t = _train({"data.dataset": "mnist_idx", "data.batch_size": 32,
+                "steps": 30, "optim.lr": 0.1}, tmp_path)
+    losses = t.losses()
+    assert losses[-1] < losses[0] * 0.8  # genuinely learns the stripes
+    rec = t.evaluate(num_batches=2)  # from the real t10k split
+    assert np.isfinite(rec.loss)
+
+
+def test_lenet_trains_from_cifar10_bin(tmp_path):
+    cifar_dir(tmp_path)
+    t = _train({"data.dataset": "cifar10_bin", "model.name": "lenet",
+                "data.batch_size": 32, "steps": 20,
+                "optim.lr": 0.05}, tmp_path)
+    losses = t.losses()
+    assert losses[-1] < losses[0]
+
+
+def test_resnet_trains_from_image_folder(tmp_path):
+    image_folder(tmp_path, n_per_class=8, size=40)
+    t = _train({"data.dataset": "image_folder", "model.name": "resnet50",
+                "data.batch_size": 8, "data.image_size": 32,
+                "steps": 2, "model.compute_dtype": "float32"}, tmp_path)
+    assert np.isfinite(t.losses()).all()
+
+
+def test_bench_loader_metric(tmp_path):
+    """bench.py --metric loader: one JSON line with samples/s through
+    the prefetch pipeline, on the real image_folder reader."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    image_folder(tmp_path, n_per_class=8, size=40)
+    env = dict(os.environ, JAX_PLATFORMS="cpu", JAX_NUM_CPU_DEVICES="1")
+    r = subprocess.run(
+        [sys.executable, "bench.py", "--metric", "loader", "--preset",
+         "resnet50_dp", "--loader-dataset", "image_folder",
+         "--data-path", str(tmp_path), "--per-chip-batch", "8",
+         "--steps", "3", "--warmup", "1"],
+        env=env, cwd="/root/repo", capture_output=True, text=True,
+        timeout=300,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["metric"] == "input-pipeline samples/sec (resnet50_dp)"
+    assert rec["value"] > 0
+    assert "image_folder" in rec["detail"]
