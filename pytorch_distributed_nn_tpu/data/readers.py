@@ -95,6 +95,13 @@ class MnistIdxDataset(_Uint8Pixels):
         y = read_idx(lbls)
         t_imgs = _find_one(root, "t10k-images-idx3-ubyte")
         t_lbls = _find_one(root, "t10k-labels-idx1-ubyte")
+        if (t_imgs is None) != (t_lbls is None):
+            # a half-present test pair would silently degrade eval to
+            # the in-sample stream — as loud as a missing train pair
+            raise ValueError(
+                f"{root}: t10k pair incomplete (found "
+                f"{'images' if t_imgs else 'labels'} without its mate)"
+            )
         n_eval = 0
         if t_imgs is not None and t_lbls is not None:
             x = np.concatenate([x, read_idx(t_imgs)])

@@ -247,6 +247,36 @@ def _stage_apply(part: StagePartition, stage_params, x, *,
 _DATA_SPEC = batch_pspec()  # P(('data','fsdp')) — mesh.py owns this
 _X_MB_SPEC = P(None, *_DATA_SPEC)  # (M, mb, ...)
 _STAGE_SPEC = P(AXIS_PIPE)
+# With TP on, the pipeline's shard_maps are MANUAL over these axes
+# only; the `tensor` axis stays AUTO so the SPMD partitioner runs
+# Megatron TP inside each stage (per the stage params' sharding —
+# _stage_sharding) with no hand-written collectives in the tick body.
+_MANUAL_AXES = frozenset({AXIS_PIPE, "data", "fsdp"})
+
+
+def _pipeline_axis_names(mesh: Mesh) -> frozenset:
+    """Manual axes for the pipeline shard_maps. Fully manual unless
+    tensor > 1: partial-manual lowering is only needed for TP, and
+    XLA's CPU AllReducePromotion pass crashes ('Invalid binary
+    instruction opcode copy') cloning bf16 all-reduces out of
+    partial-manual computations — keep the standard path unperturbed."""
+    if mesh.shape.get("tensor", 1) > 1:
+        return _MANUAL_AXES & set(mesh.axis_names)
+    return frozenset(mesh.axis_names)
+
+
+def _stage_sharding(mesh: Mesh, path: str, shape) -> NamedSharding:
+    """Sharding for one STACKED stage leaf (S, K, *param_shape): stages
+    over ``pipe``, and the within-stage dims TP-sharded by the same
+    name-driven Megatron rules every other strategy uses
+    (sharding_rules.spec_for, dims shifted by the 2 stacking dims)."""
+    from pytorch_distributed_nn_tpu.parallel.sharding_rules import (
+        spec_for,
+    )
+
+    tensor = mesh.shape.get("tensor", 1)
+    inner = spec_for(path, tuple(shape[2:]), tensor=tensor)
+    return NamedSharding(mesh, P(AXIS_PIPE, None, *inner))
 
 
 def _pipelined_forward(part: StagePartition, mesh: Mesh, S: int, M: int,
@@ -287,11 +317,17 @@ def _pipelined_forward(part: StagePartition, mesh: Mesh, S: int, M: int,
             tick, (buf, outputs), jnp.arange(M + S - 1)
         )
         # everyone needs the last stage's outputs for the (replicated)
-        # head: broadcast by masked psum over pipe
+        # head: broadcast by masked psum over pipe. Under partial-manual
+        # lowering (TP on) the psum rides in f32: bf16 all-reduce
+        # promotion crashes XLA CPU there (see _pipeline_axis_names);
+        # the fully-manual path keeps the native-dtype wire.
+        wire = (jnp.float32 if mesh.shape.get("tensor", 1) > 1
+                else x_mb.dtype)
         outputs = lax.psum(
-            jnp.where(idx == S - 1, outputs, jnp.zeros_like(outputs)),
+            jnp.where(idx == S - 1, outputs.astype(wire),
+                      jnp.zeros(outputs.shape, wire)),
             AXIS_PIPE,
-        )
+        ).astype(x_mb.dtype)
         return outputs
 
     return jax.shard_map(
@@ -299,6 +335,7 @@ def _pipelined_forward(part: StagePartition, mesh: Mesh, S: int, M: int,
         mesh=mesh,
         in_specs=(_STAGE_SPEC, _X_MB_SPEC),
         out_specs=_X_MB_SPEC,
+        axis_names=_pipeline_axis_names(mesh),
         check_vma=False,
     )
 
@@ -307,22 +344,27 @@ def _state_placement(mesh: Mesh, part: StagePartition, S: int, step):
     """(step_dispatch, place_state) for a pipeline step function:
     stacks the flat params, shards stages over ``pipe``, replicates the
     rest, jits with donation."""
+    from pytorch_distributed_nn_tpu.parallel.sharding_rules import (
+        path_str,
+    )
+
     replicated = NamedSharding(mesh, P())
     batch_sh = NamedSharding(mesh, _DATA_SPEC)
 
     def _opt_shardings(opt_state):
-        # optimizer moments mirror param shapes: shard any leaf whose
-        # leading dims match the stacked (S, K, ...) pattern
-        def spec_of(x):
+        # optimizer moments mirror param shapes AND paths (optax trees
+        # embed the param path), so stacked (S, K, ...) leaves get the
+        # same pipe x TP layout as their params
+        def spec_of(kp, x):
             if hasattr(x, "ndim") and x.ndim >= 2 and x.shape[0] == S:
-                return NamedSharding(mesh, _STAGE_SPEC)
+                return _stage_sharding(mesh, path_str(kp), x.shape)
             return replicated
 
-        return jax.tree.map(spec_of, opt_state)
+        return jax.tree_util.tree_map_with_path(spec_of, opt_state)
 
     def shardings_of(state):
-        stage_sh = jax.tree.map(
-            lambda _: NamedSharding(mesh, _STAGE_SPEC),
+        stage_sh = jax.tree_util.tree_map_with_path(
+            lambda kp, x: _stage_sharding(mesh, path_str(kp), x.shape),
             state.params["stages"],
         )
         param_sh = {"stages": stage_sh,
@@ -605,6 +647,7 @@ def _make_1f1b_step(cfg: TrainConfig, mesh: Mesh, loss_fn: Callable,
         mesh=mesh,
         in_specs=(_STAGE_SPEC, P(), _X_MB_SPEC, _X_MB_SPEC, P()),
         out_specs=(_STAGE_SPEC, P(), P()),
+        axis_names=_pipeline_axis_names(mesh),
         check_vma=False,
     )
 

@@ -98,6 +98,15 @@ def _input_spec(cfg):
         return _IMAGE_SPECS[cfg.data.dataset], np.float32
     if cfg.data.dataset in _TOKEN_DATASETS:
         return (cfg.data.seq_len,), np.int32
+    # file readers with format-fixed (or config-derived) shapes — never
+    # rescan an ImageNet-sized tree or reload a corpus just for .spec
+    if cfg.data.dataset == "cifar10_bin":
+        return (32, 32, 3), np.float32
+    if cfg.data.dataset == "mnist_idx":
+        return (28, 28), np.float32  # the idx standard layout
+    if cfg.data.dataset == "image_folder":
+        s = cfg.data.image_size
+        return (s, s, 3), np.float32
     # array_file and friends: the shape lives in the file/config
     from pytorch_distributed_nn_tpu.data import get_dataset
 

@@ -1,7 +1,28 @@
 """Golden-equivalence: sync DP is mathematically identical to single-device
 training on the same global batch — the strongest oracle this domain has
 (SURVEY.md §4). Runs config 1 (MLP/MNIST) three ways: single device,
-compiler-sharded DP on 8 devices, explicit shard_map DP on 8 devices."""
+compiler-sharded DP on 8 devices, explicit shard_map DP on 8 devices.
+
+How exact can "exact" be? Measured and pinned here:
+
+- The FIRST loss (forward + xent on identical params/batch) is
+  BIT-EXACT across all strategies — asserted with array_equal. This
+  isolates any divergence to the gradient reduction.
+- From step 1 on, runs differ by a few float32 ULPs per step. The
+  irreducible source: the single-device gradient is one fused
+  batch-contraction (e.g. dW = x^T dlogits over all B rows, reduction
+  order chosen by XLA inside one matmul), while sharded DP computes 8
+  per-shard contractions and combines them through psum's reduction
+  tree. Floating-point addition is not associative; XLA owns both
+  orders and exposes no API to pin them to each other (deterministic
+  ≠ identical-order: each run IS reproducible bit-for-bit with
+  itself). One update later the parameters differ in their last bit
+  and the gap compounds slowly.
+
+So the contract asserted here is: step 0 bitwise, then an ULP-COUNTED
+bound (not an rtol blanket): <= 8 ULPs per elapsed step, ~100x tighter
+than the round-1 rtol=2e-5 check at these loss magnitudes.
+"""
 
 import jax
 import numpy as np
@@ -25,7 +46,27 @@ def losses_for(strategy: str, mesh_spec: MeshSpec, devices=None):
         len(devices or jax.devices())), devices=devices)
     trainer = Trainer(cfg, mesh=mesh)
     trainer.train()
-    return np.array(trainer.losses())
+    return np.array(trainer.losses(), np.float32)
+
+
+def ulp_distance(a: np.ndarray, b: np.ndarray) -> np.ndarray:
+    """Distance in representable float32 steps (same-sign finite
+    inputs): adjacent floats are 1 apart, equality is 0."""
+    ai = np.asarray(a, np.float32).view(np.int32).astype(np.int64)
+    bi = np.asarray(b, np.float32).view(np.int32).astype(np.int64)
+    return np.abs(ai - bi)
+
+
+def assert_golden(dist_losses, single_losses, *, max_ulp_per_step=8):
+    np.testing.assert_array_equal(
+        dist_losses[0], single_losses[0],
+        err_msg="step-0 loss must be BIT-exact (identical forward)",
+    )
+    ulps = ulp_distance(dist_losses, single_losses)
+    budget = max_ulp_per_step * np.arange(1, len(ulps) + 1)
+    assert (ulps <= budget).all(), (
+        f"loss ULP distance {ulps} exceeds per-step budget {budget}"
+    )
 
 
 @pytest.fixture(scope="module")
@@ -40,19 +81,25 @@ def test_loss_decreases(single_device_losses):
 
 
 def test_dp8_matches_single(single_device_losses):
-    dp = losses_for("dp", MeshSpec(data=8))
-    np.testing.assert_allclose(dp, single_device_losses, rtol=2e-5,
-                               atol=1e-5)
+    assert_golden(losses_for("dp", MeshSpec(data=8)),
+                  single_device_losses)
 
 
 def test_dp_explicit_matches_single(single_device_losses):
-    dp = losses_for("dp_explicit", MeshSpec(data=8))
-    np.testing.assert_allclose(dp, single_device_losses, rtol=2e-5,
-                               atol=1e-5)
+    assert_golden(losses_for("dp_explicit", MeshSpec(data=8)),
+                  single_device_losses)
 
 
 def test_dp_mixed_axes_matches_single(single_device_losses):
     # batch split over data×fsdp jointly (4×2): same math
-    dp = losses_for("dp", MeshSpec(data=4, fsdp=2))
-    np.testing.assert_allclose(dp, single_device_losses, rtol=2e-5,
-                               atol=1e-5)
+    assert_golden(losses_for("dp", MeshSpec(data=4, fsdp=2)),
+                  single_device_losses)
+
+
+def test_dp_runs_are_self_deterministic():
+    # "deterministic but not identical-order": the same sharded run
+    # twice IS bit-for-bit reproducible — the ULP gap above is purely
+    # the cross-strategy reduction-order difference
+    a = losses_for("dp", MeshSpec(data=8))
+    b = losses_for("dp", MeshSpec(data=8))
+    np.testing.assert_array_equal(a, b)

@@ -19,7 +19,7 @@ TINY_LLAMA = dict(num_layers=4, d_model=32, num_heads=4, num_kv_heads=2,
 
 def _train(strategy, mesh_spec, *, model="transformer_lm", extra=TINY_TLM,
            microbatches=4, devices=None, schedule="gpipe", steps=STEPS,
-           return_trainer=False):
+           return_trainer=False, do_train=True):
     cfg = get_config(
         "transformer_lm_pp",
         **{"steps": str(steps), "log_every": "1", "data.prefetch": "0"},
@@ -38,7 +38,8 @@ def _train(strategy, mesh_spec, *, model="transformer_lm", extra=TINY_TLM,
     mesh = make_mesh(cfg.mesh.resolve(len(devices or jax.devices())),
                      devices=devices)
     trainer = Trainer(cfg, mesh=mesh)
-    trainer.train()
+    if do_train:
+        trainer.train()
     if return_trainer:
         return trainer
     return np.array(trainer.losses())
@@ -171,3 +172,29 @@ def test_pipeline_eval_matches_dp_eval():
     rec_dp = dp.evaluate(num_batches=2)
     np.testing.assert_allclose(rec.loss, rec_dp.loss, rtol=2e-5)
     np.testing.assert_allclose(rec.accuracy, rec_dp.accuracy, rtol=2e-5)
+
+
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_pipeline_x_tensor_parallel(single_losses, schedule):
+    """pipe=2 x tensor=2 x data=2: stage params TP-sharded INSIDE
+    stages (the `tensor` axis stays auto in the pipeline shard_map, so
+    the SPMD partitioner runs Megatron TP within each stage). Golden
+    vs single device, and the placed state must really carry `tensor`
+    in its stage-param shardings."""
+    trainer = _train("pipeline", MeshSpec(pipe=2, tensor=2, data=2),
+                     schedule=schedule, return_trainer=True,
+                     do_train=False)
+
+    specs = {
+        "/".join(str(getattr(k, "key", k)) for k in kp):
+            leaf.sharding.spec
+        for kp, leaf in jax.tree_util.tree_flatten_with_path(
+            trainer.state.params["stages"])[0]
+    }
+    tp_sharded = [p for p, s in specs.items() if "tensor" in str(s)]
+    assert any("query/kernel" in p for p in tp_sharded), specs
+    assert any("mlp_in/kernel" in p for p in tp_sharded), specs
+
+    trainer.train()
+    np.testing.assert_allclose(np.array(trainer.losses()), single_losses,
+                               rtol=2e-5, atol=1e-5)

@@ -243,3 +243,11 @@ def test_bench_loader_metric(tmp_path):
     assert rec["metric"] == "input-pipeline samples/sec (resnet50_dp)"
     assert rec["value"] > 0
     assert "image_folder" in rec["detail"]
+
+
+def test_mnist_half_present_t10k_pair_rejected(tmp_path):
+    mnist_dir(tmp_path, n_train=32, n_test=16)
+    (tmp_path / "t10k-labels-idx1-ubyte").unlink()
+    with pytest.raises(ValueError, match="t10k pair incomplete"):
+        get_dataset("mnist_idx", seed=0, batch_size=4,
+                    path=str(tmp_path))
