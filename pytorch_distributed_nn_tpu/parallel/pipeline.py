@@ -48,12 +48,32 @@ from pytorch_distributed_nn_tpu.train.state import TrainState
 
 @dataclasses.dataclass
 class StagePartition:
-    """How to split one model family into (embed | blocks | head)."""
+    """How to split one model family into (embed | blocks | head).
+
+    ``block`` returns ``(y, aux)``: aux is the scalar sum of the
+    block's sown "losses" collection (MoE load-balance terms; exactly
+    0.0 for dense blocks), which the schedules thread into the training
+    objective."""
 
     block_names: list[str]  # ordered param-tree keys of the block stack
     embed: Callable  # (params, tokens) -> activations
-    block: Callable  # (one_block_params, x, *, train, rng) -> x
+    block: Callable  # (one_block_params, x, *, train, rng) -> (x, aux)
     head: Callable  # (params, x) -> logits
+
+
+def _aux_block(block_mod):
+    def block(p, x, *, train=True, rng=None):
+        rngs = None if rng is None else {"dropout": rng}
+        y, updates = block_mod.apply({"params": p}, x, train=train,
+                                     rngs=rngs, mutable=["losses"])
+        aux = sum(
+            (leaf.astype(jnp.float32).sum()
+             for leaf in jax.tree.leaves(updates.get("losses", {}))),
+            jnp.zeros((), jnp.float32),
+        )
+        return y, aux
+
+    return block
 
 
 def partition_for(model) -> StagePartition:
@@ -67,16 +87,19 @@ def partition_for(model) -> StagePartition:
 
     from pytorch_distributed_nn_tpu.models.moe_lm import MoETransformerLM
 
-    if isinstance(model, MoETransformerLM):
-        # MoE blocks carry an expert-parallel FFN the dense DecoderBlock
-        # rebuild below can't represent; reject clearly rather than fail
-        # deep inside Flax param matching.
+    if isinstance(model, MoETransformerLM) and model.moe_every != 1:
+        # alternating dense/MoE layers have heterogeneous param trees,
+        # which the homogeneous (S, K, ...) stage stacking cannot hold
         raise ValueError(
-            "pipeline strategy does not support MoE models yet; use the "
-            "expert-parallel mesh (strategy='dp' + expert axis) instead"
+            "pipeline parallelism needs uniform blocks: MoE models "
+            "require moe_every=1 (every layer MoE); use the "
+            "expert-parallel mesh (strategy='dp'/'zero' + expert axis) "
+            "for mixed stacks"
         )
     if isinstance(model, TransformerLM):
-        block_mod = DecoderBlock(**model.block_kwargs())
+        ffn = (model.layer_ffn(0)
+               if isinstance(model, MoETransformerLM) else None)
+        block_mod = DecoderBlock(**model.block_kwargs(), ffn=ffn)
         tok = nn.Embed(model.vocab_size, model.d_model,
                        param_dtype=model.param_dtype)
         pos = nn.Embed(model.max_len, model.d_model,
@@ -94,17 +117,12 @@ def partition_for(model) -> StagePartition:
                               jnp.arange(T)[None])
             return x.astype(model.dtype)
 
-        def block(p, x, *, train=True, rng=None):
-            rngs = None if rng is None else {"dropout": rng}
-            return block_mod.apply({"params": p}, x, train=train,
-                                   rngs=rngs)
-
         def head(params, x):
             x = ln_f.apply({"params": params["ln_f"]}, x)
             return lm_head.apply({"params": params["lm_head"]}, x)
 
         names = [f"block{i}" for i in range(model.num_layers)]
-        return StagePartition(names, embed, block, head)
+        return StagePartition(names, embed, _aux_block(block_mod), head)
 
     if isinstance(model, Llama):
         block_mod = LlamaBlock(
@@ -124,17 +142,12 @@ def partition_for(model) -> StagePartition:
             x = tok.apply({"params": params["tok_embed"]}, tokens)
             return x.astype(model.dtype)
 
-        def block(p, x, *, train=True, rng=None):
-            rngs = None if rng is None else {"dropout": rng}
-            return block_mod.apply({"params": p}, x, train=train,
-                                   rngs=rngs)
-
         def head(params, x):
             x = norm.apply({"params": params["final_norm"]}, x)
             return lm_head.apply({"params": params["lm_head"]}, x)
 
         names = [f"layer{i}" for i in range(model.num_layers)]
-        return StagePartition(names, embed, block, head)
+        return StagePartition(names, embed, _aux_block(block_mod), head)
 
     raise ValueError(
         f"pipeline parallelism supports TransformerLM/Llama, got "
@@ -224,24 +237,34 @@ def restore_unstacked_params(cfg, checkpoint_dir: str):
 def _stage_apply(part: StagePartition, stage_params, x, *,
                  train: bool = True, rng=None):
     """Run this device's K blocks sequentially (scan over the stacked
-    leading dim). ``rng`` (dropout): folded per layer so every block
+    leading dim); returns (y, aux) with aux the summed sown losses of
+    the K blocks. ``rng`` (dropout): folded per layer so every block
     draws a distinct mask — callers fold in microbatch and stage first,
     making the stream deterministic for backward recompute."""
     K = jax.tree.leaves(stage_params)[0].shape[0]
 
     if rng is None:
-        def body(h, p):
-            return part.block(p, h, train=train), None
+        def body(carry, p):
+            h, aux = carry
+            h, a = part.block(p, h, train=train)
+            return (h, aux + a), None
 
-        out, _ = lax.scan(body, x, stage_params)
+        (out, aux), _ = lax.scan(
+            body, (x, jnp.zeros((), jnp.float32)), stage_params
+        )
     else:
-        def body(h, xs):
+        def body(carry, xs):
+            h, aux = carry
             p, i = xs
-            return part.block(p, h, train=train,
-                              rng=jax.random.fold_in(rng, i)), None
+            h, a = part.block(p, h, train=train,
+                              rng=jax.random.fold_in(rng, i))
+            return (h, aux + a), None
 
-        out, _ = lax.scan(body, x, (stage_params, jnp.arange(K)))
-    return out
+        (out, aux), _ = lax.scan(
+            body, (x, jnp.zeros((), jnp.float32)),
+            (stage_params, jnp.arange(K)),
+        )
+    return out, aux
 
 
 _DATA_SPEC = batch_pspec()  # P(('data','fsdp')) — mesh.py owns this
@@ -254,38 +277,49 @@ _STAGE_SPEC = P(AXIS_PIPE)
 _MANUAL_AXES = frozenset({AXIS_PIPE, "data", "fsdp"})
 
 
+def _is_partial_manual(mesh: Mesh) -> bool:
+    """True when the pipeline shard_maps leave axes to the compiler
+    (TP/EP inside stages). Keep every consumer of this predicate in
+    lockstep: the wire dtype in _pipelined_forward depends on it too
+    (bf16 all-reduces crash XLA CPU's AllReducePromotion pass under
+    partial-manual lowering — 'Invalid binary instruction opcode
+    copy')."""
+    return (mesh.shape.get("tensor", 1) > 1
+            or mesh.shape.get("expert", 1) > 1)
+
+
 def _pipeline_axis_names(mesh: Mesh) -> frozenset:
-    """Manual axes for the pipeline shard_maps. Fully manual unless
-    tensor > 1: partial-manual lowering is only needed for TP, and
-    XLA's CPU AllReducePromotion pass crashes ('Invalid binary
-    instruction opcode copy') cloning bf16 all-reduces out of
-    partial-manual computations — keep the standard path unperturbed."""
-    if mesh.shape.get("tensor", 1) > 1:
+    """Manual axes for the pipeline shard_maps: fully manual unless
+    TP/EP is on (see _is_partial_manual) — keep the standard path
+    unperturbed."""
+    if _is_partial_manual(mesh):
         return _MANUAL_AXES & set(mesh.axis_names)
     return frozenset(mesh.axis_names)
 
 
 def _stage_sharding(mesh: Mesh, path: str, shape) -> NamedSharding:
     """Sharding for one STACKED stage leaf (S, K, *param_shape): stages
-    over ``pipe``, and the within-stage dims TP-sharded by the same
-    name-driven Megatron rules every other strategy uses
+    over ``pipe``, and the within-stage dims TP/EP-sharded by the same
+    name-driven rules every other strategy uses
     (sharding_rules.spec_for, dims shifted by the 2 stacking dims)."""
     from pytorch_distributed_nn_tpu.parallel.sharding_rules import (
         spec_for,
     )
 
-    tensor = mesh.shape.get("tensor", 1)
-    inner = spec_for(path, tuple(shape[2:]), tensor=tensor)
+    inner = spec_for(path, tuple(shape[2:]),
+                     tensor=mesh.shape.get("tensor", 1),
+                     expert=mesh.shape.get("expert", 1))
     return NamedSharding(mesh, P(AXIS_PIPE, None, *inner))
 
 
 def _pipelined_forward(part: StagePartition, mesh: Mesh, S: int, M: int,
                        *, train: bool):
     """The GPipe fill-drain FORWARD as a shard_map over ``pipe``:
-    (stage_params, x_mb (M, mb, T, D)) -> last-stage outputs, broadcast
-    to every stage for the replicated head. Differentiable (the AD
-    transpose is the reverse fill-drain) and reused verbatim by the
-    forward-only pipeline eval path (train=False)."""
+    (stage_params, x_mb (M, mb, T, D)) -> (last-stage outputs broadcast
+    to every stage for the replicated head, mean per-microbatch aux
+    loss). Differentiable (the AD transpose is the reverse fill-drain)
+    and reused verbatim by the forward-only pipeline eval path
+    (train=False, aux ignored)."""
     fwd_edges = [(i, i + 1) for i in range(S - 1)]  # no wraparound
 
     def pipelined_blocks(stage_params, x_mb):
@@ -294,13 +328,19 @@ def _pipelined_forward(part: StagePartition, mesh: Mesh, S: int, M: int,
         mb_shape = x_mb.shape[1:]
         buf = jnp.zeros(mb_shape, x_mb.dtype)
         outputs = jnp.zeros_like(x_mb)
+        aux0 = jnp.zeros((), jnp.float32)
 
         def tick(carry, t):
-            buf, outputs = carry
+            buf, outputs, aux_sum = carry
             feed = x_mb[jnp.clip(t, 0, M - 1)]
             x_in = jnp.where(idx == 0, feed, buf)
-            y = _stage_apply(part, stage_params, x_in, train=train)
+            y, aux = _stage_apply(part, stage_params, x_in, train=train)
             sent = lax.ppermute(y, AXIS_PIPE, fwd_edges)
+            # fill/drain ticks compute garbage — their aux terms must
+            # not reach the objective (stage s is live for t in
+            # [s, s + M))
+            live = jnp.logical_and(t >= idx, t < idx + M)
+            aux_sum = aux_sum + jnp.where(live, aux, 0.0)
             out_t = t - (S - 1)
             write = jnp.logical_and(idx == S - 1, out_t >= 0)
             outputs = lax.cond(
@@ -311,30 +351,34 @@ def _pipelined_forward(part: StagePartition, mesh: Mesh, S: int, M: int,
                 lambda o: o,
                 outputs,
             )
-            return (sent, outputs), None
+            return (sent, outputs, aux_sum), None
 
-        (_, outputs), _ = lax.scan(
-            tick, (buf, outputs), jnp.arange(M + S - 1)
+        (_, outputs, aux_sum), _ = lax.scan(
+            tick, (buf, outputs, aux0), jnp.arange(M + S - 1)
         )
         # everyone needs the last stage's outputs for the (replicated)
         # head: broadcast by masked psum over pipe. Under partial-manual
-        # lowering (TP on) the psum rides in f32: bf16 all-reduce
-        # promotion crashes XLA CPU there (see _pipeline_axis_names);
-        # the fully-manual path keeps the native-dtype wire.
-        wire = (jnp.float32 if mesh.shape.get("tensor", 1) > 1
-                else x_mb.dtype)
+        # lowering the psum rides in f32 (see _is_partial_manual); the
+        # fully-manual path keeps the native-dtype wire.
+        wire = jnp.float32 if _is_partial_manual(mesh) else x_mb.dtype
         outputs = lax.psum(
             jnp.where(idx == S - 1, outputs.astype(wire),
                       jnp.zeros(outputs.shape, wire)),
             AXIS_PIPE,
         ).astype(x_mb.dtype)
-        return outputs
+        # aux: sum over this device's M live ticks and all stages, then
+        # batch-mean across the data shards; /M makes it the mean of
+        # per-microbatch sums — identical semantics to the dense path's
+        # full-batch forward (routing groups never span microbatches)
+        aux = lax.pmean(lax.psum(aux_sum, AXIS_PIPE),
+                        ("data", "fsdp")) / M
+        return outputs, aux
 
     return jax.shard_map(
         pipelined_blocks,
         mesh=mesh,
         in_specs=(_STAGE_SPEC, _X_MB_SPEC),
-        out_specs=_X_MB_SPEC,
+        out_specs=(_X_MB_SPEC, P()),
         axis_names=_pipeline_axis_names(mesh),
         check_vma=False,
     )
@@ -438,10 +482,10 @@ def make_pipeline_train_step(cfg: TrainConfig, mesh: Mesh,
         def compute(params):
             h = part.embed(params["rest"], tokens)  # (B, T, D)
             h_mb = h.reshape((M, B // M) + h.shape[1:])
-            h_mb = sharded_pipeline(params["stages"], h_mb)
+            h_mb, aux = sharded_pipeline(params["stages"], h_mb)
             h = h_mb.reshape((B,) + h_mb.shape[2:])
             logits = part.head(params["rest"], h)
-            return loss_fn(logits, targets)
+            return loss_fn(logits, targets) + aux
 
         loss, grads = jax.value_and_grad(compute)(state.params)
         new_state = state.apply_gradients(grads)
@@ -541,11 +585,14 @@ def _make_1f1b_step(cfg: TrainConfig, mesh: Mesh, loss_fn: Callable,
                     act, x_in, slot, 0
                 )
                 # the last stage's forward output feeds nobody (its
-                # backward re-linearizes from the saved input): skip
+                # backward re-linearizes from the saved input): skip.
+                # aux is discarded here — every (mb, stage) pair gets
+                # exactly one backward, which recomputes and counts it.
                 y = lax.cond(
                     idx == S - 1,
                     lambda: jnp.zeros(mb_shape, act_dtype),
-                    lambda: stage_fwd(sp, x_in, f_idx).astype(act_dtype),
+                    lambda: stage_fwd(sp, x_in, f_idx)[0]
+                    .astype(act_dtype),
                 )
                 return act_new, y
 
@@ -557,35 +604,40 @@ def _make_1f1b_step(cfg: TrainConfig, mesh: Mesh, loss_fn: Callable,
             # ---- backward unit (three flavors; dead ticks skip both
             # the vjp and the dense grad-tree accumulate) -------------
             def bwd_unit(_):
+                # each flavor's objective includes the stage's own aux
+                # terms (sown MoE losses, /M like the data loss), so
+                # their gradients flow through the same vjp and the
+                # summed lv values reproduce the dense path's objective
                 def bwd_first(_):
                     def f(sp_, rp_):
                         x0 = part.embed(rp_, tok_mb[b_idx]) \
                             .astype(act_dtype)
-                        return stage_fwd(sp_, x0, b_idx).astype(act_dtype)
+                        y, aux = stage_fwd(sp_, x0, b_idx)
+                        return y.astype(act_dtype), aux / M
 
-                    _, vjp = jax.vjp(f, sp, rest_params)
-                    dsp, drp = vjp(recv_b)
-                    return (jnp.zeros((), jnp.float32), dsp, drp,
+                    (_, auxv), vjp = jax.vjp(f, sp, rest_params)
+                    dsp, drp = vjp((recv_b, jnp.ones((), jnp.float32)))
+                    return (auxv, dsp, drp,
                             jnp.zeros(mb_shape, act_dtype))
 
                 def bwd_mid(_):
                     def f(sp_, x):
-                        return stage_fwd(sp_, x, b_idx).astype(act_dtype)
+                        y, aux = stage_fwd(sp_, x, b_idx)
+                        return y.astype(act_dtype), aux / M
 
-                    _, vjp = jax.vjp(f, sp, x_saved)
-                    dsp, dx = vjp(recv_b)
+                    (_, auxv), vjp = jax.vjp(f, sp, x_saved)
+                    dsp, dx = vjp((recv_b, jnp.ones((), jnp.float32)))
                     zeros_rest = jax.tree.map(jnp.zeros_like, rest_params)
-                    return (jnp.zeros((), jnp.float32), dsp, zeros_rest,
-                            dx)
+                    return auxv, dsp, zeros_rest, dx
 
                 def bwd_last(_):
                     tgt = tgt_mb[b_idx]
 
                     def f(sp_, rp_, x):
-                        yl = stage_fwd(sp_, x, b_idx)
+                        yl, aux = stage_fwd(sp_, x, b_idx)
                         logits = part.head(rp_, yl)
                         # mean of per-mb means == global batch mean
-                        return (loss_fn(logits, tgt) / M) \
+                        return ((loss_fn(logits, tgt) + aux) / M) \
                             .astype(jnp.float32)
 
                     lv, vjp = jax.vjp(f, sp, rest_params, x_saved)
@@ -685,7 +737,7 @@ def make_pipeline_eval_step(cfg: TrainConfig, mesh: Mesh,
         params = state.params
         h = part.embed(params["rest"], x)
         h_mb = h.reshape((M, B // M) + h.shape[1:])
-        h_mb = fwd(params["stages"], h_mb)
+        h_mb, _ = fwd(params["stages"], h_mb)  # eval reports data loss
         h = h_mb.reshape((B,) + h_mb.shape[2:])
         logits = part.head(params["rest"], h)
         loss = loss_fn(logits, y)

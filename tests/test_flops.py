@@ -145,3 +145,29 @@ def test_static_input_specs_match_real_datasets():
                            vocab_size=128).spec
         assert spec.x_shape == (64,)
         assert spec.x_dtype == np.int32
+
+
+def test_reader_input_specs_match_real_readers(tmp_path):
+    # the mnist_idx/cifar10_bin static shapes in _input_spec must track
+    # what the real readers derive from actual files
+    import sys
+
+    sys.path.insert(0, "tests")
+    import test_readers as tr
+
+    from pytorch_distributed_nn_tpu.data import get_dataset as gd
+    from pytorch_distributed_nn_tpu.config import get_config as gc
+
+    (tmp_path / "mnist").mkdir()
+    (tmp_path / "cifar").mkdir()
+    tr.mnist_dir(tmp_path / "mnist", n_train=32, n_test=16)
+    tr.cifar_dir(tmp_path / "cifar", n_per_batch=16, n_test=8)
+    cfg = gc("mlp_mnist")
+    for name, sub in (("mnist_idx", "mnist"), ("cifar10_bin", "cifar")):
+        cfg.data.dataset = name
+        cfg.data.path = str(tmp_path / sub)
+        spec = gd(name, seed=0, batch_size=1,
+                  path=cfg.data.path).spec
+        shape, dtype = flops_mod._input_spec(cfg)
+        assert shape == spec.x_shape, name
+        assert dtype == spec.x_dtype, name

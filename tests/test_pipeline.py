@@ -198,3 +198,55 @@ def test_pipeline_x_tensor_parallel(single_losses, schedule):
     trainer.train()
     np.testing.assert_allclose(np.array(trainer.losses()), single_losses,
                                rtol=2e-5, atol=1e-5)
+
+
+TINY_MOE = dict(num_layers=4, d_model=32, num_heads=2, mlp_dim=64,
+                vocab_size=101, max_len=64, num_experts=4, k=2,
+                capacity_factor=2.0, group_size=16, moe_every=1)
+
+
+@pytest.fixture(scope="module")
+def single_moe_losses():
+    return _train("single", MeshSpec(data=1, pipe=1), model="moe_lm",
+                  extra=TINY_MOE, devices=jax.devices()[:1])
+
+
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+def test_moe_under_pipeline_matches_single(single_moe_losses, schedule):
+    """MoE models pipeline now (uniform moe_every=1 stacks): the sown
+    load-balance aux reaches the objective through both schedules —
+    gpipe (masked per-tick accumulation through the fill-drain scan)
+    and 1f1b (each stage's backward differentiates its own aux). The
+    single-device run is the oracle: routing groups never span
+    microbatches, so the loss curves must agree."""
+    pp = _train("pipeline", MeshSpec(pipe=4, data=2), model="moe_lm",
+                extra=TINY_MOE, schedule=schedule)
+    np.testing.assert_allclose(pp, single_moe_losses, rtol=2e-5,
+                               atol=1e-5)
+
+
+def test_moe_pipeline_x_expert_parallel(single_moe_losses):
+    """pipe=2 x expert=2 x data=2: expert weights sharded over the
+    expert axis INSIDE the pipeline stages (auto axis, like TP)."""
+    trainer = _train("pipeline", MeshSpec(pipe=2, expert=2, data=2),
+                     model="moe_lm", extra=TINY_MOE, schedule="gpipe",
+                     return_trainer=True, do_train=False)
+    specs = {
+        "/".join(str(getattr(k, "key", k)) for k in kp):
+            leaf.sharding.spec
+        for kp, leaf in jax.tree_util.tree_flatten_with_path(
+            trainer.state.params["stages"])[0]
+    }
+    ep_sharded = [p for p, s in specs.items() if "expert" in str(s)]
+    assert any("moe/wi" in p for p in ep_sharded), specs
+    assert any("moe/wo" in p for p in ep_sharded), specs
+    trainer.train()
+    np.testing.assert_allclose(np.array(trainer.losses()),
+                               single_moe_losses, rtol=2e-5, atol=1e-5)
+
+
+def test_moe_mixed_stack_rejected_for_pipeline():
+    extra = dict(TINY_MOE, moe_every=2)  # alternating dense/MoE
+    with pytest.raises(ValueError, match="moe_every"):
+        _train("pipeline", MeshSpec(pipe=2, data=4), model="moe_lm",
+               extra=extra)
