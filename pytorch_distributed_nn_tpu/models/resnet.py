@@ -3,6 +3,10 @@ pure data-parallel DDP allreduce"; SURVEY.md §2a Models row).
 
 NHWC layout (TPU-native: channels-last feeds the MXU's 128-lane minor
 dimension), BatchNorm running stats in the ``batch_stats`` collection.
+Geometry matches torch exactly (symmetric paddings, not flax 'SAME'),
+so torchvision ``resnet50`` checkpoints convert logit-equivalently
+(utils/torch_interop.py) — note checkpoints trained before round 2's
+padding alignment see shifted stride-2 receptive fields on restore.
 Under compiler-sharded DP the batch statistics are computed over the
 *global* batch (SyncBN semantics) because the batch axis is sharded, not
 vmapped — strictly stronger than torch DDP's local BN.
@@ -37,8 +41,11 @@ class BottleneckBlock(nn.Module):
         residual = x
         y = conv(self.filters, (1, 1), name="conv1")(x)
         y = nn.relu(norm(name="bn1")(y))
+        # explicit symmetric padding = torch Conv2d(padding=1) geometry
+        # (flax 'SAME' pads asymmetrically at stride 2) — keeps
+        # converted torchvision weights logit-equivalent
         y = conv(self.filters, (3, 3), strides=(self.strides,) * 2,
-                 name="conv2")(y)
+                 padding=[(1, 1)] * 2, name="conv2")(y)
         y = nn.relu(norm(name="bn2")(y))
         y = conv(self.filters * 4, (1, 1), name="conv3")(y)
         # zero-init final BN scale: residual branch starts as identity
@@ -68,7 +75,9 @@ class ResNet(nn.Module):
                          epsilon=1e-5, dtype=self.dtype,
                          param_dtype=self.param_dtype, name="bn_init")(x)
         x = nn.relu(x)
-        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        # torch MaxPool2d(3, 2, padding=1) geometry (see BottleneckBlock)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2),
+                        padding=((1, 1), (1, 1)))
         for stage, n_blocks in enumerate(self.stage_sizes):
             for block in range(n_blocks):
                 strides = 2 if stage > 0 and block == 0 else 1

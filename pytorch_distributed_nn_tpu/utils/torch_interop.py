@@ -363,3 +363,121 @@ def mlp_params_from_torch(state_dict: Mapping[str, Any]) -> dict:
             leaf["bias"] = to_numpy(state_dict[bk])
         params[f"Dense_{j}"] = leaf
     return params
+
+
+def _conv_kernel(weight) -> np.ndarray:
+    """torch Conv2d weight (O, I, kh, kw) → flax kernel (kh, kw, I, O)."""
+    return to_numpy(weight).transpose(2, 3, 1, 0)
+
+
+def _bn_from_torch(tracked, prefix: str) -> tuple[dict, dict]:
+    """torch BatchNorm2d → (flax params {scale, bias},
+    batch_stats {mean, var})."""
+    params = {"scale": to_numpy(tracked[prefix + ".weight"]),
+              "bias": to_numpy(tracked[prefix + ".bias"])}
+    stats = {"mean": to_numpy(tracked[prefix + ".running_mean"]),
+             "var": to_numpy(tracked[prefix + ".running_var"])}
+    return params, stats
+
+
+def resnet50_params_from_torch(
+    state_dict: Mapping[str, Any],
+    *,
+    stage_sizes: tuple[int, ...] = (3, 4, 6, 3),
+) -> tuple[dict, dict]:
+    """torchvision ``resnet50().state_dict()`` → (params, batch_stats)
+    for models/resnet.py — the reference's config-2 model family, so a
+    migrant's ImageNet checkpoint drops straight in.
+
+    Key layout bridged (torchvision side): ``conv1``/``bn1`` stem,
+    ``layer{1..4}.{b}.{conv1,bn1,conv2,bn2,conv3,bn3}`` bottlenecks
+    with ``downsample.{0,1}`` projections on each stage's first block,
+    ``fc`` head. Conv kernels transpose (O, I, kh, kw) → (kh, kw, I,
+    O); BatchNorm running stats land in the ``batch_stats`` collection
+    (our model's geometry matches torch's symmetric paddings, so
+    converted weights are logit-equivalent in eval mode).
+    """
+    tracked = _TrackingDict(state_dict)
+    params: dict = {
+        "conv_init": {"kernel": _conv_kernel(tracked["conv1.weight"])},
+    }
+    stats: dict = {}
+    params["bn_init"], stats["bn_init"] = _bn_from_torch(tracked, "bn1")
+
+    for stage, n_blocks in enumerate(stage_sizes):
+        for block in range(n_blocks):
+            src = f"layer{stage + 1}.{block}"
+            dst = f"stage{stage}_block{block}"
+            p: dict = {}
+            s: dict = {}
+            for j in (1, 2, 3):
+                p[f"conv{j}"] = {"kernel": _conv_kernel(
+                    tracked[f"{src}.conv{j}.weight"])}
+                p[f"bn{j}"], s[f"bn{j}"] = _bn_from_torch(
+                    tracked, f"{src}.bn{j}")
+            if f"{src}.downsample.0.weight" in state_dict:
+                p["conv_proj"] = {"kernel": _conv_kernel(
+                    tracked[f"{src}.downsample.0.weight"])}
+                p["bn_proj"], s["bn_proj"] = _bn_from_torch(
+                    tracked, f"{src}.downsample.1")
+            params[dst] = p
+            stats[dst] = s
+
+    params["head"] = {
+        "kernel": to_numpy(tracked["fc.weight"]).T,
+        "bias": to_numpy(tracked["fc.bias"]),
+    }
+    tracked.check_consumed(ignorable=("num_batches_tracked",))
+    return params, {"batch_stats": stats}
+
+
+def resnet50_params_to_torch(params: Mapping[str, Any],
+                             model_state: Mapping[str, Any],
+                             *,
+                             stage_sizes: tuple[int, ...] = (3, 4, 6, 3),
+                             ) -> dict:
+    """Inverse of :func:`resnet50_params_from_torch` (torchvision key
+    layout, torch tensors). ``model_state`` is the TrainState field
+    that function returns — the {'batch_stats': ...} wrapper, exactly
+    what ``state.model_state`` holds."""
+    import torch
+
+    sd: dict = {}
+
+    def put_conv(key, kernel):
+        sd[key + ".weight"] = torch.from_numpy(
+            np.asarray(kernel, np.float32).transpose(3, 2, 0, 1).copy())
+
+    def put_bn(key, p, s):
+        sd[key + ".weight"] = torch.from_numpy(
+            np.asarray(p["scale"], np.float32).copy())
+        sd[key + ".bias"] = torch.from_numpy(
+            np.asarray(p["bias"], np.float32).copy())
+        sd[key + ".running_mean"] = torch.from_numpy(
+            np.asarray(s["mean"], np.float32).copy())
+        sd[key + ".running_var"] = torch.from_numpy(
+            np.asarray(s["var"], np.float32).copy())
+        sd[key + ".num_batches_tracked"] = torch.zeros((), dtype=torch.int64)
+
+    stats = model_state["batch_stats"]
+    put_conv("conv1", params["conv_init"]["kernel"])
+    put_bn("bn1", params["bn_init"], stats["bn_init"])
+    for stage, n_blocks in enumerate(stage_sizes):
+        for block in range(n_blocks):
+            src = f"stage{stage}_block{block}"
+            dst = f"layer{stage + 1}.{block}"
+            for j in (1, 2, 3):
+                put_conv(f"{dst}.conv{j}",
+                         params[src][f"conv{j}"]["kernel"])
+                put_bn(f"{dst}.bn{j}", params[src][f"bn{j}"],
+                       stats[src][f"bn{j}"])
+            if "conv_proj" in params[src]:
+                put_conv(f"{dst}.downsample.0",
+                         params[src]["conv_proj"]["kernel"])
+                put_bn(f"{dst}.downsample.1", params[src]["bn_proj"],
+                       stats[src]["bn_proj"])
+    sd["fc.weight"] = torch.from_numpy(
+        np.asarray(params["head"]["kernel"], np.float32).T.copy())
+    sd["fc.bias"] = torch.from_numpy(
+        np.asarray(params["head"]["bias"], np.float32).copy())
+    return sd

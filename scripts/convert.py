@@ -48,6 +48,8 @@ def _load_state_dict(path: str):
 
 
 def _converted_params(arch: str, state_dict, model_cfg):
+    """Returns (params, model_state_or_None) — model_state carries the
+    non-param variable collections (ResNet BatchNorm running stats)."""
     from pytorch_distributed_nn_tpu.utils import torch_interop as ti
 
     e = model_cfg.extra
@@ -57,23 +59,29 @@ def _converted_params(arch: str, state_dict, model_cfg):
             num_layers=e.get("num_layers", 32),
             num_heads=e.get("num_heads", 32),
             num_kv_heads=e.get("num_kv_heads", 8),
-        )
+        ), None
     if arch == "bert":
         return ti.bert_params_from_torch(
             state_dict,
             num_layers=e.get("num_layers", 12),
             num_heads=e.get("num_heads", 12),
-        )
+        ), None
     if arch == "gpt2":
         return ti.gpt2_params_from_torch(
             state_dict,
             num_layers=e.get("num_layers", 12),
             num_heads=e.get("num_heads", 12),
+        ), None
+    if arch == "resnet50":
+        return ti.resnet50_params_from_torch(
+            state_dict,
+            stage_sizes=tuple(e.get("stage_sizes", (3, 4, 6, 3))),
         )
     if arch == "mlp":
-        return ti.mlp_params_from_torch(state_dict)
+        return ti.mlp_params_from_torch(state_dict), None
     raise ValueError(
-        f"unknown --arch {arch!r} (llama3 | bert | gpt2 | mlp)"
+        f"unknown --arch {arch!r} (llama3 | bert | gpt2 | resnet50 | "
+        "mlp)"
     )
 
 
@@ -83,7 +91,8 @@ def main(argv=None) -> int:
         formatter_class=argparse.RawDescriptionHelpFormatter,
     )
     ap.add_argument("--arch", required=True,
-                    choices=("llama3", "bert", "gpt2", "mlp"))
+                    choices=("llama3", "bert", "gpt2", "resnet50",
+                             "mlp"))
     ap.add_argument("--preset", required=True)
     ap.add_argument("--torch-checkpoint", required=True,
                     help="torch state_dict file (read on import, "
@@ -116,7 +125,8 @@ def main(argv=None) -> int:
 
     if args.out:
         state_dict = _load_state_dict(args.torch_checkpoint)
-        converted = _converted_params(args.arch, state_dict, cfg.model)
+        converted, model_state = _converted_params(args.arch, state_dict,
+                                                   cfg.model)
         if cfg.parallel.strategy == "pipeline":
             # pipeline checkpoints hold STACKED stage params — restack
             # the flat converted tree so train.py --resume consumes it
@@ -133,13 +143,17 @@ def main(argv=None) -> int:
 
         try:
             placed = place_like(converted, trainer.state.params)
+            state = trainer.state.replace(params=placed)
+            if model_state is not None:  # e.g. BatchNorm running stats
+                state = state.replace(model_state=place_like(
+                    model_state, trainer.state.model_state))
         except ValueError as e:
             raise SystemExit(
                 f"converted weights do not fit the configured model "
                 f"(set --model.extra to the checkpoint's dims): {e}"
             ) from e
         mgr = CheckpointManager(args.out, async_save=False)
-        mgr.save(trainer.state.replace(params=placed), data_step=0,
+        mgr.save(state, data_step=0,
                  extra_meta={"converted_from": args.torch_checkpoint},
                  force=True)
         mgr.close()
@@ -150,8 +164,10 @@ def main(argv=None) -> int:
     mgr = CheckpointManager(args.export, async_save=False)
     state, meta = mgr.restore(trainer.state)
     mgr.close()
-    if args.arch != "llama3":
-        raise SystemExit("export currently supports --arch llama3 only")
+    if args.arch not in ("llama3", "resnet50"):
+        raise SystemExit(
+            "export currently supports --arch llama3 | resnet50"
+        )
     from pytorch_distributed_nn_tpu.utils import torch_interop as ti
 
     params = state.params
@@ -166,8 +182,19 @@ def main(argv=None) -> int:
     host_params = jax.tree.map(
         lambda x: np.asarray(jax.device_get(x), np.float32), params
     )
-    torch.save(ti.llama_params_to_torch(host_params),
-               args.torch_checkpoint)
+    if args.arch == "resnet50":
+        host_stats = jax.tree.map(
+            lambda x: np.asarray(jax.device_get(x), np.float32),
+            dict(state.model_state),
+        )
+        sd = ti.resnet50_params_to_torch(
+            host_params, host_stats,
+            stage_sizes=tuple(cfg.model.extra.get("stage_sizes",
+                                                  (3, 4, 6, 3))),
+        )
+    else:
+        sd = ti.llama_params_to_torch(host_params)
+    torch.save(sd, args.torch_checkpoint)
     print(f"wrote torch state_dict: {args.torch_checkpoint} "
           f"(from step {meta['step']})")
     return 0

@@ -131,3 +131,62 @@ def test_convert_safetensors_and_eps_default(tmp_path):
                 "--out", str(ckpt), *OVERRIDES)
     assert r.returncode == 0, r.stderr
     assert (ckpt / "0").exists()
+
+
+def test_convert_resnet50_checkpoint_carries_batch_stats(tmp_path):
+    """--arch resnet50: BatchNorm running stats must ride the converted
+    checkpoint's model_state, not get silently re-initialized."""
+    import sys as _sys
+
+    _sys.path.insert(0, "tests")
+    from test_torch_interop import _torch_resnet50
+
+    torch.manual_seed(0)
+    net = _torch_resnet50()
+    net.train()
+    with torch.no_grad():
+        for _ in range(2):
+            net(torch.randn(4, 3, 64, 64))
+    net.eval()
+    pt = tmp_path / "resnet.pt"
+    torch.save(net.state_dict(), pt)
+
+    ckpt = tmp_path / "ckpt"
+    r = run_cli("scripts/convert.py", "--arch", "resnet50", "--preset",
+                "resnet50_dp", "--torch-checkpoint", str(pt),
+                "--out", str(ckpt), "--data.batch_size", "8",
+                "--mesh.data", "-1")
+    assert r.returncode == 0, r.stderr[-2000:]
+
+    from pytorch_distributed_nn_tpu.config import get_config
+    from pytorch_distributed_nn_tpu.train.checkpoint import (
+        CheckpointManager,
+    )
+    from pytorch_distributed_nn_tpu.train.trainer import Trainer
+
+    cfg = get_config("resnet50_dp", **{"data.batch_size": "8",
+                                       "steps": "0",
+                                       "data.prefetch": "0"})
+    trainer = Trainer(cfg)
+    mgr = CheckpointManager(str(ckpt), async_save=False)
+    state, _ = mgr.restore(trainer.state)
+    mgr.close()
+    got = np.asarray(
+        state.model_state["batch_stats"]["bn_init"]["mean"]
+    )
+    want = net.state_dict()["bn1.running_mean"].numpy()
+    np.testing.assert_allclose(got, want, rtol=1e-6)
+
+    back = tmp_path / "back.pt"
+    r = run_cli("scripts/convert.py", "--arch", "resnet50", "--preset",
+                "resnet50_dp", "--torch-checkpoint", str(back),
+                "--export", str(ckpt), "--data.batch_size", "8",
+                "--mesh.data", "-1")
+    assert r.returncode == 0, r.stderr[-2000:]
+    exported = torch.load(back, weights_only=True)
+    np.testing.assert_allclose(
+        exported["layer3.2.bn2.running_var"].numpy(),
+        net.state_dict()["layer3.2.bn2.running_var"].numpy(), rtol=1e-6)
+    np.testing.assert_allclose(
+        exported["conv1.weight"].numpy(),
+        net.state_dict()["conv1.weight"].numpy(), rtol=1e-6)

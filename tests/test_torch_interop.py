@@ -7,6 +7,7 @@ strongest possible migration guarantee (a reference user's checkpoint
 keeps its behavior bit-for-nearly-bit)."""
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 import pytest
 
@@ -169,3 +170,123 @@ def test_truncated_state_dict_fails_loudly(tiny_llama):
     with pytest.raises(KeyError):
         ti.llama_params_from_torch(sd, num_layers=2, num_heads=4,
                                    num_kv_heads=2)
+
+
+def _torch_resnet50():
+    """Minimal faithful torch ResNet-50 (v1.5) with torchvision's exact
+    module names, so its state_dict keys match ``resnet50().state_dict()``
+    — the oracle for the conv/BN/fc mapping without torchvision in the
+    image."""
+    import torch
+    from torch import nn as tnn
+
+    class Bottleneck(tnn.Module):
+        def __init__(self, inplanes, planes, stride=1,
+                     downsample=None):
+            super().__init__()
+            self.conv1 = tnn.Conv2d(inplanes, planes, 1, bias=False)
+            self.bn1 = tnn.BatchNorm2d(planes)
+            self.conv2 = tnn.Conv2d(planes, planes, 3, stride, 1,
+                                    bias=False)
+            self.bn2 = tnn.BatchNorm2d(planes)
+            self.conv3 = tnn.Conv2d(planes, planes * 4, 1, bias=False)
+            self.bn3 = tnn.BatchNorm2d(planes * 4)
+            self.relu = tnn.ReLU(inplace=True)
+            self.downsample = downsample
+
+        def forward(self, x):
+            identity = x
+            out = self.relu(self.bn1(self.conv1(x)))
+            out = self.relu(self.bn2(self.conv2(out)))
+            out = self.bn3(self.conv3(out))
+            if self.downsample is not None:
+                identity = self.downsample(x)
+            return self.relu(out + identity)
+
+    class ResNet50(tnn.Module):
+        def __init__(self, num_classes=1000):
+            super().__init__()
+            self.conv1 = tnn.Conv2d(3, 64, 7, 2, 3, bias=False)
+            self.bn1 = tnn.BatchNorm2d(64)
+            self.relu = tnn.ReLU(inplace=True)
+            self.maxpool = tnn.MaxPool2d(3, 2, 1)
+            inplanes = 64
+            for li, (planes, blocks, stride) in enumerate(
+                    [(64, 3, 1), (128, 4, 2), (256, 6, 2),
+                     (512, 3, 2)], start=1):
+                downsample = tnn.Sequential(
+                    tnn.Conv2d(inplanes, planes * 4, 1, stride,
+                               bias=False),
+                    tnn.BatchNorm2d(planes * 4),
+                )
+                layers = [Bottleneck(inplanes, planes, stride,
+                                     downsample)]
+                inplanes = planes * 4
+                layers += [Bottleneck(inplanes, planes)
+                           for _ in range(blocks - 1)]
+                setattr(self, f"layer{li}", tnn.Sequential(*layers))
+            self.avgpool = tnn.AdaptiveAvgPool2d(1)
+            self.fc = tnn.Linear(2048, num_classes)
+
+        def forward(self, x):
+            x = self.maxpool(self.relu(self.bn1(self.conv1(x))))
+            for li in range(1, 5):
+                x = getattr(self, f"layer{li}")(x)
+            x = self.avgpool(x).flatten(1)
+            return self.fc(x)
+
+    return ResNet50()
+
+
+def test_resnet50_from_torch_logit_equivalence():
+    """torchvision-layout ResNet-50 weights → our NHWC flax model:
+    eval-mode logits must agree (conv transpose, BN running stats, and
+    the torch-matching padding geometry all on trial)."""
+    import torch
+
+    from pytorch_distributed_nn_tpu.config import ModelConfig
+    from pytorch_distributed_nn_tpu.models import get_model
+    from pytorch_distributed_nn_tpu.utils.torch_interop import (
+        resnet50_params_from_torch,
+    )
+
+    torch.manual_seed(0)
+    net = _torch_resnet50()
+    # make the running stats non-trivial before eval
+    net.train()
+    with torch.no_grad():
+        for _ in range(2):
+            net(torch.randn(4, 3, 64, 64))
+    net.eval()
+
+    params, model_state = resnet50_params_from_torch(net.state_dict())
+    model = get_model(ModelConfig(name="resnet50",
+                                  compute_dtype="float32"))
+    x = np.random.RandomState(0).randn(2, 64, 64, 3).astype(np.float32)
+    with torch.no_grad():
+        want = net(torch.from_numpy(x.transpose(0, 3, 1, 2))).numpy()
+    got = np.asarray(model.apply(
+        {"params": params, **model_state},
+        jnp.asarray(x), train=False,
+    ))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_resnet50_torch_roundtrip():
+    import torch
+
+    from pytorch_distributed_nn_tpu.utils.torch_interop import (
+        resnet50_params_from_torch,
+        resnet50_params_to_torch,
+    )
+
+    torch.manual_seed(1)
+    net = _torch_resnet50()
+    sd = net.state_dict()
+    params, stats = resnet50_params_from_torch(sd)
+    back = resnet50_params_to_torch(params, stats)
+    for key, tensor in sd.items():
+        if key.endswith("num_batches_tracked"):
+            continue
+        np.testing.assert_array_equal(back[key].numpy(),
+                                      tensor.numpy(), err_msg=key)
