@@ -481,3 +481,80 @@ def resnet50_params_to_torch(params: Mapping[str, Any],
     sd["fc.bias"] = torch.from_numpy(
         np.asarray(params["head"]["bias"], np.float32).copy())
     return sd
+
+
+def lenet_params_from_torch(state_dict: Mapping[str, Any]) -> dict:
+    """torch LeNet-style nets (the reference's classic small CNN:
+    conv(6,5,pad 2) -> pool -> conv(16,5) -> pool -> fc 120/84/classes)
+    → params for models/lenet.py.
+
+    Layers are taken in registration order like
+    :func:`mlp_params_from_torch`: 4-D weights become ``Conv_i``, 2-D
+    weights ``Dense_i``. The first Linear after the flatten needs its
+    input rows PERMUTED: torch flattens NCHW (channel-major,
+    ``c*H*W + h*W + w``) while our NHWC model flattens channel-minor
+    (``h*W*C + w*C + c``) — same features, different order.
+    """
+    convs = [k for k in state_dict
+             if k.endswith(".weight")
+             and to_numpy(state_dict[k]).ndim == 4]
+    fcs = [k for k in state_dict
+           if k.endswith(".weight")
+           and to_numpy(state_dict[k]).ndim == 2]
+    if not convs or not fcs:
+        raise ValueError(
+            "lenet mapping needs Conv2d and Linear weights; got "
+            f"convs={convs}, linears={fcs}"
+        )
+    # fail loudly on anything this layout does not map (BatchNorm
+    # scales/stats, etc.) — a silently-dropped tensor means silently
+    # wrong logits
+    mapped = set(convs) | set(fcs)
+    mapped |= {k[: -len(".weight")] + ".bias" for k in mapped}
+    unmapped = [k for k in state_dict if k not in mapped]
+    if unmapped:
+        raise ValueError(
+            "tensors the lenet layout does not map (norm-bearing or "
+            f"non-standard variant?): {sorted(unmapped)[:8]}"
+        )
+    params: dict = {}
+    for i, key in enumerate(convs):
+        leaf = {"kernel": _conv_kernel(state_dict[key])}
+        bk = key[: -len(".weight")] + ".bias"
+        if bk in state_dict:
+            leaf["bias"] = to_numpy(state_dict[bk])
+        params[f"Conv_{i}"] = leaf
+
+    channels = to_numpy(state_dict[convs[-1]]).shape[0]  # last conv out
+    for j, key in enumerate(fcs):
+        w = to_numpy(state_dict[key])  # (out, in)
+        if j == 0:
+            n_in = w.shape[1]
+            if n_in % channels:
+                raise ValueError(
+                    f"first Linear in_features {n_in} not divisible by "
+                    f"final conv channels {channels}"
+                )
+            hw = n_in // channels
+            side = int(round(hw ** 0.5))
+            if side * side != hw:
+                raise ValueError(
+                    f"non-square feature map ({hw} spatial elements) — "
+                    "pass through a model-specific mapping"
+                )
+            # torch index c*H*W + h*W + w  ->  flax h*W*C + w*C + c.
+            # ASSUMES a square final feature map (models/lenet.py
+            # geometry); a rectangular map with square area would
+            # permute with the wrong (H, W) and cannot be detected
+            # from the state_dict alone.
+            perm = (np.arange(n_in)
+                    .reshape(channels, side, side)  # (c, h, w)
+                    .transpose(1, 2, 0)  # (h, w, c)
+                    .reshape(-1))
+            w = w[:, perm]
+        leaf = {"kernel": linear_kernel(w)}
+        bk = key[: -len(".weight")] + ".bias"
+        if bk in state_dict:
+            leaf["bias"] = to_numpy(state_dict[bk])
+        params[f"Dense_{j}"] = leaf
+    return params

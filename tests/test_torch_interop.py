@@ -290,3 +290,60 @@ def test_resnet50_torch_roundtrip():
             continue
         np.testing.assert_array_equal(back[key].numpy(),
                                       tensor.numpy(), err_msg=key)
+
+
+def test_lenet_from_torch_logit_equivalence():
+    """Reference-style torch LeNet → our NHWC model: the NCHW-flatten
+    row permutation on the first Linear is the load-bearing part."""
+    import torch
+    from torch import nn as tnn
+    from torch.nn import functional as F
+
+    class TorchLeNet(tnn.Module):
+        def __init__(self):
+            super().__init__()
+            self.conv1 = tnn.Conv2d(1, 6, 5, padding=2)
+            self.conv2 = tnn.Conv2d(6, 16, 5)
+            self.fc1 = tnn.Linear(16 * 5 * 5, 120)
+            self.fc2 = tnn.Linear(120, 84)
+            self.fc3 = tnn.Linear(84, 10)
+
+        def forward(self, x):
+            x = F.avg_pool2d(F.relu(self.conv1(x)), 2)
+            x = F.avg_pool2d(F.relu(self.conv2(x)), 2)
+            x = x.flatten(1)
+            x = F.relu(self.fc1(x))
+            x = F.relu(self.fc2(x))
+            return self.fc3(x)
+
+    from pytorch_distributed_nn_tpu.utils.torch_interop import (
+        lenet_params_from_torch,
+    )
+
+    torch.manual_seed(3)
+    net = TorchLeNet().eval()
+    params = lenet_params_from_torch(net.state_dict())
+    model = get_model(ModelConfig(name="lenet",
+                                  compute_dtype="float32"))
+    x = np.random.RandomState(2).randn(4, 28, 28).astype(np.float32)
+    with torch.no_grad():
+        want = net(torch.from_numpy(x[:, None])).numpy()
+    got = np.asarray(model.apply({"params": params}, jnp.asarray(x),
+                                 train=False))
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_lenet_rejects_norm_bearing_variant():
+    import torch
+    from torch import nn as tnn
+
+    from pytorch_distributed_nn_tpu.utils.torch_interop import (
+        lenet_params_from_torch,
+    )
+
+    net = tnn.Module()
+    net.conv1 = tnn.Conv2d(1, 6, 5, padding=2)
+    net.bn1 = tnn.BatchNorm2d(6)  # not representable by models/lenet.py
+    net.fc1 = tnn.Linear(6 * 14 * 14, 10)
+    with pytest.raises(ValueError, match="does not map"):
+        lenet_params_from_torch(net.state_dict())
