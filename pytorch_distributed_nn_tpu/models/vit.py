@@ -24,14 +24,17 @@ class EncoderBlock(nn.Module):
     mlp_dim: int
     dropout: float = 0.0
     attn_impl: str = "xla"
+    # HF-conventional (ViTConfig.layer_norm_eps): converted checkpoints
+    # reproduce the original's logits without an override
+    ln_eps: float = 1e-12
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
         d = x.shape[-1]
-        y = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
-                         name="ln1")(x)
+        y = nn.LayerNorm(epsilon=self.ln_eps, dtype=self.dtype,
+                         param_dtype=self.param_dtype, name="ln1")(x)
         y = MultiHeadAttention(
             num_heads=self.num_heads, head_dim=d // self.num_heads,
             causal=False, impl=self.attn_impl, dtype=self.dtype,
@@ -40,8 +43,8 @@ class EncoderBlock(nn.Module):
         if self.dropout:
             y = nn.Dropout(self.dropout, deterministic=not train)(y)
         x = x + y
-        y = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
-                         name="ln2")(x)
+        y = nn.LayerNorm(epsilon=self.ln_eps, dtype=self.dtype,
+                         param_dtype=self.param_dtype, name="ln2")(x)
         y = nn.Dense(self.mlp_dim, dtype=self.dtype,
                      param_dtype=self.param_dtype, name="mlp_in")(y)
         y = nn.gelu(y)
@@ -64,6 +67,7 @@ class ViT(nn.Module):
     # 32px/4) where the einsum path wins; 'auto'/'flash' available for
     # high-resolution patch grids
     attn_impl: str = "xla"
+    ln_eps: float = 1e-12  # see EncoderBlock
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
 
@@ -97,11 +101,11 @@ class ViT(nn.Module):
             x = EncoderBlock(
                 num_heads=self.num_heads, mlp_dim=self.mlp_dim,
                 dropout=self.dropout, attn_impl=self.attn_impl,
-                dtype=self.dtype,
+                ln_eps=self.ln_eps, dtype=self.dtype,
                 param_dtype=self.param_dtype, name=f"layer{i}",
             )(x, train=train)
-        x = nn.LayerNorm(dtype=self.dtype, param_dtype=self.param_dtype,
-                         name="ln_f")(x)
+        x = nn.LayerNorm(epsilon=self.ln_eps, dtype=self.dtype,
+                         param_dtype=self.param_dtype, name="ln_f")(x)
         return nn.Dense(self.num_classes, dtype=jnp.float32,
                         param_dtype=self.param_dtype, name="head")(
             x[:, 0])  # CLS token
@@ -118,6 +122,7 @@ def build_vit(cfg: ModelConfig) -> ViT:
         d_model=e.get("d_model", 192),
         num_heads=e.get("num_heads", 3),
         mlp_dim=e.get("mlp_dim", 768),
+        ln_eps=e.get("ln_eps", 1e-12),
         dropout=e.get("dropout", 0.0),
         attn_impl=e.get("attn_impl", "xla"),
         dtype=policy.compute_dtype,

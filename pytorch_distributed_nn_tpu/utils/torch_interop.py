@@ -75,6 +75,27 @@ class _TrackingDict:
             )
 
 
+def _ln_leaf(sd, prefix: str) -> dict:
+    """HF LayerNorm ``{prefix}.weight/.bias`` → flax {scale, bias}."""
+    return {"scale": to_numpy(sd[prefix + ".weight"]),
+            "bias": to_numpy(sd[prefix + ".bias"])}
+
+
+def _dense_leaf(sd, prefix: str) -> dict:
+    """HF Linear ``{prefix}.weight/.bias`` → flax Dense leaf."""
+    return {"kernel": linear_kernel(sd[prefix + ".weight"]),
+            "bias": to_numpy(sd[prefix + ".bias"])}
+
+
+def _heads_in_leaf(sd, prefix: str, heads: int, head_dim: int) -> dict:
+    """HF per-head input projection → DenseGeneral (D, H, Dh) leaf."""
+    return {
+        "kernel": _heads_in_kernel(sd[prefix + ".weight"], heads,
+                                   head_dim),
+        "bias": to_numpy(sd[prefix + ".bias"]).reshape(heads, head_dim),
+    }
+
+
 def _heads_out_kernel(weight, heads: int, head_dim: int) -> np.ndarray:
     """(D, H*Dh) out projection → DenseGeneral kernel (H, Dh, D)."""
     w = to_numpy(weight)
@@ -210,13 +231,11 @@ def bert_params_from_torch(
         raise ValueError(f"d_model {d_model} % num_heads {num_heads} != 0")
     head_dim = d_model // num_heads
 
-    def ln(prefix: str) -> dict:
-        return {"scale": to_numpy(sd[prefix + ".weight"]),
-                "bias": to_numpy(sd[prefix + ".bias"])}
+    def ln(prefix):
+        return _ln_leaf(sd, prefix)
 
-    def dense(prefix: str) -> dict:
-        return {"kernel": linear_kernel(sd[prefix + ".weight"]),
-                "bias": to_numpy(sd[prefix + ".bias"])}
+    def dense(prefix):
+        return _dense_leaf(sd, prefix)
 
     params: dict = {
         "tok_embed": {"embedding": embed},
@@ -229,13 +248,8 @@ def bert_params_from_torch(
     for i in range(num_layers):
         p = f"bert.encoder.layer.{i}."
 
-        def heads_in(prefix: str) -> dict:
-            return {
-                "kernel": _heads_in_kernel(sd[prefix + ".weight"],
-                                           num_heads, head_dim),
-                "bias": to_numpy(sd[prefix + ".bias"]).reshape(
-                    num_heads, head_dim),
-            }
+        def heads_in(prefix):
+            return _heads_in_leaf(sd, prefix, num_heads, head_dim)
 
         params[f"layer{i}"] = {
             "attn": {
@@ -557,4 +571,68 @@ def lenet_params_from_torch(state_dict: Mapping[str, Any]) -> dict:
         if bk in state_dict:
             leaf["bias"] = to_numpy(state_dict[bk])
         params[f"Dense_{j}"] = leaf
+    return params
+
+
+def vit_params_from_torch(
+    state_dict: Mapping[str, Any], *, num_layers: int, num_heads: int
+) -> dict:
+    """HF ``ViTForImageClassification.state_dict()`` → params for
+    models/vit.py (both are pre-LN encoders with CLS token + learned
+    positions, so the mapping is 1:1).
+
+    Same activation note as BERT: models/vit.py uses flax's
+    tanh-approximate gelu — ``hidden_act='gelu_pytorch_tanh'``
+    checkpoints match tightly, plain ``'gelu'`` (erf) diverges at the
+    ~1e-3 level. The unused pooler (when present) is dropped.
+    """
+    sd = _TrackingDict(state_dict)
+    proj = to_numpy(sd["vit.embeddings.patch_embeddings.projection"
+                       ".weight"])  # (D, C, p, p)
+    d_model = proj.shape[0]
+    if d_model % num_heads:
+        raise ValueError(f"d_model {d_model} % num_heads {num_heads} != 0")
+    head_dim = d_model // num_heads
+
+    def ln(prefix):
+        return _ln_leaf(sd, prefix)
+
+    def dense(prefix):
+        return _dense_leaf(sd, prefix)
+
+    def heads_in(prefix):
+        return _heads_in_leaf(sd, prefix, num_heads, head_dim)
+
+    params: dict = {
+        "patch_embed": {
+            "kernel": _conv_kernel(proj),
+            "bias": to_numpy(sd["vit.embeddings.patch_embeddings"
+                                ".projection.bias"]),
+        },
+        "cls": to_numpy(sd["vit.embeddings.cls_token"]),
+        "pos_embed": to_numpy(sd["vit.embeddings.position_embeddings"]),
+        "ln_f": ln("vit.layernorm"),
+        "head": dense("classifier"),
+    }
+    for i in range(num_layers):
+        p = f"vit.encoder.layer.{i}."
+        params[f"layer{i}"] = {
+            "attn": {
+                "query": heads_in(p + "attention.attention.query"),
+                "key": heads_in(p + "attention.attention.key"),
+                "value": heads_in(p + "attention.attention.value"),
+                "out": {
+                    "kernel": _heads_out_kernel(
+                        sd[p + "attention.output.dense.weight"],
+                        num_heads, head_dim),
+                    "bias": to_numpy(
+                        sd[p + "attention.output.dense.bias"]),
+                },
+            },
+            "ln1": ln(p + "layernorm_before"),
+            "ln2": ln(p + "layernorm_after"),
+            "mlp_in": dense(p + "intermediate.dense"),
+            "mlp_out": dense(p + "output.dense"),
+        }
+    sd.check_consumed(ignorable=("pooler",))
     return params

@@ -77,13 +77,19 @@ def _converted_params(arch: str, state_dict, model_cfg):
             state_dict,
             stage_sizes=tuple(e.get("stage_sizes", (3, 4, 6, 3))),
         )
+    if arch == "vit":
+        return ti.vit_params_from_torch(
+            state_dict,
+            num_layers=e.get("num_layers", 6),
+            num_heads=e.get("num_heads", 3),
+        ), None
     if arch == "lenet":
         return ti.lenet_params_from_torch(state_dict), None
     if arch == "mlp":
         return ti.mlp_params_from_torch(state_dict), None
     raise ValueError(
         f"unknown --arch {arch!r} (llama3 | bert | gpt2 | resnet50 | "
-        "lenet | mlp)"
+        "vit | lenet | mlp)"
     )
 
 
@@ -94,7 +100,7 @@ def main(argv=None) -> int:
     )
     ap.add_argument("--arch", required=True,
                     choices=("llama3", "bert", "gpt2", "resnet50",
-                             "lenet", "mlp"))
+                             "vit", "lenet", "mlp"))
     ap.add_argument("--preset", required=True)
     ap.add_argument("--torch-checkpoint", required=True,
                     help="torch state_dict file (read on import, "

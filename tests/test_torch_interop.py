@@ -347,3 +347,36 @@ def test_lenet_rejects_norm_bearing_variant():
     net.fc1 = tnn.Linear(6 * 14 * 14, 10)
     with pytest.raises(ValueError, match="does not map"):
         lenet_params_from_torch(net.state_dict())
+
+
+def test_vit_from_torch_logit_equivalence():
+    """HF ViTForImageClassification → our ViT: pre-LN encoders map
+    1:1; patch conv, CLS/pos embeddings, and per-head QKV reshapes on
+    trial."""
+    transformers = pytest.importorskip("transformers")
+    import torch
+
+    cfg = transformers.ViTConfig(
+        hidden_size=64, num_hidden_layers=2, num_attention_heads=4,
+        intermediate_size=128, image_size=32, patch_size=8,
+        num_channels=3, hidden_act="gelu_pytorch_tanh",
+        layer_norm_eps=1e-12, num_labels=10)
+    torch.manual_seed(5)
+    hf = transformers.ViTForImageClassification(cfg).eval()
+
+    from pytorch_distributed_nn_tpu.utils.torch_interop import (
+        vit_params_from_torch,
+    )
+
+    params = vit_params_from_torch(hf.state_dict(), num_layers=2,
+                                   num_heads=4)
+    model = get_model(ModelConfig(
+        name="vit", compute_dtype="float32",
+        extra=dict(num_layers=2, d_model=64, num_heads=4, mlp_dim=128,
+                   patch_size=8, num_classes=10)))
+    x = np.random.RandomState(4).randn(2, 32, 32, 3).astype(np.float32)
+    with torch.no_grad():
+        want = hf(torch.from_numpy(x.transpose(0, 3, 1, 2))).logits.numpy()
+    got = np.asarray(model.apply({"params": params}, jnp.asarray(x),
+                                 train=False))
+    np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
