@@ -52,7 +52,10 @@ PER_CHIP_BATCH = {
 # can raise UNAVAILABLE *or hang outright* (round 1's only hard failure —
 # BENCH_r01.json rc=1 — was one such blip). A hung in-process backend
 # init is unrecoverable (jax caches the dead client), so availability is
-# probed in a subprocess with a timeout, retried with backoff.
+# probed in a subprocess with a timeout, retried with backoff. Defaults
+# bound the worst case near 4 minutes: long enough to ride out a blip,
+# short enough that a hard-down tunnel still yields the structured
+# failure record before any outer harness timeout.
 _PROBE = (
     "from pytorch_distributed_nn_tpu.runtime.platform import "
     "apply_platform_overrides; apply_platform_overrides(); "
@@ -60,7 +63,7 @@ _PROBE = (
 )
 
 
-def wait_for_backend(attempts: int = 5, probe_timeout: float = 120.0,
+def wait_for_backend(attempts: int = 3, probe_timeout: float = 75.0,
                      ) -> str | None:
     """Block until `jax.devices()` works in a fresh subprocess.
 
@@ -355,10 +358,10 @@ def main(argv=None) -> int:
     ap.add_argument("--profile-dir", default="",
                     help="capture an XProf/TensorBoard trace of the "
                          "timed steps into this directory")
-    ap.add_argument("--probe-attempts", type=int, default=5,
+    ap.add_argument("--probe-attempts", type=int, default=3,
                     help="backend availability probes before giving up "
                          "with a structured failure record")
-    ap.add_argument("--probe-timeout", type=float, default=120.0,
+    ap.add_argument("--probe-timeout", type=float, default=75.0,
                     help="seconds before one availability probe counts "
                          "as hung")
     args = ap.parse_args(argv)
