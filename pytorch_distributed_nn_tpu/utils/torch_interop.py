@@ -636,3 +636,185 @@ def vit_params_from_torch(
         }
     sd.check_consumed(ignorable=("pooler",))
     return params
+
+
+def _tt(x):
+    # copy=True: jax.device_get hands back non-writable zero-copy host
+    # buffers, and torch.from_numpy would alias them (same hazard the
+    # llama exporter's t() documents). Dtype is preserved.
+    import torch
+
+    return torch.from_numpy(np.array(x, copy=True))
+
+
+def _ln_to_torch(sd: dict, prefix: str, leaf: Mapping[str, Any]) -> None:
+    sd[prefix + ".weight"] = _tt(leaf["scale"])
+    sd[prefix + ".bias"] = _tt(leaf["bias"])
+
+
+def _dense_to_torch(sd: dict, prefix: str,
+                    leaf: Mapping[str, Any]) -> None:
+    sd[prefix + ".weight"] = _tt(np.asarray(leaf["kernel"]).T)
+    if "bias" in leaf:
+        sd[prefix + ".bias"] = _tt(leaf["bias"])
+
+
+def _heads_in_to_torch(sd: dict, prefix: str,
+                       leaf: Mapping[str, Any]) -> None:
+    k = np.asarray(leaf["kernel"])  # (D, H, Dh)
+    d = k.shape[0]
+    sd[prefix + ".weight"] = _tt(k.reshape(d, -1).T)
+    sd[prefix + ".bias"] = _tt(np.asarray(leaf["bias"]).reshape(-1))
+
+
+def _heads_out_to_torch(sd: dict, prefix: str,
+                        leaf: Mapping[str, Any]) -> None:
+    k = np.asarray(leaf["kernel"])  # (H, Dh, D)
+    d = k.shape[-1]
+    sd[prefix + ".weight"] = _tt(k.reshape(-1, d).T)
+    sd[prefix + ".bias"] = _tt(leaf["bias"])
+
+
+def _layer_count(params: Mapping[str, Any], stem: str) -> int:
+    n = len([k for k in params if k.startswith(stem)])
+    if not n:
+        raise ValueError(f"no {stem}* entries in params")
+    return n
+
+
+def _maybe_untied_head(sd: dict, key: str, head: np.ndarray,
+                       embed: np.ndarray, tie_note: str) -> None:
+    """Stock HF LM heads are TIED to the embedding table (shared
+    storage), so a state_dict carrying both would let whichever loads
+    last clobber the other. When the trained head still equals the
+    embeddings, omit the head key — the tied model regenerates it.
+    When training has untied them, keep it and warn: such a checkpoint
+    must load into an untied config (tie_word_embeddings=False)."""
+    if head.shape == embed.shape and np.array_equal(head, embed):
+        return
+    import warnings
+
+    warnings.warn(
+        f"exported head {key!r} differs from the embedding table; "
+        f"{tie_note} by default, and loading this state_dict into a "
+        "tied model would silently clobber the embeddings — use an "
+        "untied config (tie_word_embeddings=False)", stacklevel=3)
+    sd[key] = _tt(head)
+
+
+def bert_params_to_torch(params: Mapping[str, Any]) -> dict:
+    """Inverse of :func:`bert_params_from_torch` (HF ``BertForMaskedLM``
+    key layout; the non-persistent ``position_ids`` buffer is omitted —
+    load with ``strict=False`` on transformers versions that still
+    register it)."""
+    sd: dict = {}
+    e = "bert.embeddings."
+    sd[e + "word_embeddings.weight"] = _tt(
+        params["tok_embed"]["embedding"])
+    sd[e + "position_embeddings.weight"] = _tt(
+        params["pos_embed"]["embedding"])
+    sd[e + "token_type_embeddings.weight"] = _tt(
+        params["type_embed"]["embedding"])
+    _ln_to_torch(sd, e + "LayerNorm", params["ln_embed"])
+    for i in range(_layer_count(params, "layer")):
+        p = f"bert.encoder.layer.{i}."
+        lp = params[f"layer{i}"]
+        _heads_in_to_torch(sd, p + "attention.self.query",
+                           lp["attn"]["query"])
+        _heads_in_to_torch(sd, p + "attention.self.key",
+                           lp["attn"]["key"])
+        _heads_in_to_torch(sd, p + "attention.self.value",
+                           lp["attn"]["value"])
+        _heads_out_to_torch(sd, p + "attention.output.dense",
+                            lp["attn"]["out"])
+        _ln_to_torch(sd, p + "attention.output.LayerNorm", lp["ln1"])
+        _dense_to_torch(sd, p + "intermediate.dense", lp["mlp_in"])
+        _dense_to_torch(sd, p + "output.dense", lp["mlp_out"])
+        _ln_to_torch(sd, p + "output.LayerNorm", lp["ln2"])
+    _dense_to_torch(sd, "cls.predictions.transform.dense",
+                    params["mlm_dense"])
+    _ln_to_torch(sd, "cls.predictions.transform.LayerNorm",
+                 params["mlm_ln"])
+    _maybe_untied_head(
+        sd, "cls.predictions.decoder.weight",
+        np.asarray(params["mlm_decoder"]["kernel"]).T,
+        np.asarray(params["tok_embed"]["embedding"]),
+        "BertForMaskedLM ties cls.predictions.decoder to the word "
+        "embeddings")
+    sd["cls.predictions.bias"] = _tt(params["mlm_decoder"]["bias"])
+    sd["cls.predictions.decoder.bias"] = sd["cls.predictions.bias"]
+    return sd
+
+
+def gpt2_params_to_torch(params: Mapping[str, Any]) -> dict:
+    """Inverse of :func:`gpt2_params_from_torch` (HF ``GPT2LMHeadModel``
+    layout: Conv1D weights stay (in, out), q/k/v re-fuse into
+    ``c_attn``, the LM head is emitted untied)."""
+    sd: dict = {}
+    sd["transformer.wte.weight"] = _tt(params["tok_embed"]["embedding"])
+    sd["transformer.wpe.weight"] = _tt(params["pos_embed"]["embedding"])
+
+    def conv1d(prefix, leaf):
+        sd[prefix + ".weight"] = _tt(leaf["kernel"])
+        sd[prefix + ".bias"] = _tt(leaf["bias"])
+
+    for i in range(_layer_count(params, "block")):
+        p = f"transformer.h.{i}."
+        bp = params[f"block{i}"]
+        _ln_to_torch(sd, p + "ln_1", bp["ln1"])
+        _ln_to_torch(sd, p + "ln_2", bp["ln2"])
+        qkv = bp["attn"]
+        d = np.asarray(qkv["query"]["kernel"]).shape[0]
+        sd[p + "attn.c_attn.weight"] = _tt(np.concatenate(
+            [np.asarray(qkv[n]["kernel"]).reshape(d, -1)
+             for n in ("query", "key", "value")], axis=1))
+        sd[p + "attn.c_attn.bias"] = _tt(np.concatenate(
+            [np.asarray(qkv[n]["bias"]).reshape(-1)
+             for n in ("query", "key", "value")]))
+        out = qkv["out"]
+        sd[p + "attn.c_proj.weight"] = _tt(
+            np.asarray(out["kernel"]).reshape(-1, d))
+        sd[p + "attn.c_proj.bias"] = _tt(out["bias"])
+        conv1d(p + "mlp.c_fc", bp["mlp_in"])
+        conv1d(p + "mlp.c_proj", bp["mlp_out"])
+    _ln_to_torch(sd, "transformer.ln_f", params["ln_f"])
+    _maybe_untied_head(
+        sd, "lm_head.weight",
+        np.asarray(params["lm_head"]["kernel"]).T,
+        np.asarray(params["tok_embed"]["embedding"]),
+        "GPT2LMHeadModel ties lm_head to transformer.wte")
+    return sd
+
+
+def vit_params_to_torch(params: Mapping[str, Any]) -> dict:
+    """Inverse of :func:`vit_params_from_torch`
+    (HF ``ViTForImageClassification`` layout)."""
+    import torch
+
+    sd: dict = {}
+    sd["vit.embeddings.cls_token"] = _tt(params["cls"])
+    sd["vit.embeddings.position_embeddings"] = _tt(params["pos_embed"])
+    sd["vit.embeddings.patch_embeddings.projection.weight"] = (
+        torch.from_numpy(np.asarray(params["patch_embed"]["kernel"],
+                                    np.float32)
+                         .transpose(3, 2, 0, 1).copy()))
+    sd["vit.embeddings.patch_embeddings.projection.bias"] = _tt(
+        params["patch_embed"]["bias"])
+    for i in range(_layer_count(params, "layer")):
+        p = f"vit.encoder.layer.{i}."
+        lp = params[f"layer{i}"]
+        _heads_in_to_torch(sd, p + "attention.attention.query",
+                           lp["attn"]["query"])
+        _heads_in_to_torch(sd, p + "attention.attention.key",
+                           lp["attn"]["key"])
+        _heads_in_to_torch(sd, p + "attention.attention.value",
+                           lp["attn"]["value"])
+        _heads_out_to_torch(sd, p + "attention.output.dense",
+                            lp["attn"]["out"])
+        _ln_to_torch(sd, p + "layernorm_before", lp["ln1"])
+        _ln_to_torch(sd, p + "layernorm_after", lp["ln2"])
+        _dense_to_torch(sd, p + "intermediate.dense", lp["mlp_in"])
+        _dense_to_torch(sd, p + "output.dense", lp["mlp_out"])
+    _ln_to_torch(sd, "vit.layernorm", params["ln_f"])
+    _dense_to_torch(sd, "classifier", params["head"])
+    return sd

@@ -172,9 +172,10 @@ def main(argv=None) -> int:
     mgr = CheckpointManager(args.export, async_save=False)
     state, meta = mgr.restore(trainer.state)
     mgr.close()
-    if args.arch not in ("llama3", "resnet50"):
+    exporters = ("llama3", "bert", "gpt2", "vit", "resnet50")
+    if args.arch not in exporters:
         raise SystemExit(
-            "export currently supports --arch llama3 | resnet50"
+            f"export supports --arch {' | '.join(exporters)}"
         )
     from pytorch_distributed_nn_tpu.utils import torch_interop as ti
 
@@ -201,7 +202,12 @@ def main(argv=None) -> int:
                                                   (3, 4, 6, 3))),
         )
     else:
-        sd = ti.llama_params_to_torch(host_params)
+        sd = {
+            "llama3": ti.llama_params_to_torch,
+            "bert": ti.bert_params_to_torch,
+            "gpt2": ti.gpt2_params_to_torch,
+            "vit": ti.vit_params_to_torch,
+        }[args.arch](host_params)
     torch.save(sd, args.torch_checkpoint)
     print(f"wrote torch state_dict: {args.torch_checkpoint} "
           f"(from step {meta['step']})")

@@ -105,6 +105,31 @@ def test_convert_gpt2_into_pipeline_preset(tmp_path):
     assert r.returncode == 0, r.stderr
     assert "final: step=1" in r.stdout, r.stdout
 
+    # and back out through the CLI export path (unstacks the pipeline
+    # params, re-fuses c_attn): the trained weights must load into a
+    # fresh untied HF GPT-2
+    back = tmp_path / "back.pt"
+    r = run_cli("scripts/convert.py", "--arch", "gpt2", "--preset",
+                "transformer_lm_pp", "--torch-checkpoint", str(back),
+                "--export", str(ckpt), *PIPE_OV)
+    assert r.returncode == 0, r.stderr[-2000:]
+    sd = torch.load(back, weights_only=True)
+    cfg_untied = transformers.GPT2Config(
+        vocab_size=128, n_positions=64, n_embd=48, n_layer=4, n_head=4,
+        layer_norm_epsilon=1e-5, activation_function="gelu_new",
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+        tie_word_embeddings=False)
+    hf2 = transformers.GPT2LMHeadModel(cfg_untied)
+    missing, unexpected = hf2.load_state_dict(sd, strict=False)
+    assert not unexpected, unexpected
+    # the exported checkpoint is the step-0 conversion (the train run
+    # wrote no new checkpoint), so the still-tied head is omitted too
+    assert all(".attn.bias" in k or ".attn.masked_bias" in k
+               or k == "lm_head.weight" for k in missing), missing
+    np.testing.assert_array_equal(
+        sd["transformer.h.0.mlp.c_fc.weight"].numpy(),
+        hf.state_dict()["transformer.h.0.mlp.c_fc.weight"].numpy())
+
 
 def test_convert_safetensors_and_eps_default(tmp_path):
     """HF .safetensors inputs load via safetensors.torch. (Norm eps

@@ -380,3 +380,121 @@ def test_vit_from_torch_logit_equivalence():
     got = np.asarray(model.apply({"params": params}, jnp.asarray(x),
                                  train=False))
     np.testing.assert_allclose(got, want, rtol=2e-4, atol=2e-4)
+
+
+def test_bert_export_reloads_into_hf():
+    """Export oracle: our params -> HF state_dict -> fresh HF model
+    must reproduce the ORIGINAL HF model's logits exactly."""
+    transformers = pytest.importorskip("transformers")
+    import torch
+
+    from pytorch_distributed_nn_tpu.utils.torch_interop import (
+        bert_params_from_torch,
+        bert_params_to_torch,
+    )
+
+    cfg = transformers.BertConfig(
+        vocab_size=128, hidden_size=48, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=96,
+        max_position_embeddings=64, type_vocab_size=2)
+    torch.manual_seed(6)
+    hf = transformers.BertForMaskedLM(cfg).eval()
+    params = bert_params_from_torch(hf.state_dict(), num_layers=2,
+                                    num_heads=4)
+    sd = bert_params_to_torch(params)
+    assert "cls.predictions.decoder.weight" not in sd  # tied, unchanged
+    hf2 = transformers.BertForMaskedLM(cfg).eval()
+    missing, unexpected = hf2.load_state_dict(sd, strict=False)
+    assert not unexpected, unexpected
+    assert all("position_ids" in k or "pooler" in k
+               or k == "cls.predictions.decoder.weight"
+               for k in missing), missing
+    x = torch.randint(0, 128, (2, 16))
+    with torch.no_grad():
+        np.testing.assert_array_equal(hf(x).logits.numpy(),
+                                      hf2(x).logits.numpy())
+
+
+def test_gpt2_export_reloads_into_hf():
+    transformers = pytest.importorskip("transformers")
+    import torch
+
+    from pytorch_distributed_nn_tpu.utils.torch_interop import (
+        gpt2_params_from_torch,
+        gpt2_params_to_torch,
+    )
+
+    cfg = transformers.GPT2Config(
+        vocab_size=128, n_positions=64, n_embd=48, n_layer=2, n_head=4,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+    torch.manual_seed(7)
+    hf = transformers.GPT2LMHeadModel(cfg).eval()
+    params = gpt2_params_from_torch(hf.state_dict(), num_layers=2,
+                                    num_heads=4)
+    sd = gpt2_params_to_torch(params)
+    # stock GPT-2 is tied: the unchanged head is omitted (the tied
+    # model regenerates it from wte)
+    assert "lm_head.weight" not in sd
+    hf2 = transformers.GPT2LMHeadModel(cfg).eval()
+    missing, unexpected = hf2.load_state_dict(sd, strict=False)
+    assert all(".attn.bias" in k or ".attn.masked_bias" in k
+               or k == "lm_head.weight" for k in missing), missing
+    assert not unexpected, unexpected
+    x = torch.randint(0, 128, (2, 16))
+    with torch.no_grad():
+        np.testing.assert_array_equal(hf(x).logits.numpy(),
+                                      hf2(x).logits.numpy())
+
+
+def test_vit_export_reloads_into_hf():
+    transformers = pytest.importorskip("transformers")
+    import torch
+
+    from pytorch_distributed_nn_tpu.utils.torch_interop import (
+        vit_params_from_torch,
+        vit_params_to_torch,
+    )
+
+    cfg = transformers.ViTConfig(
+        hidden_size=64, num_hidden_layers=2, num_attention_heads=4,
+        intermediate_size=128, image_size=32, patch_size=8,
+        num_channels=3, num_labels=10)
+    torch.manual_seed(8)
+    hf = transformers.ViTForImageClassification(cfg).eval()
+    params = vit_params_from_torch(hf.state_dict(), num_layers=2,
+                                   num_heads=4)
+    sd = vit_params_to_torch(params)
+    hf2 = transformers.ViTForImageClassification(cfg).eval()
+    missing, unexpected = hf2.load_state_dict(sd, strict=False)
+    assert not missing and not unexpected, (missing, unexpected)
+    x = torch.randn(2, 3, 32, 32)
+    with torch.no_grad():
+        np.testing.assert_array_equal(hf(x).logits.numpy(),
+                                      hf2(x).logits.numpy())
+
+
+def test_gpt2_export_warns_when_head_untied():
+    transformers = pytest.importorskip("transformers")
+    import torch
+    import warnings as w
+
+    from pytorch_distributed_nn_tpu.utils.torch_interop import (
+        gpt2_params_from_torch,
+        gpt2_params_to_torch,
+    )
+
+    cfg = transformers.GPT2Config(
+        vocab_size=64, n_positions=32, n_embd=32, n_layer=1, n_head=2,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+    torch.manual_seed(9)
+    hf = transformers.GPT2LMHeadModel(cfg)
+    params = gpt2_params_from_torch(hf.state_dict(), num_layers=1,
+                                    num_heads=2)
+    # training untied the head from the embeddings
+    params["lm_head"]["kernel"] = (
+        np.asarray(params["lm_head"]["kernel"]) + 1.0)
+    with w.catch_warnings(record=True) as caught:
+        w.simplefilter("always")
+        sd = gpt2_params_to_torch(params)
+    assert "lm_head.weight" in sd  # kept, since it carries information
+    assert any("clobber" in str(c.message) for c in caught), caught
