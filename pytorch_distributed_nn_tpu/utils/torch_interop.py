@@ -171,12 +171,7 @@ def llama_params_from_torch(
 def llama_params_to_torch(params: Mapping[str, Any]) -> dict:
     """Inverse of :func:`llama_params_from_torch`: params →
     HF-layout state dict of torch tensors."""
-    import torch
-
-    def t(a):
-        # copy=True: device_get can hand back non-writable views, which
-        # torch.from_numpy rejects (undefined behavior on write)
-        return torch.from_numpy(np.array(a, copy=True))
+    t = _tt  # shared copy=True/from_numpy helper
 
     out = {
         "model.embed_tokens.weight": t(params["tok_embed"]["embedding"]),
@@ -749,7 +744,9 @@ def bert_params_to_torch(params: Mapping[str, Any]) -> dict:
 def gpt2_params_to_torch(params: Mapping[str, Any]) -> dict:
     """Inverse of :func:`gpt2_params_from_torch` (HF ``GPT2LMHeadModel``
     layout: Conv1D weights stay (in, out), q/k/v re-fuse into
-    ``c_attn``, the LM head is emitted untied)."""
+    ``c_attn``). ``lm_head.weight`` appears ONLY when training untied
+    it from ``wte`` (see :func:`_maybe_untied_head`); stock tied
+    checkpoints regenerate the head from the embeddings on load."""
     sd: dict = {}
     sd["transformer.wte.weight"] = _tt(params["tok_embed"]["embedding"])
     sd["transformer.wpe.weight"] = _tt(params["pos_embed"]["embedding"])
@@ -789,15 +786,12 @@ def gpt2_params_to_torch(params: Mapping[str, Any]) -> dict:
 def vit_params_to_torch(params: Mapping[str, Any]) -> dict:
     """Inverse of :func:`vit_params_from_torch`
     (HF ``ViTForImageClassification`` layout)."""
-    import torch
-
     sd: dict = {}
     sd["vit.embeddings.cls_token"] = _tt(params["cls"])
     sd["vit.embeddings.position_embeddings"] = _tt(params["pos_embed"])
-    sd["vit.embeddings.patch_embeddings.projection.weight"] = (
-        torch.from_numpy(np.asarray(params["patch_embed"]["kernel"],
-                                    np.float32)
-                         .transpose(3, 2, 0, 1).copy()))
+    sd["vit.embeddings.patch_embeddings.projection.weight"] = _tt(
+        np.asarray(params["patch_embed"]["kernel"])
+        .transpose(3, 2, 0, 1))
     sd["vit.embeddings.patch_embeddings.projection.bias"] = _tt(
         params["patch_embed"]["bias"])
     for i in range(_layer_count(params, "layer")):
