@@ -51,3 +51,45 @@ def test_composed_grad_accum_step_has_no_involuntary_remat():
     assert "Involuntary full rematerialization" not in r.stderr, (
         "\n".join(l for l in r.stderr.splitlines() if "spmd" in l.lower())
     )
+
+
+_PIPE_TP_SCRIPT = r"""
+import jax
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)
+from pytorch_distributed_nn_tpu.config import get_config, MeshSpec
+from pytorch_distributed_nn_tpu.runtime.mesh import make_mesh
+from pytorch_distributed_nn_tpu.train.trainer import Trainer
+
+cfg = get_config("transformer_lm_pp", **{"steps": "1", "log_every": "1",
+                                         "data.prefetch": "0"})
+cfg.data.batch_size = 16
+cfg.data.seq_len = 16
+cfg.data.vocab_size = 101
+cfg.model.extra = dict(num_layers=4, d_model=32, num_heads=2,
+                       mlp_dim=64, vocab_size=101, max_len=64)
+cfg.model.remat = False
+cfg.parallel.microbatches = 2
+cfg.parallel.pipeline_schedule = "1f1b"
+cfg.mesh = MeshSpec(pipe=2, tensor=2, data=2)
+mesh = make_mesh(cfg.mesh.resolve(8))
+trainer = Trainer(cfg, mesh=mesh)
+trainer.train(1)  # compiles the partial-manual pipe x TP step
+print("PIPE_TP_OK")
+"""
+
+
+def test_pipe_tp_partial_manual_has_no_involuntary_remat():
+    """The partial-manual (tensor-auto) pipeline lowering is a separate
+    SPMD path from the zero/dp step: its resharding hygiene gets its
+    own guard."""
+    r = subprocess.run(
+        [sys.executable, "-c", _PIPE_TP_SCRIPT],
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        capture_output=True, text=True, timeout=420,
+    )
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "PIPE_TP_OK" in r.stdout
+    assert "Involuntary full rematerialization" not in r.stderr, (
+        "\n".join(l for l in r.stderr.splitlines() if "spmd" in l.lower())
+    )
