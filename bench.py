@@ -466,10 +466,18 @@ def main(argv=None) -> int:
     # best-effort: a FLOPs-counting failure must not discard the
     # already-measured throughput number
     flops_per_sample = mfu = None
-    mfu_error = None
+    mfu_error = compute_dtype = None
     try:
+        import jax.numpy as jnp
+
+        # judge MFU against the peak of the model's COMPUTE dtype: an
+        # f32 model hits the MXU at half the bf16 rate (ADVICE r2) —
+        # probed from the instance actually benched, not a rebuild
+        model_dtype = getattr(trainer.model, "dtype", None)
+        compute_dtype = str(jnp.dtype(model_dtype)) if model_dtype else None
         flops_per_sample = flops_mod.train_flops_per_sample(cfg)
-        mfu = flops_mod.mfu(per_chip_rate, flops_per_sample)
+        mfu = flops_mod.mfu(per_chip_rate, flops_per_sample,
+                            dtype=model_dtype)
     except Exception as e:  # noqa: BLE001
         mfu_error = f"{type(e).__name__}: {e}"
         print(f"# MFU computation failed: {mfu_error}", file=sys.stderr)
@@ -488,6 +496,7 @@ def main(argv=None) -> int:
             samples_per_sec_chip=round(per_chip_rate, 2),
             train_flops_per_sample=flops_per_sample,
             mfu=(round(mfu, 4) if mfu is not None else None),
+            compute_dtype=compute_dtype,
             **({"mfu_error": mfu_error} if mfu_error else {}),
         )
     print(json.dumps(rec))

@@ -33,21 +33,40 @@ _IDX_DTYPES = {0x08: np.uint8, 0x09: np.int8, 0x0B: np.int16,
                0x0C: np.int32, 0x0D: np.float32, 0x0E: np.float64}
 
 
+def _parse_idx_header(f, path) -> tuple[np.dtype, tuple[int, ...]]:
+    """Read the idx header from an open stream: [0, 0, dtype, ndim] then
+    ndim big-endian uint32 dims. Leaves ``f`` positioned at the data."""
+    zero, dtype_code, ndim = struct.unpack(">HBB", f.read(4))
+    if zero != 0 or dtype_code not in _IDX_DTYPES:
+        raise ValueError(f"{path}: not an idx file (magic "
+                         f"{zero:#06x}/{dtype_code:#04x})")
+    dims = struct.unpack(f">{ndim}I", f.read(4 * ndim))
+    return (np.dtype(_IDX_DTYPES[dtype_code]),
+            tuple(int(d) for d in dims))
+
+
+def _idx_opener(path):
+    return gzip.open if str(path).endswith(".gz") else open
+
+
+def read_idx_header(path: str | Path) -> tuple[np.dtype, tuple[int, ...]]:
+    """Parse only the idx header: (dtype, dims). Reads a handful of
+    bytes — cheap enough for shape probes (e.g. FLOPs counting) that
+    must not load a full corpus."""
+    with _idx_opener(path)(path, "rb") as f:
+        return _parse_idx_header(f, path)
+
+
 def read_idx(path: str | Path) -> np.ndarray:
     """Parse one idx(1|3)-ubyte file (optionally .gz) — the LeCun MNIST
-    container: [0, 0, dtype, ndim] then ndim big-endian uint32 dims,
-    then the raw array."""
-    opener = gzip.open if str(path).endswith(".gz") else open
-    with opener(path, "rb") as f:
-        zero, dtype_code, ndim = struct.unpack(">HBB", f.read(4))
-        if zero != 0 or dtype_code not in _IDX_DTYPES:
-            raise ValueError(f"{path}: not an idx file (magic "
-                             f"{zero:#06x}/{dtype_code:#04x})")
-        dims = struct.unpack(f">{ndim}I", f.read(4 * ndim))
+    container (header per :func:`_parse_idx_header`, then the raw
+    array)."""
+    with _idx_opener(path)(path, "rb") as f:
+        native_dtype, dims = _parse_idx_header(f, path)
         # idx stores multi-byte dtypes big-endian: the bytes must be
         # REINTERPRETED as '>' at frombuffer time (converting after a
         # native-endian read would keep the swapped values)
-        dtype = np.dtype(_IDX_DTYPES[dtype_code]).newbyteorder(">")
+        dtype = native_dtype.newbyteorder(">")
         data = np.frombuffer(f.read(), dtype=dtype)
     expected = int(np.prod(dims))
     if data.size != expected:

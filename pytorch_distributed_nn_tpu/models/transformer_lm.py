@@ -71,7 +71,11 @@ class TransformerLM(nn.Module):
     max_len: int = 2048
     dropout: float = 0.0
     # HF-conventional (GPT2Config.layer_norm_epsilon): converted
-    # checkpoints reproduce the original's logits without an override
+    # checkpoints reproduce the original's logits without an override.
+    # COMPAT: the round-1 default was 1e-6 (bert/vit: 1e-12) — a round-1
+    # checkpoint restored without extra={'ln_eps': 1e-6} sees slightly
+    # different forward math (same caveat class as the resnet padding
+    # note in models/resnet.py).
     ln_eps: float = 1e-5
     remat: bool = False
     attn_impl: str = "auto"

@@ -122,7 +122,7 @@ def _ring_attention_xla(q, k, v, *, axis: str = AXIS_SEQ,
     # fresh zeros are unvarying over the mesh; the scan carry becomes
     # device-varying after one block update, so mark the initials
     # varying up front or check_vma rejects the carry type change
-    m0, l0, acc0 = (lax.pvary(t, axis) for t in (m0, l0, acc0))
+    m0, l0, acc0 = (lax.pcast(t, axis, to='varying') for t in (m0, l0, acc0))
     # s-1 rotate-after-use rounds in the scan, then the last held block
     # outside it: the final rotation's output is never read, so don't
     # pay its 2 ppermutes of full KV shards.
@@ -170,7 +170,7 @@ def _ring_fused_impl(q, k, v, axis: str, causal: bool, interpret: bool):
     acc0 = jnp.zeros((B * H, Tl, D), jnp.float32)
     # see _ring_attention_xla: initials must be device-varying for the
     # scan carry to type-check under check_vma
-    m0, l0, acc0 = (lax.pvary(t, axis) for t in (m0, l0, acc0))
+    m0, l0, acc0 = (lax.pcast(t, axis, to='varying') for t in (m0, l0, acc0))
 
     def step(carry, i):
         k_blk, v_blk, m, l, acc = carry
@@ -280,7 +280,7 @@ def _ring_fused_bwd(axis, causal, interpret, res, g):
         def future(kv):
             zq = jnp.zeros((B * H, Tl, D), jnp.float32)
             zkv = jnp.zeros((B * Hkv, Tl, D), jnp.float32)
-            return tuple(lax.pvary(t, axis) for t in (zq, zkv, zkv))
+            return tuple(lax.pcast(t, axis, to='varying') for t in (zq, zkv, zkv))
 
         return lax.cond(
             src == idx,
@@ -305,7 +305,7 @@ def _ring_fused_bwd(axis, causal, interpret, res, g):
     dq0 = jnp.zeros((B * H, Tl, D), jnp.float32)
     dk0 = jnp.zeros((B * Hkv, Tl, D), jnp.float32)
     dv0 = jnp.zeros_like(dk0)
-    dq0, dk0, dv0 = (lax.pvary(t, axis) for t in (dq0, dk0, dv0))
+    dq0, dk0, dv0 = (lax.pcast(t, axis, to='varying') for t in (dq0, dk0, dv0))
     (kb, vb, dk, dv, dq), _ = lax.scan(
         step, (kb, vb, dk0, dv0, dq0), jnp.arange(s - 1)
     )

@@ -9,6 +9,18 @@ import jax.numpy as jnp
 import optax
 
 
+def valid_mask(labels) -> jnp.ndarray:
+    """THE ignore-index convention, in one place: targets >= 0 are
+    valid, negative targets (-1, torch ignore_index style) contribute
+    neither loss nor denominator. Every consumer of the convention —
+    masked_lm_xent, the smoothed variant, eval accuracy, and the 1F1B
+    pipeline's per-microbatch valid-count weighting
+    (parallel/pipeline.py) — must derive its mask here so a future
+    loss with different masking can't silently diverge from one path
+    only."""
+    return labels >= 0
+
+
 def softmax_xent(logits, labels) -> jnp.ndarray:
     """Classification: logits (B, C) float, labels (B,) int."""
     return optax.softmax_cross_entropy_with_integer_labels(
@@ -34,7 +46,7 @@ def masked_lm_xent(logits, labels) -> jnp.ndarray:
     divides by its shard's count before the pmean — which is precisely
     torch DDP's per-rank behavior for ignore_index losses (reference
     parity), not the global mean."""
-    valid = labels >= 0
+    valid = valid_mask(labels)
     per_tok = optax.softmax_cross_entropy_with_integer_labels(
         logits.astype(jnp.float32), jnp.maximum(labels, 0)
     )
@@ -160,7 +172,7 @@ def _smoothed(base, eps: float):
         )[..., 0]
         per = (1.0 - eps) * nll + eps * uniform
         if base is masked_lm_xent:
-            valid = labels >= 0
+            valid = valid_mask(labels)
             per = jnp.where(valid, per, 0.0)
             return per.sum() / jnp.maximum(valid.sum(), 1)
         return per.mean()

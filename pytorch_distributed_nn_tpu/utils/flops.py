@@ -39,14 +39,18 @@ PEAK_BF16_FLOPS = {
 }
 
 
-def peak_flops_per_chip(device=None) -> float | None:
-    """Peak dense bf16 FLOP/s for ``device`` (default: jax.devices()[0]),
-    or None when the chip is unknown (CPU test platform)."""
+def peak_flops_per_chip(device=None, dtype=None) -> float | None:
+    """Peak dense FLOP/s for ``device`` (default: jax.devices()[0]) at
+    ``dtype`` (default bf16), or None when the chip is unknown (CPU test
+    platform). TPUs run f32 matmuls at half the bf16 MXU rate, so an
+    f32-compute model's MFU must be judged against the f32 peak."""
     if device is None:
         device = jax.devices()[0]
     kind = (getattr(device, "device_kind", "") or "").lower()
     for key, val in PEAK_BF16_FLOPS.items():
         if key in kind:
+            if dtype is not None and jnp.dtype(dtype) == jnp.float32:
+                return val / 2.0
             return val
     return None
 
@@ -103,6 +107,21 @@ def _input_spec(cfg):
     if cfg.data.dataset == "cifar10_bin":
         return (32, 32, 3), np.float32
     if cfg.data.dataset == "mnist_idx":
+        # idx files encode arbitrary dims — probe the real header when a
+        # path is configured (a wrong hardcode would silently mis-scale
+        # MFU); (28, 28) only as the no-path default
+        if cfg.data.path:
+            from pathlib import Path
+
+            from pytorch_distributed_nn_tpu.data.readers import (
+                _find_one,
+                read_idx_header,
+            )
+
+            imgs = _find_one(Path(cfg.data.path), "train-images-idx3-ubyte")
+            if imgs is not None:
+                _, dims = read_idx_header(imgs)
+                return tuple(dims[1:]), np.float32
         return (28, 28), np.float32  # the idx standard layout
     if cfg.data.dataset == "image_folder":
         s = cfg.data.image_size
@@ -153,9 +172,11 @@ def lm_train_flops_per_token(n_params: int, n_layers: int,
 
 
 def mfu(samples_per_sec_chip: float, flops_per_sample: float,
-        device=None) -> float | None:
-    """Achieved / peak FLOPs for one chip; None off-TPU."""
-    peak = peak_flops_per_chip(device)
+        device=None, dtype=None) -> float | None:
+    """Achieved / peak FLOPs for one chip; None off-TPU. ``dtype`` is
+    the model's COMPUTE dtype (``model.dtype``): f32 runs against the
+    halved f32 peak (see peak_flops_per_chip)."""
+    peak = peak_flops_per_chip(device, dtype=dtype)
     if peak is None:
         return None
     return samples_per_sec_chip * flops_per_sample / peak

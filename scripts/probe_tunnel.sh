@@ -1,0 +1,15 @@
+#!/bin/bash
+# Poll the axon TPU tunnel until it answers, then exit 0.
+# Logs every attempt to scripts/tunnel_probe.log.
+LOG=/root/repo/scripts/tunnel_probe.log
+for i in $(seq 1 200); do
+  echo "[$(date -u +%FT%TZ)] probe $i" >> "$LOG"
+  if timeout 90 python -c "import jax; d=jax.devices(); assert d and d[0].platform=='tpu'; print(d)" >> "$LOG" 2>&1; then
+    echo "[$(date -u +%FT%TZ)] TUNNEL UP" >> "$LOG"
+    exit 0
+  fi
+  echo "[$(date -u +%FT%TZ)] down (rc=$?)" >> "$LOG"
+  sleep 480
+done
+echo "[$(date -u +%FT%TZ)] gave up after 200 probes" >> "$LOG"
+exit 1
