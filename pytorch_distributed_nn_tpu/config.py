@@ -100,7 +100,15 @@ class ParallelConfig:
     # memory grows with `microbatches`. "1f1b": PipeDream-flush with a
     # manual per-stage backward (parallel/pipeline.py::_make_1f1b_step)
     # — activation memory bounded by ~2*stages, dropout supported.
+    # "interleaved": Megatron virtual-chunk 1F1B — `pipe_chunks` chunks
+    # per device round-robin over virtual stages, pipeline bubble cut
+    # to ~1/pipe_chunks of 1f1b's at the cost of more in-flight
+    # activations and 2x ppermute traffic (full rings).
     pipeline_schedule: str = "gpipe"
+    # virtual chunks per device for pipeline_schedule='interleaved'
+    # (model layers must divide stages x chunks; microbatches must
+    # divide by stages — Megatron's group structure)
+    pipe_chunks: int = 1
     quantized_allreduce: str = ""  # "" | "bf16" | "int8" (EQuARX-style)
 
 

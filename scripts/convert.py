@@ -143,9 +143,14 @@ def main(argv=None) -> int:
                 stack_stage_params,
             )
 
+            interleaved = (cfg.parallel.pipeline_schedule
+                           == "interleaved")
             converted = stack_stage_params(
                 converted, partition_for(trainer.model),
                 max(cfg.mesh.pipe, 1),
+                n_chunks=(max(cfg.parallel.pipe_chunks, 1)
+                          if interleaved else 1),
+                chunked=interleaved,
             )
         from pytorch_distributed_nn_tpu.runtime.mesh import place_like
 
@@ -186,8 +191,13 @@ def main(argv=None) -> int:
             unstack_stage_params,
         )
 
-        params = unstack_stage_params(jax.device_get(params),
-                                      partition_for(trainer.model))
+        interleaved = cfg.parallel.pipeline_schedule == "interleaved"
+        params = unstack_stage_params(
+            jax.device_get(params), partition_for(trainer.model),
+            n_chunks=(max(cfg.parallel.pipe_chunks, 1)
+                      if interleaved else 1),
+            chunked=interleaved,
+        )
     host_params = jax.tree.map(
         lambda x: np.asarray(jax.device_get(x), np.float32), params
     )

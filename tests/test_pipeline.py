@@ -19,7 +19,8 @@ TINY_LLAMA = dict(num_layers=4, d_model=32, num_heads=4, num_kv_heads=2,
 
 def _train(strategy, mesh_spec, *, model="transformer_lm", extra=TINY_TLM,
            microbatches=4, devices=None, schedule="gpipe", steps=STEPS,
-           return_trainer=False, do_train=True, dataset=None):
+           return_trainer=False, do_train=True, dataset=None,
+           pipe_chunks=1):
     cfg = get_config(
         "transformer_lm_pp",
         **{"steps": str(steps), "log_every": "1", "data.prefetch": "0"},
@@ -36,6 +37,7 @@ def _train(strategy, mesh_spec, *, model="transformer_lm", extra=TINY_TLM,
     cfg.parallel.strategy = strategy
     cfg.parallel.microbatches = microbatches
     cfg.parallel.pipeline_schedule = schedule
+    cfg.parallel.pipe_chunks = pipe_chunks
     cfg.mesh = mesh_spec
     mesh = make_mesh(cfg.mesh.resolve(len(devices or jax.devices())),
                      devices=devices)
@@ -346,3 +348,104 @@ def test_1f1b_masked_loss_matches_gpipe():
     f = _train("pipeline", MeshSpec(pipe=2, data=4), schedule="1f1b",
                dataset="mlm_synthetic", **kw)
     np.testing.assert_allclose(f, g, rtol=2e-5, atol=1e-5)
+
+
+def test_interleaved_matches_single(single_losses):
+    """Interleaved (virtual-chunk) 1F1B — VERDICT r2 Missing #4: 2
+    chunks per device round-robin over 4 virtual stages, full-ring
+    ppermutes, inbox-buffered messages — must still reproduce
+    single-device training exactly."""
+    pp = _train("pipeline", MeshSpec(pipe=2, data=4),
+                schedule="interleaved", pipe_chunks=2)
+    np.testing.assert_allclose(pp, single_losses, rtol=2e-5, atol=1e-5)
+
+
+def test_interleaved_v1_matches_single(single_losses):
+    """v=1 degenerates to plain 1F1B timing (the schedule simulator
+    reproduces the closed-form table) — same goldens."""
+    pp = _train("pipeline", MeshSpec(pipe=4, data=2),
+                schedule="interleaved", pipe_chunks=1)
+    np.testing.assert_allclose(pp, single_losses, rtol=2e-5, atol=1e-5)
+
+
+def test_interleaved_llama_8layers_matches_1f1b():
+    """Deeper stack (8 layers over 4 devices x 2 chunks) on the Llama
+    family: interleaved must agree with plain 1f1b on the identical
+    run."""
+    extra = dict(TINY_LLAMA, num_layers=8)
+    ob = _train("pipeline", MeshSpec(pipe=4, data=2), model="llama3_8b",
+                extra=extra, schedule="1f1b")
+    il = _train("pipeline", MeshSpec(pipe=4, data=2), model="llama3_8b",
+                extra=extra, schedule="interleaved", pipe_chunks=2)
+    np.testing.assert_allclose(il, ob, rtol=2e-5, atol=1e-5)
+
+
+def test_interleaved_dropout_trains_and_evals():
+    """Dropout under interleaving (deterministic per-(mb, virtual
+    stage, shard) rng recomputed in the chunk backward), plus the eval
+    path's chunk-regroup to the fill-drain layout."""
+    extra = dict(TINY_TLM, dropout=0.2)
+    trainer = _train("pipeline", MeshSpec(pipe=2, data=4), extra=extra,
+                     schedule="interleaved", pipe_chunks=2, steps=12,
+                     return_trainer=True)
+    losses = np.array(trainer.losses())
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0]
+    rec = trainer.evaluate(num_batches=1)
+    assert np.isfinite(rec.loss)
+
+
+def test_interleaved_masked_loss_matches_gpipe():
+    """The valid-count microbatch weighting carries over to the
+    interleaved backward."""
+    g = _train("pipeline", MeshSpec(pipe=2, data=4), schedule="gpipe",
+               dataset="mlm_synthetic")
+    il = _train("pipeline", MeshSpec(pipe=2, data=4),
+                schedule="interleaved", pipe_chunks=2,
+                dataset="mlm_synthetic")
+    np.testing.assert_allclose(il, g, rtol=2e-5, atol=1e-5)
+
+
+def test_interleaved_stack_roundtrip():
+    """unstack(stack(v>1)) is the identity: the device-major chunk
+    permutation (stacked[d, j] = virtual stage j*S+d) inverts exactly
+    — the checkpoint-export contract."""
+    from pytorch_distributed_nn_tpu.models import get_model
+    from pytorch_distributed_nn_tpu.config import ModelConfig
+    from pytorch_distributed_nn_tpu.parallel.pipeline import (
+        partition_for, stack_stage_params, unstack_stage_params)
+
+    extra = dict(TINY_TLM, num_layers=8)
+    model = get_model(ModelConfig(name="transformer_lm",
+                                  compute_dtype="float32", extra=extra))
+    x = np.zeros((1, 8), np.int32)
+    params = model.init(jax.random.key(0), x, train=False)["params"]
+    part = partition_for(model)
+    stacked = stack_stage_params(params, part, 2, n_chunks=2)
+    # virtual stage layout check on one leaf: [d, j] == block j*2+d
+    leaf = stacked["stages"]["attn"]["query"]["kernel"]  # (S,v,Kc,D,D)
+    flat = np.stack(
+        [np.asarray(params[f"block{i}"]["attn"]["query"]["kernel"])
+         for i in range(8)])
+    S, v, Kc = leaf.shape[:3]
+    for d in range(S):
+        for j in range(v):
+            k = j * S + d
+            np.testing.assert_array_equal(
+                np.asarray(leaf)[d, j], flat[k * Kc:(k + 1) * Kc])
+    out = unstack_stage_params(stacked, part, n_chunks=2)
+    jax.tree.map(np.testing.assert_array_equal, out, params)
+
+
+def test_interleaved_rejections():
+    with pytest.raises(ValueError, match="divisible by stages"):
+        # M=4 microbatches not divisible by... M % S: S=2, M=3
+        _train("pipeline", MeshSpec(pipe=2, data=4), microbatches=3,
+               schedule="interleaved", pipe_chunks=2)
+    with pytest.raises(ValueError, match="chunks"):
+        # 4 layers don't divide 2 stages x 4 chunks
+        _train("pipeline", MeshSpec(pipe=2, data=4),
+               schedule="interleaved", pipe_chunks=4)
+    with pytest.raises(ValueError, match="interleaved"):
+        _train("pipeline", MeshSpec(pipe=2, data=2, tensor=2),
+               schedule="interleaved", pipe_chunks=2)

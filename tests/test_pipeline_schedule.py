@@ -56,3 +56,115 @@ def test_degenerate_sizes():
         one_f_one_b(0, 4)
     s = one_f_one_b(1, 1)
     assert s.n_ticks >= 2  # fwd tick then bwd tick
+
+
+# ---------------- interleaved (virtual-chunk) 1F1B ----------------
+
+from pytorch_distributed_nn_tpu.parallel.pipeline_schedule import (  # noqa: E402
+    interleaved_1f1b,
+)
+
+
+def _unit_ticks(s):
+    """{(virtual stage, mb): tick} for fwd and bwd units."""
+    S = s.n_stages
+    fwd, bwd = {}, {}
+    for t in range(s.n_ticks):
+        for d in range(S):
+            if s.fwd_chunk[t, d] != NO_OP:
+                fwd[(int(s.fwd_chunk[t, d]) * S + d,
+                     int(s.fwd_mb[t, d]))] = t
+            if s.bwd_chunk[t, d] != NO_OP:
+                bwd[(int(s.bwd_chunk[t, d]) * S + d,
+                     int(s.bwd_mb[t, d]))] = t
+    return fwd, bwd
+
+
+@pytest.mark.parametrize("S,v,M", [(2, 1, 4), (2, 2, 4), (2, 4, 8),
+                                   (4, 2, 8), (4, 4, 8), (8, 2, 16),
+                                   (3, 2, 6)])
+def test_interleaved_schedule_properties(S, v, M):
+    s = interleaved_1f1b(S, v, M)
+    Sv = S * v
+    fwd, bwd = _unit_ticks(s)
+
+    # completeness: every (virtual stage, microbatch) exactly once
+    assert len(fwd) == Sv * M and len(bwd) == Sv * M
+
+    for k in range(Sv):
+        for m in range(M):
+            tf, tb = fwd[(k, m)], bwd[(k, m)]
+            # backward strictly after own forward (saved-input read)
+            assert tb > tf
+            # forward input produced strictly earlier upstream —
+            # including across the device-0 wrap edge
+            if k > 0:
+                assert fwd[(k - 1, m)] < tf
+            # cotangent produced strictly earlier downstream
+            if k < Sv - 1:
+                assert bwd[(k + 1, m)] < tb
+
+    # inbox consistency: every read slot was written at-or-before, and
+    # no slot is clobbered while a message waits (allocator invariant:
+    # write tick of next occupant > read tick of previous)
+    for tbl_w, tbl_r in ((s.fin_write, s.fin_read),
+                         (s.bin_write, s.bin_read)):
+        for d in range(s.n_stages):
+            occupied = {}
+            for t in range(s.n_ticks):
+                wslot = int(tbl_w[t, d])
+                rslot = int(tbl_r[t, d])
+                if wslot != NO_OP:
+                    assert wslot not in occupied, "clobbered live slot"
+                    occupied[wslot] = t
+                if rslot != NO_OP:
+                    assert rslot in occupied, "read before write"
+                    del occupied[rslot]
+            assert not occupied, "message written but never consumed"
+
+    # act-buffer consistency (read-at-tick frees AFTER the read, and
+    # the tick body reads before it writes, so same-tick reuse is ok)
+    for d in range(s.n_stages):
+        occupied = set()
+        for t in range(s.n_ticks):
+            rslot = int(s.act_read[t, d])
+            if rslot != NO_OP:
+                assert rslot in occupied, "act read before write"
+                occupied.discard(rslot)
+            wslot = int(s.act_write[t, d])
+            if wslot != NO_OP:
+                assert wslot not in occupied, "act slot clobbered"
+                occupied.add(wslot)
+        assert not occupied
+
+
+def test_interleaved_v1_is_plain_1f1b():
+    """v=1 must reproduce the closed-form 1F1B table's tick count —
+    the simulator and the closed form agree on the degenerate case."""
+    for S, M in [(2, 4), (4, 8), (8, 16)]:
+        assert interleaved_1f1b(S, 1, M).n_ticks == one_f_one_b(S, M).n_ticks
+
+
+@pytest.mark.parametrize("S,v", [(2, 2), (4, 2), (4, 4), (8, 4), (8, 8)])
+def test_interleaved_bubble_is_one_over_v(S, v):
+    """THE point of interleaving (SURVEY.md §7(b)): bubble cut to 1/v.
+
+    Cost model: dead units are lax.cond'd out, and devices sync at the
+    per-tick ppermutes, so a tick costs the max live-unit count over
+    devices (in chunk units; one chunk = 1/v of a plain stage). The
+    schedule must hit the Megatron ratio EXACTLY, not approximately."""
+    M = 4 * S
+    si = interleaved_1f1b(S, v, M)
+    sp = one_f_one_b(S, M)
+    live_i = ((si.fwd_chunk != NO_OP).astype(int)
+              + (si.bwd_chunk != NO_OP).astype(int))
+    live_p = ((sp.fwd != NO_OP).astype(int)
+              + (sp.bwd != NO_OP).astype(int))
+    bubble_i = (live_i.max(1).sum() - 2 * v * M) / v  # plain-stage units
+    bubble_p = live_p.max(1).sum() - 2 * M
+    assert bubble_i == pytest.approx(bubble_p / v)
+
+
+def test_interleaved_rejects_bad_m():
+    with pytest.raises(ValueError, match="divisible"):
+        interleaved_1f1b(4, 2, 6)  # M % S != 0
