@@ -53,12 +53,30 @@ class StagePartition:
     ``block`` returns ``(y, aux)``: aux is the scalar sum of the
     block's sown "losses" collection (MoE load-balance terms; exactly
     0.0 for dense blocks), which the schedules thread into the training
-    objective."""
+    objective.
+
+    Mixed dense/MoE stacks (``moe_every = e > 1``): homogeneous
+    (S, K, ...) stacking can't hold heterogeneous layer trees, so the
+    stage params become TWO homogeneous stacks — ``{"dense", "moe"}``
+    subtrees — applied in (e-1 dense, 1 MoE) groups of ``period`` by
+    :func:`_stage_apply`. ``block`` then applies a DENSE layer and
+    ``moe_block`` the MoE layer closing each group."""
 
     block_names: list[str]  # ordered param-tree keys of the block stack
     embed: Callable  # (params, tokens) -> activations
     block: Callable  # (one_block_params, x, *, train, rng) -> (x, aux)
     head: Callable  # (params, x) -> logits
+    moe_block: Callable | None = None  # MoE layer flavor (mixed stacks)
+    period: int = 1  # layers per dense+MoE group (moe_every)
+
+    def split_names(self) -> tuple[list[str], list[str]]:
+        """(dense, moe) block names in layer order (mixed stacks)."""
+        e = self.period
+        dense = [n for i, n in enumerate(self.block_names)
+                 if i % e != e - 1]
+        moe = [n for i, n in enumerate(self.block_names)
+               if i % e == e - 1]
+        return dense, moe
 
 
 def _aux_block(block_mod):
@@ -87,18 +105,39 @@ def partition_for(model) -> StagePartition:
 
     from pytorch_distributed_nn_tpu.models.moe_lm import MoETransformerLM
 
-    if isinstance(model, MoETransformerLM) and model.moe_every != 1:
-        # alternating dense/MoE layers have heterogeneous param trees,
-        # which the homogeneous (S, K, ...) stage stacking cannot hold
-        raise ValueError(
-            "pipeline parallelism needs uniform blocks: MoE models "
-            "require moe_every=1 (every layer MoE); use the "
-            "expert-parallel mesh (strategy='dp'/'zero' + expert axis) "
-            "for mixed stacks"
-        )
     if isinstance(model, TransformerLM):
-        ffn = (model.layer_ffn(0)
-               if isinstance(model, MoETransformerLM) else None)
+        # MoE cadence: derived from the model's own layer_ffn hook (the
+        # single source of truth for which layers are MoE), validated
+        # against the periodic pattern split_names/_stage_apply_mixed
+        # assume — a changed convention fails HERE, loudly, not as an
+        # opaque stacking mismatch. moe_every=1 keeps ONE homogeneous
+        # stack (every block is MoE); e>1 splits into dense + MoE
+        # stacks applied in period-e groups (see StagePartition).
+        period = 1
+        moe_block = None
+        ffn = None
+        if isinstance(model, MoETransformerLM):
+            mask = [model.layer_ffn(i) is not None
+                    for i in range(model.num_layers)]
+            e = model.moe_every
+            if mask != [(i % e == e - 1)
+                        for i in range(model.num_layers)]:
+                raise ValueError(
+                    f"layer_ffn MoE placement {mask} is not the "
+                    f"(e-1 dense, 1 MoE) period-{e} pattern the mixed "
+                    f"stage stacking assumes — update "
+                    f"StagePartition.split_names/_stage_apply_mixed "
+                    f"alongside the model convention"
+                )
+            if e == 1:
+                ffn = model.layer_ffn(0)
+            else:
+                period = e
+                moe_mod = DecoderBlock(
+                    **model.block_kwargs(),
+                    ffn=model.layer_ffn(mask.index(True)),
+                )
+                moe_block = _aux_block(moe_mod)
         block_mod = DecoderBlock(**model.block_kwargs(), ffn=ffn)
         tok = nn.Embed(model.vocab_size, model.d_model,
                        param_dtype=model.param_dtype)
@@ -122,7 +161,9 @@ def partition_for(model) -> StagePartition:
             return lm_head.apply({"params": params["lm_head"]}, x)
 
         names = [f"block{i}" for i in range(model.num_layers)]
-        return StagePartition(names, embed, _aux_block(block_mod), head)
+        return StagePartition(names, embed, _aux_block(block_mod), head,
+                              moe_block=moe_block,
+                              period=period if moe_block else 1)
 
     if isinstance(model, Llama):
         block_mod = LlamaBlock(
@@ -175,49 +216,83 @@ def stack_stage_params(params: dict, part: StagePartition,
         raise ValueError(
             f"{L} blocks not divisible by {S} stages x {v} chunks"
         )
-    blocks = [params[name] for name in part.block_names]
-    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *blocks)
-    if not chunked:
-        return {
-            "stages": jax.tree.map(
-                lambda x: x.reshape((S, L // S) + x.shape[1:]), stacked
-            ),
-            "rest": {k: p for k, p in params.items()
-                     if k not in part.block_names},
+    rest = {k: p for k, p in params.items() if k not in part.block_names}
+    if part.period > 1:
+        K = L // (S * v)
+        if K % part.period:
+            raise ValueError(
+                f"each pipeline stage/chunk holds {K} layers — not "
+                f"divisible by moe_every={part.period}, so stages "
+                f"would split a dense+MoE group; choose stages/chunks "
+                f"aligned to whole groups"
+            )
+        dense_names, moe_names = part.split_names()
+        stages = {
+            "dense": _stack_subset(params, dense_names, S, v, chunked),
+            "moe": _stack_subset(params, moe_names, S, v, chunked),
         }
-    Kc = L // (S * v)
-    # flat (L, ...) -> (v, S, Kc, ...): index [j, d] is virtual stage
-    # j*S + d; transpose to device-major (S, v, Kc, ...)
-    stacked = jax.tree.map(
+        return {"stages": stages, "rest": rest}
+    return {"stages": _stack_subset(params, part.block_names, S, v,
+                                    chunked),
+            "rest": rest}
+
+
+def _stack_subset(params: dict, names: list[str], S: int, v: int,
+                  chunked: bool):
+    """Stack ``names``'s (homogeneous) block trees into (S, n/S, ...)
+    or, chunked, device-major (S, v, n/(Sv), ...) — index [d, j] is
+    virtual stage j*S + d (subsets inherit the layout because name
+    filtering preserves layer order and every stage contributes a
+    contiguous run)."""
+    blocks = [params[name] for name in names]
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *blocks)
+    n = len(names)
+    if not chunked:
+        return jax.tree.map(
+            lambda x: x.reshape((S, n // S) + x.shape[1:]), stacked
+        )
+    per = n // (S * v)
+    return jax.tree.map(
         lambda x: jnp.moveaxis(
-            x.reshape((v, S, Kc) + x.shape[1:]), 0, 1
+            x.reshape((v, S, per) + x.shape[1:]), 0, 1
         ),
         stacked,
     )
-    rest = {k: p for k, p in params.items() if k not in part.block_names}
-    return {"stages": stacked, "rest": rest}
 
 
 def unstack_stage_params(params: dict, part: StagePartition,
                          n_chunks: int = 1,
                          chunked: bool | None = None) -> dict:
     """Inverse of :func:`stack_stage_params` (for checkpoint export):
-    inverts the device-major permutation for chunked layouts."""
+    inverts the device-major permutation for chunked layouts and
+    re-interleaves mixed dense/MoE stacks."""
     stacked = params["stages"]
     if chunked is None:
         chunked = n_chunks > 1
-    if not chunked:
-        flat = jax.tree.map(
-            lambda x: x.reshape((-1,) + x.shape[2:]), stacked
-        )
-    else:
-        flat = jax.tree.map(
+
+    def unflatten(tree):
+        if not chunked:
+            return jax.tree.map(
+                lambda x: x.reshape((-1,) + x.shape[2:]), tree
+            )
+        return jax.tree.map(
             lambda x: jnp.moveaxis(x, 1, 0).reshape(
                 (-1,) + x.shape[3:]
             ),
-            stacked,
+            tree,
         )
+
     out = dict(params["rest"])
+    if part.period > 1:
+        dense_names, moe_names = part.split_names()
+        dflat = unflatten(stacked["dense"])
+        mflat = unflatten(stacked["moe"])
+        for i, name in enumerate(dense_names):
+            out[name] = jax.tree.map(lambda x: x[i], dflat)
+        for i, name in enumerate(moe_names):
+            out[name] = jax.tree.map(lambda x: x[i], mflat)
+        return out
+    flat = unflatten(stacked)
     for i, name in enumerate(part.block_names):
         out[name] = jax.tree.map(lambda x: x[i], flat)
     return out
@@ -285,6 +360,9 @@ def _stage_apply(part: StagePartition, stage_params, x, *,
     the K blocks. ``rng`` (dropout): folded per layer so every block
     draws a distinct mask — callers fold in microbatch and stage first,
     making the stream deterministic for backward recompute."""
+    if part.period > 1:
+        return _stage_apply_mixed(part, stage_params, x, train=train,
+                                  rng=rng)
     K = jax.tree.leaves(stage_params)[0].shape[0]
 
     if rng is None:
@@ -308,6 +386,46 @@ def _stage_apply(part: StagePartition, stage_params, x, *,
             body, (x, jnp.zeros((), jnp.float32)),
             (stage_params, jnp.arange(K)),
         )
+    return out, aux
+
+
+def _stage_apply_mixed(part: StagePartition, stage_params, x, *,
+                       train: bool, rng=None):
+    """Mixed dense/MoE stage (``moe_every = e > 1``): the stage holds
+    two homogeneous stacks — dense (K(e-1)/e, ...) and moe (K/e, ...)
+    — applied as a scan over K/e groups of (e-1 dense, 1 MoE) layers.
+    ``rng`` folds the ORIGINAL in-stage layer index (j*e + i), keeping
+    the dropout-mask convention identical to the homogeneous path."""
+    e = part.period
+    dense, moe = stage_params["dense"], stage_params["moe"]
+    g = jax.tree.leaves(moe)[0].shape[0]
+    dense = jax.tree.map(
+        lambda p: p.reshape((g, e - 1) + p.shape[1:]), dense
+    )
+
+    def group(carry, xs):
+        h, aux = carry
+        dp, mp, j = xs
+
+        def lay(c, xs2):
+            h2, a2 = c
+            p, i = xs2
+            r = (None if rng is None
+                 else jax.random.fold_in(rng, j * e + i))
+            h2, a = part.block(p, h2, train=train, rng=r)
+            return (h2, a2 + a), None
+
+        (h, aux), _ = lax.scan(lay, (h, aux),
+                               (dp, jnp.arange(e - 1)))
+        r = (None if rng is None
+             else jax.random.fold_in(rng, j * e + e - 1))
+        h, a = part.moe_block(mp, h, train=train, rng=r)
+        return (h, aux + a), None
+
+    (out, aux), _ = lax.scan(
+        group, (x, jnp.zeros((), jnp.float32)),
+        (dense, moe, jnp.arange(g)),
+    )
     return out, aux
 
 
