@@ -217,12 +217,61 @@ def check_ring_bwd() -> bool:
     return ok
 
 
+def check_long_context() -> bool:
+    """The streamed flash kernel at 128k-512k tokens on the REAL chip
+    (SURVEY.md §5 long-context row names 32k-512k; the CPU harness
+    can't execute these — T^2 on one host core trips XLA CPU's
+    collective rendezvous deadline, see __graft_entry__, which instead
+    AOT-compiles the 128k seq-sharded ring step). A dense oracle at
+    128k would materialize a 68 GB score matrix, so correctness at
+    these lengths rides the small-T oracle checks above; this check
+    proves the kernel's real-TPU tiling/DMA/VMEM behavior AT LENGTH:
+    fwd+bwd execute, outputs and grads finite, throughput printed."""
+    import time
+
+    on_tpu = jax.default_backend() == "tpu"
+    ok = True
+    rng = np.random.RandomState(6)
+    lengths = [1 << 17, 1 << 19] if on_tpu else [1 << 12]
+    for T in lengths:
+        B, H, D, Hkv = 1, 4, 64, 2
+        q = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32) * 0.3)
+        k = jnp.asarray(rng.randn(B, T, Hkv, D).astype(np.float32) * 0.3)
+        v = jnp.asarray(rng.randn(B, T, Hkv, D).astype(np.float32))
+
+        def f(q, k, v):
+            return (flash_attention(q, k, v, causal=True)
+                    .astype(jnp.float32) ** 2).sum()
+
+        grad_fn = jax.jit(jax.value_and_grad(f, argnums=(0, 1, 2)))
+        (val, grads) = grad_fn(q, k, v)  # compile + warm
+        # fence the warm-up through a host read: behind the axon
+        # tunnel block_until_ready can return early (bench.py does the
+        # same), which would start the timer mid-warm-up
+        float(val)
+        t0 = time.perf_counter()
+        val, grads = grad_fn(q, k, v)
+        jax.block_until_ready(grads)
+        # fence through a host read (axon tunnel: block_until_ready can
+        # return early — same workaround as bench.py)
+        finite = bool(np.isfinite(float(val)))
+        dt = time.perf_counter() - t0
+        for g in grads:
+            finite &= bool(jnp.isfinite(g).all())
+        ok &= finite
+        print(f"long-context flash fwd+bwd T={T}: {dt * 1e3:.1f} ms "
+              f"({T / dt:.0f} tok/s) finite={finite} "
+              f"{'OK' if finite else 'FAIL'}")
+    return ok
+
+
 def main() -> int:
     print(f"backend: {jax.default_backend()} devices: {jax.devices()}")
     if jax.default_backend() != "tpu":
         print("WARNING: not on TPU — validating fallbacks only")
     ok = (check_flash() & check_flash_grad() & check_quantize()
-          & check_ring_block() & check_ring_bwd())
+          & check_ring_block() & check_ring_bwd()
+          & check_long_context())
     print("ALL OK" if ok else "FAILURES")
     return 0 if ok else 1
 
