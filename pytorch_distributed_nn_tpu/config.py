@@ -68,6 +68,10 @@ class DataConfig:
     seq_len: int = 512
     vocab_size: int = 32000
     prefetch: int = 2  # background host batches kept ready (0 = sync)
+    # decode threads per batch for image_folder (torch DataLoader
+    # num_workers semantics: 0 = inline, -1 = one per core capped 16;
+    # PIL/libjpeg releases the GIL so threads scale across cores)
+    num_workers: int = -1
 
 
 @dataclass

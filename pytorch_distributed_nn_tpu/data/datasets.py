@@ -376,7 +376,7 @@ def get_dataset(name: str, *, seed: int, batch_size: int,
                 seq_len: int = 512, vocab_size: int = 32000,
                 path: str = "", token_dtype: str = "uint16",
                 sample: str = "shuffle", holdout_frac: float = 0.0,
-                image_size: int = 224):
+                image_size: int = 224, num_workers: int = 0):
     if name in _FILE_DATASETS and not path:
         raise ValueError(f"dataset {name!r} needs data.path")
     if name in ("mnist_idx", "cifar10_bin", "image_folder"):
@@ -392,7 +392,8 @@ def get_dataset(name: str, *, seed: int, batch_size: int,
                 holdout_frac=holdout_frac)
         return readers.ImageFolderDataset(
             path, seed, batch_size, sample=sample,
-            holdout_frac=holdout_frac, image_size=image_size)
+            holdout_frac=holdout_frac, image_size=image_size,
+            num_workers=num_workers)
     if name == "token_file":
         return TokenFileDataset(path, seed, batch_size, seq_len=seq_len,
                                 vocab_size=vocab_size,

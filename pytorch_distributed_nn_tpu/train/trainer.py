@@ -76,6 +76,7 @@ class Trainer:
             sample=cfg.data.sample,
             holdout_frac=cfg.data.holdout_frac,
             image_size=cfg.data.image_size,
+            num_workers=cfg.data.num_workers,
         )
         self.loader = DataLoader(self.dataset, self.mesh,
                                  prefetch=cfg.data.prefetch)
