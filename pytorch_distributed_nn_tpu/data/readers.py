@@ -290,3 +290,17 @@ class ImageFolderDataset(ArraySampler):
         else:
             x = np.stack([self._decode(p) for p in paths])
         return x, self.y[idx]
+
+    def close(self) -> None:
+        """Shut the decode pool down (idle threads otherwise persist
+        for the process lifetime — e.g. the bench worker sweep builds
+        one dataset per sweep point)."""
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+            self._pool = None
+
+    def __del__(self):  # best-effort; close() is the explicit path
+        try:
+            self.close()
+        except Exception:  # noqa: BLE001 — interpreter teardown
+            pass
