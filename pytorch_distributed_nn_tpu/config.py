@@ -103,7 +103,9 @@ class ParallelConfig:
     # transpose saves residuals for every in-flight tick, so activation
     # memory grows with `microbatches`. "1f1b": PipeDream-flush with a
     # manual per-stage backward (parallel/pipeline.py::_make_1f1b_step)
-    # — activation memory bounded by ~2*stages, dropout supported.
+    # — activation memory bounded by ~2*stages. All three schedules
+    # support dropout (shared deterministic rng stream; gpipe and 1f1b
+    # draw bit-identical masks).
     # "interleaved": Megatron virtual-chunk 1F1B — `pipe_chunks` chunks
     # per device round-robin over virtual stages, pipeline bubble cut
     # to ~1/pipe_chunks of 1f1b's at the cost of more in-flight

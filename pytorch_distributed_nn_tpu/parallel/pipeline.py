@@ -493,16 +493,24 @@ def _stage_sharding(mesh: Mesh, path: str, shape,
 
 
 def _pipelined_forward(part: StagePartition, mesh: Mesh, S: int, M: int,
-                       *, train: bool):
+                       *, train: bool, with_rng: bool = False):
     """The GPipe fill-drain FORWARD as a shard_map over ``pipe``:
-    (stage_params, x_mb (M, mb, T, D)) -> (last-stage outputs broadcast
-    to every stage for the replicated head, mean per-microbatch aux
-    loss). Differentiable (the AD transpose is the reverse fill-drain)
-    and reused verbatim by the forward-only pipeline eval path
-    (train=False, aux ignored)."""
+    (stage_params, x_mb (M, mb, T, D)[, rng]) -> (last-stage outputs
+    broadcast to every stage for the replicated head, mean
+    per-microbatch aux loss). Differentiable (the AD transpose is the
+    reverse fill-drain) and reused verbatim by the forward-only
+    pipeline eval path (train=False, aux ignored).
+
+    ``with_rng`` (dropout): the tick folds (rng, live microbatch,
+    stage, data shard) — the SAME stream convention as 1F1B's
+    ``mb_rng`` — so both schedules draw bit-identical masks and their
+    loss curves agree exactly (the cross-schedule dropout golden in
+    tests/test_pipeline.py). AD saves the mask-relevant residuals like
+    any other; fill/drain ticks draw garbage masks for garbage compute
+    that never reaches the objective."""
     fwd_edges = [(i, i + 1) for i in range(S - 1)]  # no wraparound
 
-    def pipelined_blocks(stage_params, x_mb):
+    def pipelined_blocks(stage_params, x_mb, rng=None):
         stage_params = jax.tree.map(lambda p: p.squeeze(0), stage_params)
         idx = lax.axis_index(AXIS_PIPE)
         mb_shape = x_mb.shape[1:]
@@ -514,7 +522,18 @@ def _pipelined_forward(part: StagePartition, mesh: Mesh, S: int, M: int,
             buf, outputs, aux_sum = carry
             feed = x_mb[jnp.clip(t, 0, M - 1)]
             x_in = jnp.where(idx == 0, feed, buf)
-            y, aux = _stage_apply(part, stage_params, x_in, train=train)
+            if rng is not None:
+                m_live = jnp.clip(t - idx, 0, M - 1)
+                r = jax.random.fold_in(
+                    jax.random.fold_in(rng, m_live), idx
+                )
+                r = jax.random.fold_in(
+                    r, lax.axis_index(("data", "fsdp"))
+                )
+            else:
+                r = None
+            y, aux = _stage_apply(part, stage_params, x_in, train=train,
+                                  rng=r)
             sent = lax.ppermute(y, AXIS_PIPE, fwd_edges)
             # fill/drain ticks compute garbage — their aux terms must
             # not reach the objective (stage s is live for t in
@@ -553,10 +572,12 @@ def _pipelined_forward(part: StagePartition, mesh: Mesh, S: int, M: int,
                         ("data", "fsdp")) / M
         return outputs, aux
 
+    in_specs = ((_STAGE_SPEC, _X_MB_SPEC, P()) if with_rng
+                else (_STAGE_SPEC, _X_MB_SPEC))
     return jax.shard_map(
         pipelined_blocks,
         mesh=mesh,
-        in_specs=(_STAGE_SPEC, _X_MB_SPEC),
+        in_specs=in_specs,
         out_specs=(_X_MB_SPEC, P()),
         axis_names=_pipeline_axis_names(mesh),
         check_vma=False,
@@ -661,25 +682,25 @@ def make_pipeline_train_step(cfg: TrainConfig, mesh: Mesh,
             "manual backward, depth-bounded activation memory), and "
             "'interleaved' (Megatron virtual chunks, ~1/v bubble)"
         )
-    if getattr(model, "dropout", 0.0):
-        raise ValueError(
-            "the gpipe schedule does not support dropout; use "
-            "pipeline_schedule='1f1b' (deterministic per-microbatch "
-            "rng, recomputed in its manual backward) or set model "
-            "dropout to 0"
-        )
     part = partition_for(model)
-    sharded_pipeline = _pipelined_forward(part, mesh, S, M, train=True)
+    use_dropout = bool(getattr(model, "dropout", 0.0))
+    sharded_pipeline = _pipelined_forward(part, mesh, S, M, train=True,
+                                          with_rng=use_dropout)
 
     def step(state: TrainState, tokens, targets):
         B = tokens.shape[0]
         if B % M:
             raise ValueError(f"batch {B} not divisible by {M} microbatches")
+        rng = (jax.random.fold_in(state.rng, state.step)
+               if use_dropout else None)
 
         def compute(params):
             h = part.embed(params["rest"], tokens)  # (B, T, D)
             h_mb = h.reshape((M, B // M) + h.shape[1:])
-            h_mb, aux = sharded_pipeline(params["stages"], h_mb)
+            if use_dropout:
+                h_mb, aux = sharded_pipeline(params["stages"], h_mb, rng)
+            else:
+                h_mb, aux = sharded_pipeline(params["stages"], h_mb)
             h = h_mb.reshape((B,) + h_mb.shape[2:])
             logits = part.head(params["rest"], h)
             return loss_fn(logits, targets) + aux
@@ -781,7 +802,7 @@ def _make_1f1b_step(cfg: TrainConfig, mesh: Mesh, loss_fn: Callable,
       divergent control flow; the tables guarantee sender/receiver
       liveness matches.
 
-    Dropout is supported (unlike gpipe): each microbatch/stage/layer
+    Dropout: each microbatch/stage/layer
     folds a deterministic rng, so the backward's recompute sees the
     identical masks its forward drew.
     """
