@@ -1012,8 +1012,11 @@ def _make_interleaved_step(cfg: TrainConfig, mesh: Mesh,
       devices but fixed (device, chunk) pairs, so the lax.switch
       branch index folds the chunk table in.
 
-    TP/EP inside interleaved stages (partial-manual lowering) is not
-    yet supported — compose TP with pipeline_schedule='1f1b'.
+    TP/EP compose exactly as under 1f1b: the tensor/expert axes stay
+    AUTO in the shard_map (partial-manual lowering) and the SPMD
+    partitioner runs Megatron TP / expert sharding inside each chunk
+    (goldens: tests/test_pipeline.py pipe x TP x interleaved and
+    pipe x EP x interleaved).
     """
     from pytorch_distributed_nn_tpu.parallel.pipeline_schedule import (
         NO_OP,
@@ -1021,11 +1024,6 @@ def _make_interleaved_step(cfg: TrainConfig, mesh: Mesh,
     )
 
     v = max(cfg.parallel.pipe_chunks, 1)
-    if _is_partial_manual(mesh):
-        raise ValueError(
-            "pipeline_schedule='interleaved' does not compose with "
-            "tensor/expert mesh axes yet; use '1f1b' for pipe x TP/EP"
-        )
     part = partition_for(model)
     L = len(part.block_names)
     if L % (S * v):

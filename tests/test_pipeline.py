@@ -189,16 +189,18 @@ def test_pipeline_eval_matches_dp_eval():
     np.testing.assert_allclose(rec.accuracy, rec_dp.accuracy, rtol=2e-5)
 
 
-@pytest.mark.parametrize("schedule", ["gpipe", "1f1b"])
+@pytest.mark.parametrize("schedule", ["gpipe", "1f1b", "interleaved"])
 def test_pipeline_x_tensor_parallel(single_losses, schedule):
     """pipe=2 x tensor=2 x data=2: stage params TP-sharded INSIDE
     stages (the `tensor` axis stays auto in the pipeline shard_map, so
-    the SPMD partitioner runs Megatron TP within each stage). Golden
-    vs single device, and the placed state must really carry `tensor`
-    in its stage-param shardings."""
+    the SPMD partitioner runs Megatron TP within each stage — under
+    the interleaved schedule the chunk dim just adds a leading None).
+    Golden vs single device, and the placed state must really carry
+    `tensor` in its stage-param shardings."""
     trainer = _train("pipeline", MeshSpec(pipe=2, tensor=2, data=2),
                      schedule=schedule, return_trainer=True,
-                     do_train=False)
+                     do_train=False,
+                     pipe_chunks=2 if schedule == "interleaved" else 1)
 
     specs = {
         "/".join(str(getattr(k, "key", k)) for k in kp):
@@ -485,6 +487,14 @@ def test_interleaved_rejections():
         # 4 layers don't divide 2 stages x 4 chunks
         _train("pipeline", MeshSpec(pipe=2, data=4),
                schedule="interleaved", pipe_chunks=4)
-    with pytest.raises(ValueError, match="interleaved"):
-        _train("pipeline", MeshSpec(pipe=2, data=2, tensor=2),
-               schedule="interleaved", pipe_chunks=2)
+
+
+def test_interleaved_x_expert_parallel(single_moe_losses):
+    """pipe=2 x expert=2 x data=2 under virtual chunks: expert weights
+    stay expert-sharded inside the chunked stages (auto axis), golden
+    vs single device."""
+    il = _train("pipeline", MeshSpec(pipe=2, expert=2, data=2),
+                model="moe_lm", extra=TINY_MOE, schedule="interleaved",
+                pipe_chunks=2)
+    np.testing.assert_allclose(il, single_moe_losses, rtol=2e-5,
+                               atol=1e-5)

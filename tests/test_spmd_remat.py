@@ -70,7 +70,8 @@ cfg.model.extra = dict(num_layers=4, d_model=32, num_heads=2,
                        mlp_dim=64, vocab_size=101, max_len=64)
 cfg.model.remat = False
 cfg.parallel.microbatches = 2
-cfg.parallel.pipeline_schedule = "1f1b"
+cfg.parallel.pipeline_schedule = "SCHEDULE"
+cfg.parallel.pipe_chunks = CHUNKS
 cfg.mesh = MeshSpec(pipe=2, tensor=2, data=2)
 mesh = make_mesh(cfg.mesh.resolve(8))
 trainer = Trainer(cfg, mesh=mesh)
@@ -79,12 +80,22 @@ print("PIPE_TP_OK")
 """
 
 
-def test_pipe_tp_partial_manual_has_no_involuntary_remat():
-    """The partial-manual (tensor-auto) pipeline lowering is a separate
-    SPMD path from the zero/dp step: its resharding hygiene gets its
-    own guard."""
+import pytest
+
+
+@pytest.mark.parametrize("schedule,chunks", [("1f1b", 1),
+                                             ("interleaved", 2)])
+def test_pipe_tp_partial_manual_has_no_involuntary_remat(schedule,
+                                                         chunks):
+    """The partial-manual (tensor-auto) pipeline lowerings are separate
+    SPMD paths from the zero/dp step: each schedule's resharding
+    hygiene gets its own guard (1f1b ring-buffer body; interleaved
+    chunk-table lax.switch + dynamic chunk slicing of (S, v, Kc, ...)
+    params)."""
+    script = (_PIPE_TP_SCRIPT.replace("SCHEDULE", schedule)
+              .replace("CHUNKS", str(chunks)))
     r = subprocess.run(
-        [sys.executable, "-c", _PIPE_TP_SCRIPT],
+        [sys.executable, "-c", script],
         cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         capture_output=True, text=True, timeout=420,
     )
