@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""One-shot on-chip round sweep (VERDICT r2 Next #1).
+
+Two rounds of perf claims rest on round-1 self-reports because the
+axon tunnel was down for all of round 2's build and judging. The
+moment the tunnel answers, run THIS — it captures every on-chip
+artifact in one pass, ordered so the most important land first if the
+tunnel flaps again:
+
+1. `scripts/validate_tpu_kernels.py` -> KERNELS_r{N}.json  (the Pallas
+   kernel gate: flash fwd/bwd, ring block, ring backward, int8
+   quantize, 128k/512k long-context — never yet recorded on real TPU)
+2. `bench.py` per preset (+ decode / loader / bus_bw)       -> ONCHIP_r{N}.json
+
+Each phase runs as a subprocess with a timeout, so a mid-sweep tunnel
+drop costs that phase only; everything captured so far is still
+written. Run `python scripts/onchip_sweep.py [--round N]` from the
+repo root with NO platform overrides (the default backend must be the
+TPU).
+"""
+
+import argparse
+import json
+import os
+import re
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+PRESETS = [
+    "mlp_mnist", "lenet_cifar10", "resnet50_dp", "bert_base_buckets",
+    "transformer_lm_pp", "llama3_8b_zero", "moe_lm_ep",
+    "llama3_longcontext",
+]
+METRICS = ["decode", "bus_bw", "loader"]
+
+
+def run(cmd: list[str], timeout: float) -> dict:
+    t0 = time.time()
+    try:
+        r = subprocess.run(cmd, cwd=REPO, capture_output=True,
+                           text=True, timeout=timeout)
+        return {"cmd": " ".join(cmd), "rc": r.returncode,
+                "stdout": r.stdout[-20000:], "stderr": r.stderr[-4000:],
+                "seconds": round(time.time() - t0, 1)}
+    except subprocess.TimeoutExpired as e:
+        # keep the partial output: on a mid-run tunnel flap the check
+        # lines printed before the hang are the salvageable evidence
+        out = e.stdout or b""
+        err = e.stderr or b""
+        if isinstance(out, bytes):
+            out = out.decode(errors="replace")
+        if isinstance(err, bytes):
+            err = err.decode(errors="replace")
+        return {"cmd": " ".join(cmd), "rc": None,
+                "stdout": out[-20000:],
+                "stderr": (err[-3000:]
+                           + f"\nTIMEOUT after {timeout:.0f}s"),
+                "seconds": round(time.time() - t0, 1)}
+
+
+def last_json_line(stdout: str):
+    for line in reversed(stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--round", type=int, default=3)
+    ap.add_argument("--kernel-timeout", type=float, default=1800)
+    ap.add_argument("--bench-timeout", type=float, default=900)
+    args = ap.parse_args()
+
+    # ---- 1) kernel gate ------------------------------------------------
+    kr = run([sys.executable, "scripts/validate_tpu_kernels.py"],
+             args.kernel_timeout)
+    checks = [ln for ln in kr["stdout"].splitlines()
+              if re.search(r"\b(OK|FAIL)\b", ln)]
+    backend_line = next((ln for ln in kr["stdout"].splitlines()
+                         if ln.startswith("backend:")), "")
+    kernels = {
+        "round": args.round,
+        # ok requires the REAL chip: the validator exits 0 on CPU
+        # fallbacks too, and a fallback pass must not certify the
+        # on-chip gate this artifact exists to record
+        "ok": (kr["rc"] == 0 and "ALL OK" in kr["stdout"]
+               and "tpu" in backend_line.lower()),
+        "on_tpu": "tpu" in backend_line.lower(),
+        "rc": kr["rc"],
+        "backend_line": backend_line,
+        "checks": checks,
+        "seconds": kr["seconds"],
+        **({"error": kr["stderr"]} if kr["rc"] != 0 else {}),
+    }
+    kpath = os.path.join(REPO, f"KERNELS_r{args.round:02d}.json")
+    with open(kpath, "w") as f:
+        json.dump(kernels, f, indent=1)
+    print(f"wrote {kpath}: ok={kernels['ok']} "
+          f"({len(checks)} check lines)")
+
+    # ---- 2) bench sweep ------------------------------------------------
+    records = {}
+    for preset in PRESETS:
+        r = run([sys.executable, "bench.py", "--preset", preset],
+                args.bench_timeout)
+        records[preset] = last_json_line(r["stdout"]) or {
+            "error": r["stderr"][-500:], "rc": r["rc"]}
+        print(f"{preset}: {json.dumps(records[preset])[:160]}")
+    for metric in METRICS:
+        cmd = [sys.executable, "bench.py", "--metric", metric]
+        if metric == "loader":
+            cmd += ["--preset", "resnet50_dp"]
+        elif metric == "bus_bw":
+            # THE BASELINE bus-bw claim is BERT fused buckets
+            cmd += ["--preset", "bert_base_buckets"]
+        r = run(cmd, args.bench_timeout)
+        records[f"metric:{metric}"] = last_json_line(r["stdout"]) or {
+            "error": r["stderr"][-500:], "rc": r["rc"]}
+        print(f"{metric}: {json.dumps(records[f'metric:{metric}'])[:160]}")
+
+    opath = os.path.join(REPO, f"ONCHIP_r{args.round:02d}.json")
+    with open(opath, "w") as f:
+        json.dump({"round": args.round, "records": records}, f, indent=1)
+    print(f"wrote {opath}")
+    return 0 if kernels["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -297,10 +297,15 @@ def test_moe_mixed_stack_misaligned_rejected():
                extra=extra, schedule="interleaved", pipe_chunks=2)
 
 
-def test_1f1b_checkpoint_resume_and_eval_cli(tmp_path):
-    """A 1F1B run checkpoints, resumes mid-run (same loss trajectory as
-    an uninterrupted run), and its stacked checkpoint evaluates through
-    scripts/eval.py — the stacked layout is schedule-independent."""
+@pytest.mark.parametrize("schedule,pipe,chunks",
+                         [("1f1b", 4, 1), ("interleaved", 2, 2)])
+def test_pipeline_checkpoint_resume_and_eval_cli(tmp_path, schedule,
+                                                 pipe, chunks):
+    """A manual-backward pipeline run checkpoints, resumes mid-run
+    (same loss trajectory as an uninterrupted run), and its stacked
+    checkpoint evaluates through scripts/eval.py — including the
+    interleaved (S, v, Kc) chunked stacking, whose restore template
+    and unstack must invert the device-major chunk permutation."""
     import json
     import os
     import subprocess
@@ -313,8 +318,9 @@ def test_1f1b_checkpoint_resume_and_eval_cli(tmp_path):
             '"vocab_size":101,"max_len":64}',
             "--model.remat", "false", "--model.compute_dtype", "float32",
             "--parallel.microbatches", "4",
-            "--parallel.pipeline_schedule", "1f1b",
-            "--mesh.pipe", "4", "--mesh.data", "2",
+            "--parallel.pipeline_schedule", schedule,
+            "--parallel.pipe_chunks", str(chunks),
+            "--mesh.pipe", str(pipe), "--mesh.data", str(8 // pipe),
             "--data.prefetch", "0"]
     env = dict(os.environ, JAX_PLATFORMS="cpu", JAX_NUM_CPU_DEVICES="8")
 
