@@ -41,8 +41,21 @@ def _attention_reference(q, k, v, *, causal: bool):
 STAT_LANES = 8  # minor dim of the m/l scratch (min f32 sublane tile)
 
 
+def _stat_subl(nq: int) -> int:
+    """Sublane-group height for the (BH, nq, block_q) lse/delta arrays.
+
+    TPU block tiling needs the last two block dims divisible by (8, 128)
+    or equal to the array dims, so a (1, block_q) per-row block is
+    illegal whenever nq > 1, and the whole (nq, block_q) plane OOMs the
+    16 MB scoped-vmem stack at T=512k (KERNELS_r03 first run: 2 MB x2
+    stats x double-buffering). Group-of-8 rows satisfies the sublane
+    tile and keeps stat VMEM residency T-independent (8*block_q f32)."""
+    return min(8, nq)
+
+
 def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
-                  acc_scr, *, causal: bool, block_q: int, block_k: int):
+                  acc_scr, *, causal: bool, block_q: int, block_k: int,
+                  subl: int):
     """One (bh, qi, kj) grid step. The kj grid dim iterates sequentially
     on TPU, so the f32 running stats (m, l, acc) live in VMEM scratch
     across k blocks: initialized at kj == 0, emitted at the last kj.
@@ -97,13 +110,17 @@ def _flash_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_scr, l_scr,
         )
         # logsumexp per row — the softmax stat the backward kernels
         # need to reconstruct p without a second online pass. Layout
-        # (BH, nq, block_q) with the whole (nq, block_q) plane resident:
-        # TPU block tiling rejects a (1, block_q) slice of (BH, T).
-        lse_ref[0, qi] = (m + jnp.log(jnp.maximum(l, 1e-30)))[:, 0]
+        # (BH, nq, block_q) in sublane groups of ``subl`` rows (see
+        # _stat_subl); this qi owns row qi % subl of its group block.
+        lse_ref[0, pl.ds(qi % subl, 1)] = (
+            m + jnp.log(jnp.maximum(l, 1e-30))
+        )[:, 0][None, :]
 
 
-@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k"))
-def _flash_bhtd(q, k, v, *, causal: bool, block_q: int, block_k: int):
+@functools.partial(jax.jit, static_argnames=("causal", "block_q", "block_k",
+                                             "interpret"))
+def _flash_bhtd(q, k, v, *, causal: bool, block_q: int, block_k: int,
+                interpret: bool = False):
     """(BH, T, D) flash attention via pallas_call (K/V streamed by the
     grid, so sequence length is not VMEM-bounded). Returns (out, lse).
 
@@ -117,8 +134,10 @@ def _flash_bhtd(q, k, v, *, causal: bool, block_q: int, block_k: int):
         raise ValueError(f"q heads {BH} not a multiple of kv heads {BKV}")
     q_per_kv = BH // BKV
     grid = (BH, pl.cdiv(T, block_q), pl.cdiv(T, block_k))
+    subl = _stat_subl(grid[1])
     kernel = functools.partial(
         _flash_kernel, causal=causal, block_q=block_q, block_k=block_k,
+        subl=subl,
     )
     if causal:
         # Dead (fully-future) K/V blocks are skipped by pl.when in the
@@ -145,8 +164,8 @@ def _flash_bhtd(q, k, v, *, causal: bool, block_q: int, block_k: int):
         out_specs=[
             pl.BlockSpec((1, block_q, D), lambda bh, qi, kj: (bh, qi, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, grid[1], block_q),
-                         lambda bh, qi, kj: (bh, 0, 0),
+            pl.BlockSpec((1, subl, block_q),
+                         lambda bh, qi, kj: (bh, qi // subl, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_shape=[
@@ -159,10 +178,10 @@ def _flash_bhtd(q, k, v, *, causal: bool, block_q: int, block_k: int):
             pltpu.VMEM((block_q, D), jnp.float32),
         ],
         compiler_params=pltpu.CompilerParams(
-            # qi must be 'arbitrary': the lse output block is constant
-            # in qi, and a megacore split over a parallel qi would give
-            # each core a private copy of the (nq, block_q) plane with
-            # only its own rows written — last writer wins
+            # qi must be 'arbitrary': consecutive qi share one lse group
+            # block (each writes its own row), and a megacore split over
+            # a parallel qi would give each core a private copy with only
+            # its own rows written — last writer wins
             dimension_semantics=("parallel", "arbitrary", "arbitrary"),
         ),
         cost_estimate=pl.CostEstimate(
@@ -172,15 +191,18 @@ def _flash_bhtd(q, k, v, *, causal: bool, block_q: int, block_k: int):
                 block_q, 1) * D) * q.dtype.itemsize,
             transcendentals=BH * T * T,
         ),
+        interpret=interpret,
     )(q, k, v)
 
 
 def _bwd_recompute(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
-                   qi, kj, *, causal: bool, block_q: int, block_k: int):
+                   qi, kj, *, causal: bool, block_q: int, block_k: int,
+                   subl: int):
     """Shared recompute for both backward passes: p from the saved lse
     and ds from the flash recurrence. Returns (q, k_blk, g_blk, p, ds)
     in f32 — the two kernels differ only in which products they
-    accumulate from these."""
+    accumulate from these. ``qi``'s stat row lives at qi % subl of the
+    fetched (subl, block_q) group block (see _stat_subl)."""
     scale = q_ref.shape[-1] ** -0.5
     q = q_ref[0].astype(jnp.float32)
     k_blk = k_ref[0].astype(jnp.float32)
@@ -195,15 +217,16 @@ def _bwd_recompute(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
             jnp.int32, (block_q, block_k), 1
         )
         s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-    p = jnp.exp(s - lse_ref[0, qi][:, None])
+    row = pl.ds(qi % subl, 1)
+    p = jnp.exp(s - lse_ref[0, row][0][:, None])
     dp = jnp.dot(g_blk, v_blk.T, preferred_element_type=jnp.float32)
-    ds = p * (dp - delta_ref[0, qi][:, None]) * scale
+    ds = p * (dp - delta_ref[0, row][0][:, None]) * scale
     return q, k_blk, g_blk, p, ds
 
 
 def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
                    dq_ref, dq_scr, *, causal: bool, block_q: int,
-                   block_k: int):
+                   block_k: int, subl: int):
     """dq pass: fixed Q block, stream K/V blocks (same grid shape and
     causal DMA clamp as the forward). p is reconstructed from the
     forward's lse, so no online-softmax rescan is needed."""
@@ -221,7 +244,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
     def _body():
         _, k_blk, _, _, ds = _bwd_recompute(
             q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, qi, kj,
-            causal=causal, block_q=block_q, block_k=block_k,
+            causal=causal, block_q=block_q, block_k=block_k, subl=subl,
         )
         dq_scr[...] += jnp.dot(ds, k_blk,
                                preferred_element_type=jnp.float32)
@@ -233,7 +256,7 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
 
 def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
                     dk_ref, dv_ref, dk_scr, dv_scr, *, causal: bool,
-                    block_q: int, block_k: int, nq: int):
+                    block_q: int, block_k: int, nq: int, subl: int):
     """dk/dv pass: fixed K/V block, stream Q blocks (roles swapped —
     the accumulators live with the K/V tile). The inner grid dim is
     ``g * nq + qi`` over the KV head's Q-head group (GQA): the group
@@ -255,7 +278,7 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref,
     def _body():
         q, _, g_blk, p, ds = _bwd_recompute(
             q_ref, k_ref, v_ref, g_ref, lse_ref, delta_ref, qi, kj,
-            causal=causal, block_q=block_q, block_k=block_k,
+            causal=causal, block_q=block_q, block_k=block_k, subl=subl,
         )
         dv_scr[...] += jnp.dot(p.T, g_blk,
                                preferred_element_type=jnp.float32)
@@ -293,10 +316,12 @@ def _flash_bwd_pallas(q, k, v, g, lse, delta, *, causal: bool,
     dq_dtype = out_dtype or q.dtype
     dkv_dtype = out_dtype or k.dtype
 
+    subl = _stat_subl(nq)
     q_map = lambda bh, qi, kj: (bh, qi, 0)  # noqa: E731
-    # stats: whole (nq, block_q) plane resident (128 KB f32 at T=32k)
-    stat_map = lambda bh, qi, kj: (bh, 0, 0)  # noqa: E731
-    stat_block = (1, nq, block_q)
+    # stats: one (subl, block_q) sublane group per subl consecutive qi —
+    # VMEM use is T-independent (see _stat_subl)
+    stat_map = lambda bh, qi, kj: (bh, qi // subl, 0)  # noqa: E731
+    stat_block = (1, subl, block_q)
     if causal:
         def kv_map(bh, qi, kj):
             last_live = ((qi + 1) * block_q - 1) // block_k
@@ -307,7 +332,7 @@ def _flash_bwd_pallas(q, k, v, g, lse, delta, *, causal: bool,
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, causal=causal,
-                          block_q=block_q, block_k=block_k),
+                          block_q=block_q, block_k=block_k, subl=subl),
         grid=(BH, nq, nk),
         in_specs=[
             pl.BlockSpec((1, block_q, D), q_map, memory_space=pltpu.VMEM),
@@ -331,20 +356,28 @@ def _flash_bwd_pallas(q, k, v, g, lse, delta, *, causal: bool,
     # group's Q heads and their Q blocks (inner = g * nq + qi) so every
     # contribution to this KV head lands in one VMEM accumulator.
     kv_fix = lambda bkv, kj, inner: (bkv, kj, 0)  # noqa: E731
-    stat_fix = lambda bkv, kj, inner: (  # noqa: E731
-        bkv * q_per_kv + inner // nq, 0, 0)
     if causal:
-        def q_stream(bkv, kj, inner):
-            first_live = (kj * block_k) // block_q
-            return (bkv * q_per_kv + inner // nq,
-                    jnp.maximum(inner % nq, first_live), 0)
+        # clamp dead (fully-future-of-this-KV-block) Q rows to the
+        # first live one: the kernel's `live` gate skips them, and the
+        # repeated block index lets Pallas elide their DMAs — stats
+        # ride the same clamped row's group so dead steps copy nothing
+        # either (on live steps the clamp is the identity, so the
+        # fetched group always holds the kernel's qi % subl row)
+        def _qi(kj, inner):
+            return jnp.maximum(inner % nq, (kj * block_k) // block_q)
     else:
-        def q_stream(bkv, kj, inner):
-            return (bkv * q_per_kv + inner // nq, inner % nq, 0)
+        def _qi(kj, inner):
+            return inner % nq
+
+    q_stream = lambda bkv, kj, inner: (  # noqa: E731
+        bkv * q_per_kv + inner // nq, _qi(kj, inner), 0)
+    stat_fix = lambda bkv, kj, inner: (  # noqa: E731
+        bkv * q_per_kv + inner // nq, _qi(kj, inner) // subl, 0)
 
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, causal=causal,
-                          block_q=block_q, block_k=block_k, nq=nq),
+                          block_q=block_q, block_k=block_k, nq=nq,
+                          subl=subl),
         grid=(BKV, nk, q_per_kv * nq),
         in_specs=[
             pl.BlockSpec((1, block_q, D), q_stream,

@@ -57,13 +57,16 @@ def check_flash_grad() -> bool:
     nq=nk=1) and T=2048 (multi-block — the qi-indexed lse plane, the
     causal live/clamp index maps, and cross-block scratch accumulation
     only execute when nq, nk > 1, and that is the only regime 'auto'
-    uses flash in)."""
+    uses flash in). T=1152 forces block 128 (sole divisor), nq=9:
+    the sublane-grouped lse/delta blocking (_stat_subl) gets a PARTIAL
+    tail group (1 valid row of 8) — out-of-bounds stat blocks on dim -2
+    only exist on the real chip, interpret mode can't catch them."""
     ok = True
     rng = np.random.RandomState(4)
     # Hkv < H covers the GQA backward: grouped dk/dv accumulated over
     # the head group inside the dkv kernel's inner grid dim
     for (B, T, H, D, Hkv) in [(2, 512, 4, 64, 4), (1, 2048, 4, 64, 4),
-                              (1, 2048, 4, 64, 2)]:
+                              (1, 2048, 4, 64, 2), (1, 1152, 4, 64, 2)]:
         q = jnp.asarray(rng.randn(B, T, H, D).astype(np.float32)) * 0.3
         k = jnp.asarray(rng.randn(B, T, Hkv, D).astype(np.float32)) * 0.3
         v = jnp.asarray(rng.randn(B, T, Hkv, D).astype(np.float32))

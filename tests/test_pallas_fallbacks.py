@@ -153,6 +153,60 @@ def test_flash_blockwise_backward_matches_autodiff():
             assert err < 1e-4, (causal, name, err)
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_flash_kernels_grouped_stats_interpret(causal):
+    """Interpret-mode regression for the sublane-grouped lse/delta
+    blocking (_stat_subl): nq=12 gives subl=8 with a PARTIAL tail group
+    (rows 8-11), plus GQA group addressing — the geometry where the
+    qi % subl row store, the qi // subl group maps, and the causal
+    clamp in stat_fix can all go wrong while every nq==1 test stays
+    green (KERNELS_r03: the per-qi-row variant only failed on chip)."""
+    from pytorch_distributed_nn_tpu.ops.pallas.flash_attention import (
+        _attention_reference,
+        _flash_bhtd,
+        _flash_bwd_pallas,
+    )
+
+    rng = np.random.RandomState(7)
+    BH, BKV, T, D, blk = 4, 2, 96, 16, 8  # nq = 12 -> subl = 8
+    q = jnp.asarray(rng.randn(BH, T, D), jnp.float32) * 0.3
+    k = jnp.asarray(rng.randn(BKV, T, D), jnp.float32) * 0.3
+    v = jnp.asarray(rng.randn(BKV, T, D), jnp.float32)
+    g = jnp.asarray(rng.randn(BH, T, D), jnp.float32)
+    kx = jnp.repeat(k, BH // BKV, axis=0)
+    vx = jnp.repeat(v, BH // BKV, axis=0)
+
+    out, lse = _flash_bhtd(q, k, v, causal=causal, block_q=blk,
+                           block_k=blk, interpret=True)
+    ref_out, vjp = jax.vjp(
+        lambda a, b, c: _attention_reference(a, b, c, causal=causal),
+        q, kx, vx,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref_out),
+                               rtol=2e-5, atol=2e-5)
+    # lse rows must land in the right (group, row) slots
+    scale = D ** -0.5
+    s = jnp.einsum("btd,bsd->bts", q, kx) * scale
+    if causal:
+        s = jnp.where(jnp.tril(jnp.ones((T, T), bool))[None], s, -1e30)
+    want_lse = jax.nn.logsumexp(s, axis=-1).reshape(BH, T // blk, blk)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(want_lse),
+                               rtol=1e-5, atol=1e-5)
+
+    delta = jnp.sum(out.astype(jnp.float32) * g, -1).reshape(
+        BH, T // blk, blk)
+    dq, dk, dv = _flash_bwd_pallas(q, k, v, g, lse, delta, causal=causal,
+                                   block_q=blk, block_k=blk,
+                                   interpret=True)
+    want_dq, want_dkx, want_dvx = vjp(g)
+    want_dk = want_dkx.reshape(BKV, BH // BKV, T, D).sum(1)
+    want_dv = want_dvx.reshape(BKV, BH // BKV, T, D).sum(1)
+    for got, want, name in [(dq, want_dq, "dq"), (dk, want_dk, "dk"),
+                            (dv, want_dv, "dv")]:
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=2e-4, atol=2e-4, err_msg=name)
+
+
 def test_flash_rejects_cross_length():
     import jax.numpy as jnp
     import pytest
