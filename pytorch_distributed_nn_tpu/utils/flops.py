@@ -39,6 +39,12 @@ PEAK_BF16_FLOPS = {
 }
 
 
+class CostModelUnavailable(RuntimeError):
+    """No reachable in-process backend implements the HLO cost model
+    (the axon TPU plugin has none, and JAX_PLATFORMS may be pinned so
+    no cpu backend is registered either)."""
+
+
 def peak_flops_per_chip(device=None, dtype=None) -> float | None:
     """Peak dense FLOP/s for ``device`` (default: jax.devices()[0]) at
     ``dtype`` (default bf16), or None when the chip is unknown (CPU test
@@ -78,7 +84,23 @@ def fwd_flops(model, x_shape: tuple, x_dtype) -> float:
     lowered = jax.jit(fwd).lower(variables, x)
     analysis = lowered.cost_analysis()
     if not isinstance(analysis, dict) or "flops" not in analysis:
-        raise RuntimeError(
+        # Some PJRT plugins (the axon TPU tunnel) implement no
+        # pre-compile HLO cost model and return None (ONCHIP_r03 first
+        # sweep: every preset's mfu was null). The count is a property
+        # of the traced HLO, not the backend, so redo the lowering on
+        # the host CPU backend — same trace, same shapes, same convs
+        # and dots — and read the cost model there. (CostModelUnavailable
+        # when no cpu backend is registered in-process — JAX_PLATFORMS
+        # pinned to the TPU plugin — which train_flops_per_sample
+        # handles with a JAX_PLATFORMS=cpu subprocess.)
+        try:
+            cpu = jax.local_devices(backend="cpu")[0]
+        except RuntimeError as e:
+            raise CostModelUnavailable(str(e)) from e
+        with jax.default_device(cpu):
+            analysis = jax.jit(fwd).lower(variables, x).cost_analysis()
+    if not isinstance(analysis, dict) or "flops" not in analysis:
+        raise CostModelUnavailable(
             f"XLA cost analysis returned no flops: {analysis!r}"
         )
     return float(analysis["flops"])
@@ -138,12 +160,17 @@ def _input_spec(cfg):
     return spec.x_shape, spec.x_dtype
 
 
-def train_flops_per_sample(cfg) -> float:
+def train_flops_per_sample(cfg, _subprocess_ok: bool = True) -> float:
     """Analytic training FLOPs for ONE sample of ``cfg``'s model on
     ``cfg``'s data shapes: 3 x forward (see module docstring).
 
     For LMs a "sample" is one full sequence of ``cfg.data.seq_len``
     tokens, matching how the bench counts samples/sec.
+
+    When the in-process count fails because no backend with a cost
+    model is reachable (JAX_PLATFORMS pinned to the axon TPU plugin,
+    which has none, with no cpu registered), the count reruns in a
+    JAX_PLATFORMS=cpu subprocess — pure host work, a few seconds.
     """
     from pytorch_distributed_nn_tpu.models import get_model
 
@@ -160,7 +187,56 @@ def train_flops_per_sample(cfg) -> float:
     )
     model = get_model(model_cfg)
     x_shape, x_dtype = _input_spec(cfg)
-    return 3.0 * fwd_flops(model, (1, *x_shape), x_dtype)
+    try:
+        return 3.0 * fwd_flops(model, (1, *x_shape), x_dtype)
+    except CostModelUnavailable:
+        # only the missing-cost-model case is retried out of process;
+        # genuine lowering/tracing failures propagate with their full
+        # in-process traceback
+        if not _subprocess_ok:
+            raise
+        return _train_flops_subprocess(cfg)
+
+
+def _train_flops_subprocess(cfg) -> float:
+    """train_flops_per_sample in a fresh JAX_PLATFORMS=cpu interpreter
+    (the config pickles; the model/trace does not need to)."""
+    import os
+    import pickle
+    import subprocess
+    import sys
+    import tempfile
+
+    fd, path = tempfile.mkstemp(suffix=".pkl")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            pickle.dump(cfg, f)
+        code = (
+            "import os, pickle, sys\n"
+            "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+            "from pytorch_distributed_nn_tpu.runtime.platform import "
+            "apply_platform_overrides\n"
+            "apply_platform_overrides()\n"
+            "from pytorch_distributed_nn_tpu.utils.flops import "
+            "train_flops_per_sample\n"
+            f"cfg = pickle.load(open({path!r}, 'rb'))\n"
+            "print('FLOPS_PER_SAMPLE=%r'\n"
+            "      % train_flops_per_sample(cfg, _subprocess_ok=False))\n"
+        )
+        r = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=600, cwd=os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__)))),
+        )
+        for line in r.stdout.splitlines():
+            if line.startswith("FLOPS_PER_SAMPLE="):
+                return float(line.split("=", 1)[1])
+        raise RuntimeError(
+            f"subprocess FLOPs count failed (rc {r.returncode}): "
+            f"{r.stderr[-500:]}"
+        )
+    finally:
+        os.unlink(path)
 
 
 def lm_train_flops_per_token(n_params: int, n_layers: int,

@@ -171,3 +171,20 @@ def test_reader_input_specs_match_real_readers(tmp_path):
         shape, dtype = flops_mod._input_spec(cfg)
         assert shape == spec.x_shape, name
         assert dtype == spec.x_dtype, name
+
+
+def test_train_flops_subprocess_fallback(monkeypatch):
+    """When no in-process backend has a cost model (the axon TPU plugin
+    with JAX_PLATFORMS pinned — ONCHIP_r03 first sweep: every mfu was
+    null), train_flops_per_sample must recover via the
+    JAX_PLATFORMS=cpu subprocess and agree with the in-process count."""
+    cfg = get_config("mlp_mnist")
+    want = flops_mod.train_flops_per_sample(cfg)
+
+    def no_cost_model(*a, **k):
+        raise flops_mod.CostModelUnavailable(
+            "XLA cost analysis returned no flops: None")
+
+    monkeypatch.setattr(flops_mod, "fwd_flops", no_cost_model)
+    got = flops_mod.train_flops_per_sample(cfg)
+    assert got == pytest.approx(want, rel=1e-9)
