@@ -32,6 +32,7 @@ NOMINAL = {
     "llama3_8b_zero": None,
     "moe_lm_ep": None,
     "llama3_longcontext": None,
+    "llama3_longcontext_96k": None,
 }
 
 # Per-chip batch sizes tuned for one v5e chip (16 GB HBM).
@@ -45,6 +46,7 @@ PER_CHIP_BATCH = {
     "moe_lm_ep": 8,
     "llama3_longcontext": 2,  # 32k tokens/sample (GQA-native flash keeps
                               # KV unexpanded, freeing HBM for batch 2)
+    "llama3_longcontext_96k": 1,  # 96k tokens/sample
 }
 
 
@@ -448,6 +450,11 @@ def main(argv=None) -> int:
     ap.add_argument("--probe-timeout", type=float, default=75.0,
                     help="seconds before one availability probe counts "
                          "as hung")
+    ap.add_argument("--set", action="append", default=[], dest="overrides",
+                    metavar="a.b=c",
+                    help="dotted config override applied after the "
+                         "preset (repeatable), e.g. --set model.remat="
+                         "false — for on-chip A/B experiments")
     args = ap.parse_args(argv)
 
     from pytorch_distributed_nn_tpu.runtime.platform import (
@@ -474,7 +481,11 @@ def main(argv=None) -> int:
 
     n_chips = len(jax.devices())
     per_chip = args.per_chip_batch or PER_CHIP_BATCH[args.preset]
-    cfg = get_config(args.preset)
+    # keys the operator pinned with --set: the single-chip fix-ups
+    # below must not clobber an explicit A/B choice
+    explicit = {kv.split("=", 1)[0] for kv in args.overrides}
+    cfg = get_config(args.preset,
+                     **dict(kv.split("=", 1) for kv in args.overrides))
     cfg.steps = args.warmup + args.steps
     cfg.log_every = 0  # no host syncs in the timed loop
     cfg.data.batch_size = per_chip * n_chips
@@ -489,18 +500,25 @@ def main(argv=None) -> int:
         # and tests on the virtual mesh).
         cfg.mesh.pipe = 1
         cfg.parallel.strategy = "dp"
+        # the preset's remat serves the 4-stage pod memory budget; the
+        # 1-chip DP fallback fits outright and MFU counts recompute as
+        # zero useful work (measured: 68 -> 81 samples/s)
+        if "model.remat" not in explicit:
+            cfg.model.remat = False
 
     if args.preset == "llama3_8b_zero" and n_chips < 8:
         cfg.model.extra = dict(num_layers=8, d_model=1024, num_heads=16,
                                num_kv_heads=8, mlp_dim=3584,
                                vocab_size=32000)
-        cfg.data.seq_len = 1024
+        if "data.seq_len" not in explicit:
+            cfg.data.seq_len = 1024
         cfg.data.vocab_size = 32000
         # remat exists for the 8B pod HBM budget; the ~180M-param
         # stand-in fits with room to spare, and MFU counts recompute as
         # zero useful work — leaving it on would only understate the
         # chip (the 8B preset itself is unchanged)
-        cfg.model.remat = False
+        if "model.remat" not in explicit:
+            cfg.model.remat = False
 
     trainer = Trainer(cfg)
 
@@ -586,6 +604,13 @@ def main(argv=None) -> int:
             train_flops_per_sample=flops_per_sample,
             mfu=(round(mfu, 4) if mfu is not None else None),
             compute_dtype=compute_dtype,
+            # token-dataset presets: tokens/s/chip keeps precision the
+            # 2-decimal samples/s rounding destroys at long context
+            # (96k tokens/sample -> 0.08 samples/s)
+            **({"tokens_per_sec_chip": round(
+                    per_chip_rate * cfg.data.seq_len, 1)}
+               if cfg.data.dataset in ("lm_synthetic", "mlm_synthetic",
+                                       "token_file") else {}),
             **({"mfu_error": mfu_error} if mfu_error else {}),
         )
     print(json.dumps(rec))

@@ -13,6 +13,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 from flax import linen as nn
+from jax.ad_checkpoint import checkpoint_name
 
 from pytorch_distributed_nn_tpu.config import ModelConfig
 from pytorch_distributed_nn_tpu.models import register
@@ -48,6 +49,11 @@ class LlamaBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False, decode: bool = False):
+        # inert tag unless the enclosing remat uses a name-aware policy
+        # (remat_offload): then this marks the block boundary as
+        # offloadable to pinned host memory instead of living in HBM
+        # for the whole backward (the MaxText long-context pattern)
+        x = checkpoint_name(x, "block_in")
         d = x.shape[-1]
         y = RMSNorm(eps=self.norm_eps, dtype=self.dtype,
                     param_dtype=self.param_dtype, name="attn_norm")(x)
@@ -83,6 +89,7 @@ class Llama(nn.Module):
     # extra["norm_eps"] to the checkpoint's value when converting)
     norm_eps: float = 1e-5
     remat: bool = False
+    remat_offload: bool = False
     attn_impl: str = "auto"
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
@@ -101,8 +108,32 @@ class Llama(nn.Module):
         x = nn.Embed(self.vocab_size, self.d_model,
                      param_dtype=self.param_dtype,
                      name="tok_embed")(tokens).astype(self.dtype)
-        block_cls = (nn.remat(LlamaBlock, static_argnums=(2, 3))
-                     if self.remat else LlamaBlock)
+        if self.remat_offload and not self.remat:
+            raise ValueError(
+                "remat_offload moves remat-saved block boundaries to "
+                "host RAM — it needs model.remat=True (without remat "
+                "there are no saved boundaries to offload, and "
+                "silently ignoring the flag would let a run expected "
+                "to fit via offload OOM instead)"
+            )
+        if self.remat:
+            # remat_offload moves the saved block-boundary activations
+            # (the "block_in" tags) to pinned host RAM: HBM then holds
+            # only the layer being recomputed, which is what makes
+            # 128k-token single-chip training fit (device<->host DMA
+            # overlaps with the backward's compute)
+            policy = None
+            if self.remat_offload:
+                policy = jax.checkpoint_policies.\
+                    save_and_offload_only_these_names(
+                        names_which_can_be_saved=[],
+                        names_which_can_be_offloaded=["block_in"],
+                        offload_src="device", offload_dst="pinned_host",
+                    )
+            block_cls = nn.remat(LlamaBlock, static_argnums=(2, 3),
+                                 policy=policy)
+        else:
+            block_cls = LlamaBlock
         for i in range(self.num_layers):
             x = block_cls(
                 num_heads=self.num_heads, num_kv_heads=self.num_kv_heads,
@@ -135,6 +166,7 @@ def build_llama3_8b(cfg: ModelConfig) -> Llama:
         rope_theta=e.get("rope_theta", 500000.0),
         norm_eps=e.get("norm_eps", 1e-5),
         remat=cfg.remat,
+        remat_offload=cfg.remat_offload,
         attn_impl=e.get("attn_impl", "auto"),
         dtype=policy.compute_dtype,
         param_dtype=policy.param_dtype,

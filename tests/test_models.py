@@ -208,3 +208,43 @@ def test_dropout_trains_under_dp_explicit():
     trainer = Trainer(cfg, mesh=make_mesh(cfg.mesh.resolve(8)))
     trainer.train()
     assert np.isfinite(trainer.losses()).all()
+
+
+def test_llama_remat_offload_matches_remat():
+    """remat_offload moves saved block boundaries to pinned host RAM —
+    a memory-layout choice only. Losses must track plain remat exactly
+    (same recompute, same math; the long-context enabler must never
+    change training).
+
+    Plain jit (no mesh shardings): the annotate_device_placement
+    custom-call the offload inserts is TPU-runtime territory — the CPU
+    backend can't execute it under a sharded jit, and XLA's SPMD
+    partitioner rejects it on multi-device meshes ("Side-effect HLO
+    must have sharding"). Both are upstream limitations consistent
+    with the feature's purpose: offload buys back HBM on ONE chip; at
+    pod scale sequence parallelism is the long-context tool
+    (docs/design.md). This covers the model wiring (policy
+    construction, boundary tag, gradient math)."""
+    import jax.numpy as jnp
+
+    def run(offload):
+        cfg = ModelConfig(name="llama3_8b", remat=True,
+                          remat_offload=offload, compute_dtype="float32",
+                          extra=TINY["llama3_8b"])
+        model = get_model(cfg)
+        tokens = jnp.arange(32, dtype=jnp.int32).reshape(2, 16) % 101
+        params = model.init(jax.random.key(0), tokens, train=False)
+
+        def loss(p):
+            return model.apply(p, tokens, train=True).astype(
+                jnp.float32).sum()
+
+        return jax.jit(jax.grad(loss))(params)
+
+    base, off = run(False), run(True)
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(np.asarray(b),
+                                                np.asarray(a),
+                                                rtol=1e-6, atol=1e-7),
+        base, off,
+    )
