@@ -130,21 +130,43 @@ def main() -> int:
         records[preset] = last_json_line(r["stdout"]) or {
             "error": r["stderr"][-500:], "rc": r["rc"]}
         print(f"{preset}: {json.dumps(records[preset])[:160]}")
-    for metric in METRICS:
-        cmd = [sys.executable, "bench.py", "--metric", metric]
+    metric_runs = [(m, []) for m in METRICS]
+    # decode again at serving-throughput batch: decode is HBM-bandwidth
+    # bound, so tokens/s scales near-linearly in batch until compute
+    # takes over (r3 sweep: 5.7k/18.6k/48k/96.6k/175-181k/345k/500k
+    # tok/s at b=8/32/64/128/256/512/1024 — the b=256 spread is
+    # run-to-run tunnel variance; ONCHIP's record is authoritative —
+    # OOM at 2048); b=8 stays the latency-series record, b=256 is the
+    # throughput story
+    metric_runs.append(("decode_b256", ["--per-chip-batch", "256"]))
+    for key, extra in metric_runs:
+        metric = key.split("_b")[0]
+        cmd = [sys.executable, "bench.py", "--metric", metric] + extra
         if metric == "loader":
             cmd += ["--preset", "resnet50_dp"]
         elif metric == "bus_bw":
             # THE BASELINE bus-bw claim is BERT fused buckets
             cmd += ["--preset", "bert_base_buckets"]
         r = run(cmd, args.bench_timeout)
-        records[f"metric:{metric}"] = last_json_line(r["stdout"]) or {
+        records[f"metric:{key}"] = last_json_line(r["stdout"]) or {
             "error": r["stderr"][-500:], "rc": r["rc"]}
-        print(f"{metric}: {json.dumps(records[f'metric:{metric}'])[:160]}")
+        print(f"{key}: {json.dumps(records[f'metric:{key}'])[:160]}")
 
     opath = os.path.join(REPO, f"ONCHIP_r{args.round:02d}.json")
+    out = {"round": args.round, "records": records}
+    try:
+        # provenance notes (re-measurement history) are hand-curated in
+        # the artifact; a routine re-sweep must not silently destroy
+        # them — carry them forward with a stamp
+        with open(opath) as f:
+            prior = json.load(f).get("provenance")
+        if prior:
+            out["provenance"] = (prior + " [records since replaced by a "
+                                 "full re-sweep]")
+    except (OSError, json.JSONDecodeError):
+        pass
     with open(opath, "w") as f:
-        json.dump({"round": args.round, "records": records}, f, indent=1)
+        json.dump(out, f, indent=1)
     print(f"wrote {opath}")
     return 0 if kernels["ok"] else 1
 
