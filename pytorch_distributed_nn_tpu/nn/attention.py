@@ -52,17 +52,27 @@ def dot_product_attention(
     cross-length kv), 'flash' (Pallas kernels in both directions: the
     streamed forward plus the two-pass lse-replay backward), or 'auto'.
     Measured on v5e (llama-shaped blocks, fwd+bwd): xla wins at T=512;
-    T=1k is batch-dependent (a batch-4 isolated A/B favors flash 1.2x,
-    but the batch-1 full-model bench favors xla — too few grid rows to
-    fill the chip), flash clearly from 2k up (1.33x+ with 1024-token
-    blocks, growing with T — xla's (T, T) scores thrash HBM from 8k) —
-    so 'auto' picks flash on TPU for self-attention at T >= 2048 with
-    no padding mask.
+    T=1k is an OCCUPANCY question — the flash grid parallelizes over
+    B*H row-programs, and with too few the chip idles (batch-1
+    full-model bench favors xla; batch-4 favors flash 1.2x; batch-16
+    favors flash 1.41x, r3 A/B). Flash clearly wins from 2k up at any
+    batch (1.33x+ with 1024-token blocks, growing with T — xla's
+    (T, T) scores thrash HBM from 8k). So 'auto' picks flash on TPU
+    for self-attention with no padding mask at T >= 2048, or at
+    T >= 1024 with >= 64 B*H rows PER CHIP (the measured break-even).
+    Trace-time shapes are GLOBAL under jit/GSPMD, so the per-chip rows
+    divide the worst case — batch and heads fully sharded — by the
+    device count; single-chip runs are unchanged, and a pod DP run at
+    per-chip batch 1 correctly stays on xla.
     """
     if impl == "auto":
+        T = q.shape[1]
+        rows_per_chip = (q.shape[0] * q.shape[2]) // max(
+            jax.device_count(), 1)
         impl = ("flash" if jax.default_backend() == "tpu"
-                and mask is None and q.shape[1] >= 2048
-                and k.shape[1] == q.shape[1] else "xla")
+                and mask is None and k.shape[1] == T
+                and (T >= 2048 or (T >= 1024 and rows_per_chip >= 64))
+                else "xla")
     if impl not in ("xla", "flash"):
         raise ValueError(f"unknown attention impl {impl!r}")
     B, T, H, D = q.shape
