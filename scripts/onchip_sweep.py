@@ -130,7 +130,7 @@ def main() -> int:
         records[preset] = last_json_line(r["stdout"]) or {
             "error": r["stderr"][-500:], "rc": r["rc"]}
         print(f"{preset}: {json.dumps(records[preset])[:160]}")
-    metric_runs = [(m, []) for m in METRICS]
+    metric_runs = [(m, m, []) for m in METRICS]
     # decode again at serving-throughput batch: decode is HBM-bandwidth
     # bound, so tokens/s scales near-linearly in batch until compute
     # takes over (r3 sweep: 5.7k/18.6k/48k/96.6k/175-181k/345k/500k
@@ -138,9 +138,9 @@ def main() -> int:
     # run-to-run tunnel variance; ONCHIP's record is authoritative —
     # OOM at 2048); b=8 stays the latency-series record, b=256 is the
     # throughput story
-    metric_runs.append(("decode_b256", ["--per-chip-batch", "256"]))
-    for key, extra in metric_runs:
-        metric = key.split("_b")[0]
+    metric_runs.append(("decode_b256", "decode",
+                        ["--per-chip-batch", "256"]))
+    for key, metric, extra in metric_runs:
         cmd = [sys.executable, "bench.py", "--metric", metric] + extra
         if metric == "loader":
             cmd += ["--preset", "resnet50_dp"]
