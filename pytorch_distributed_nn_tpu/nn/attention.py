@@ -39,6 +39,18 @@ def rotary_embedding(q, k, *, theta: float = 10000.0, positions=None):
     return rotate(q), rotate(k)
 
 
+def _auto_impl(q_shape, k_shape, *, has_mask: bool) -> str:
+    """The 'auto' flash-vs-xla decision (see dot_product_attention's
+    docstring for the v5e measurements behind the thresholds)."""
+    T = q_shape[1]
+    rows_per_chip = (q_shape[0] * q_shape[2]) // max(
+        jax.device_count(), 1)
+    return ("flash" if jax.default_backend() == "tpu"
+            and not has_mask and k_shape[1] == T
+            and (T >= 2048 or (T >= 1024 and rows_per_chip >= 64))
+            else "xla")
+
+
 def dot_product_attention(
     q, k, v, *, causal: bool, impl: str = "xla",
     mask: Optional[jax.Array] = None,
@@ -66,13 +78,7 @@ def dot_product_attention(
     per-chip batch 1 correctly stays on xla.
     """
     if impl == "auto":
-        T = q.shape[1]
-        rows_per_chip = (q.shape[0] * q.shape[2]) // max(
-            jax.device_count(), 1)
-        impl = ("flash" if jax.default_backend() == "tpu"
-                and mask is None and k.shape[1] == T
-                and (T >= 2048 or (T >= 1024 and rows_per_chip >= 64))
-                else "xla")
+        impl = _auto_impl(q.shape, k.shape, has_mask=mask is not None)
     if impl not in ("xla", "flash"):
         raise ValueError(f"unknown attention impl {impl!r}")
     B, T, H, D = q.shape

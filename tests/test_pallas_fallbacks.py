@@ -219,3 +219,31 @@ def test_flash_rejects_cross_length():
     kv = jnp.zeros((1, 64, 4, 32))
     with pytest.raises(ValueError, match="self-attention"):
         flash_attention(q, kv, kv, causal=False)
+
+
+def test_auto_impl_occupancy_policy(monkeypatch):
+    """The 'auto' flash-vs-xla switch (r3 occupancy policy): flash at
+    T >= 2048, or at T >= 1024 with >= 64 B*H rows per chip — global
+    trace shapes divided by device count so pod DP at per-chip batch 1
+    stays on xla (the measured under-occupied regime)."""
+    from pytorch_distributed_nn_tpu.nn import attention as att
+
+    monkeypatch.setattr(att.jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(att.jax, "device_count", lambda: 1)
+
+    def pick(B, T, H, S=None, devices=1, mask=False):
+        monkeypatch.setattr(att.jax, "device_count", lambda: devices)
+        return att._auto_impl((B, T, H, 64), (B, S or T, H, 64),
+                              has_mask=mask)
+
+    assert pick(1, 2048, 4) == "flash"      # length alone from 2k
+    assert pick(1, 1024, 16) == "xla"       # 16 rows: under-occupied
+    assert pick(4, 1024, 16) == "flash"     # 64 rows: break-even
+    assert pick(16, 1024, 16) == "flash"
+    assert pick(1, 512, 64) == "xla"        # never below 1k
+    assert pick(8, 1024, 16, devices=8) == "xla"   # pod DP: 16/chip
+    assert pick(8, 2048, 16, devices=8) == "flash"  # length still wins
+    assert pick(4, 1024, 16, mask=True) == "xla"   # masks need xla
+    assert pick(4, 1024, 16, S=512) == "xla"       # cross-length
+    monkeypatch.setattr(att.jax, "default_backend", lambda: "cpu")
+    assert pick(16, 4096, 16) == "xla"      # CPU always falls back
