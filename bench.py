@@ -452,6 +452,12 @@ def main(argv=None) -> int:
     ap.add_argument("--probe-timeout", type=float, default=75.0,
                     help="seconds before one availability probe counts "
                          "as hung")
+    ap.add_argument("--multistep", type=int, default=1,
+                    help="fuse this many optimizer steps into one device "
+                         "dispatch (lax.scan over a stacked batch pool) — "
+                         "each of --steps then counts a k-step dispatch; "
+                         "the TPU-idiomatic loop for dispatch-bound "
+                         "presets")
     ap.add_argument("--set", action="append", default=[], dest="overrides",
                     metavar="a.b=c",
                     help="dotted config override applied after the "
@@ -540,6 +546,33 @@ def main(argv=None) -> int:
     pool = [trainer.loader.batch_at(i) for i in range(4)]
     state = trainer.state
 
+    if args.multistep > 1:
+        # Device-side training loop (train/multistep.py): one dispatch
+        # runs k optimizer steps via lax.scan over a stacked batch
+        # pool. For dispatch-bound presets (mlp/lenet behind the
+        # tunnel) this measures the CHIP, not the round-trip.
+        import jax.numpy as jnp
+
+        from pytorch_distributed_nn_tpu.train.multistep import (
+            make_multistep,
+        )
+
+        k = args.multistep
+        # stack only the UNIQUE pool batches; multistep cycles i % pool
+        # on device, so HBM holds 4 batches however large k is
+        n = min(len(pool), k)
+        xs = jnp.stack([pool[i][0] for i in range(n)])
+        ys = jnp.stack([pool[i][1] for i in range(n)])
+        mstep = make_multistep(trainer.step_fn, k)
+
+        def run_step(state, i):
+            return mstep(state, xs, ys)
+    else:
+        k = 1
+
+        def run_step(state, i):
+            return trainer.step_fn(state, *pool[i % len(pool)])
+
     def fence(metrics) -> float:
         # A scalar device_get is the only reliable execution fence when
         # the chip sits behind a transfer tunnel (block_until_ready can
@@ -548,8 +581,8 @@ def main(argv=None) -> int:
         return float(jax.device_get(metrics["loss"]))
 
     metrics = None
-    for i in range(args.warmup):
-        state, metrics = trainer.step_fn(state, *pool[i % len(pool)])
+    for i in range(max(args.warmup // k, 1)):
+        state, metrics = run_step(state, i)
     fence(metrics)
 
     import contextlib
@@ -563,13 +596,13 @@ def main(argv=None) -> int:
     t0 = time.perf_counter()
     with profile:
         for i in range(args.steps):
-            state, metrics = trainer.step_fn(state, *pool[i % len(pool)])
+            state, metrics = run_step(state, i)
         loss = fence(metrics)
     dt = time.perf_counter() - t0
     if not (loss == loss):  # NaN guard: a benchmark that diverged is void
         raise RuntimeError(f"non-finite loss {loss} in benchmark loop")
 
-    samples_per_sec = args.steps * cfg.data.batch_size / dt
+    samples_per_sec = args.steps * k * cfg.data.batch_size / dt
     per_chip_rate = samples_per_sec / n_chips
     nominal = NOMINAL.get(args.preset)
 

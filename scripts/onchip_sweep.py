@@ -34,6 +34,18 @@ PRESETS = [
     "transformer_lm_pp", "llama3_8b_zero", "moe_lm_ep",
     "llama3_longcontext", "llama3_longcontext_96k",
 ]
+# record key -> (preset, extra bench args): dispatch-bound presets get a
+# second row under the device-side training loop (--multistep: k steps
+# per dispatch via lax.scan) — through the tunnel the single-dispatch
+# number measures round-trip latency, this one measures the chip
+PRESET_VARIANTS = {
+    "mlp_mnist_multistep": ("mlp_mnist",
+                            ["--multistep", "50", "--steps", "20",
+                             "--warmup", "100"]),
+    "lenet_cifar10_multistep": ("lenet_cifar10",
+                                ["--multistep", "50", "--steps", "20",
+                                 "--warmup", "100"]),
+}
 METRICS = ["decode", "bus_bw", "loader"]
 
 
@@ -130,6 +142,12 @@ def main() -> int:
         records[preset] = last_json_line(r["stdout"]) or {
             "error": r["stderr"][-500:], "rc": r["rc"]}
         print(f"{preset}: {json.dumps(records[preset])[:160]}")
+    for key, (preset, extra) in PRESET_VARIANTS.items():
+        r = run([sys.executable, "bench.py", "--preset", preset] + extra,
+                args.bench_timeout)
+        records[key] = last_json_line(r["stdout"]) or {
+            "error": r["stderr"][-500:], "rc": r["rc"]}
+        print(f"{key}: {json.dumps(records[key])[:160]}")
     metric_runs = [(m, m, []) for m in METRICS]
     # decode again at serving-throughput batch: decode is HBM-bandwidth
     # bound, so tokens/s scales near-linearly in batch until compute
