@@ -490,10 +490,13 @@ def main(argv=None) -> int:
     n_chips = len(jax.devices())
     per_chip = args.per_chip_batch or PER_CHIP_BATCH[args.preset]
     # keys the operator pinned with --set: the single-chip fix-ups
-    # below must not clobber an explicit A/B choice
-    explicit = {kv.split("=", 1)[0] for kv in args.overrides}
-    cfg = get_config(args.preset,
-                     **dict(kv.split("=", 1) for kv in args.overrides))
+    # below must not clobber an explicit A/B choice. parse_overrides is
+    # the config CLI's parser — same syntax, same clear errors.
+    from pytorch_distributed_nn_tpu.config import parse_overrides
+
+    overrides = parse_overrides(["--" + kv for kv in args.overrides])
+    explicit = set(overrides)
+    cfg = get_config(args.preset, **overrides)
     cfg.steps = args.warmup + args.steps
     cfg.log_every = 0  # no host syncs in the timed loop
     cfg.data.batch_size = per_chip * n_chips
@@ -505,9 +508,13 @@ def main(argv=None) -> int:
         # Too few chips for the 4-stage pipeline: bench the same
         # Transformer-LM under plain DP so the workload still measures
         # (the pipeline schedule itself is exercised by dryrun_multichip
-        # and tests on the virtual mesh).
-        cfg.mesh.pipe = 1
-        cfg.parallel.strategy = "dp"
+        # and tests on the virtual mesh). Explicit --set choices win
+        # (a pinned strategy/mesh that can't run will fail loudly at
+        # mesh construction — the operator asked for it).
+        if "mesh.pipe" not in explicit:
+            cfg.mesh.pipe = 1
+        if "parallel.strategy" not in explicit:
+            cfg.parallel.strategy = "dp"
         # the preset's remat serves the 4-stage pod memory budget; the
         # 1-chip DP fallback fits outright and MFU counts recompute as
         # zero useful work (measured: 68 -> 81 samples/s)
@@ -520,7 +527,8 @@ def main(argv=None) -> int:
                                vocab_size=32000)
         if "data.seq_len" not in explicit:
             cfg.data.seq_len = 1024
-        cfg.data.vocab_size = 32000
+        if "data.vocab_size" not in explicit:
+            cfg.data.vocab_size = 32000
         # r3 per-chip batch sweep ON THE STAND-IN: 49.6/69.6/76.3/81.2
         # samples/s at b=1/4/8/16, OOM at 32 — b=16 is the measured
         # optimum for the ~180M single-chip model. The shared table

@@ -38,6 +38,15 @@ def get_model(cfg: ModelConfig):
         raise KeyError(
             f"unknown model {cfg.name!r}; have {sorted(_REGISTRY)}"
         )
+    if cfg.remat_offload and cfg.name != "llama3_8b":
+        # only the llama builder consumes the flag; silently dropping
+        # it would let a run expected to fit via host offload OOM
+        # instead (the same failure mode llama.py guards against for
+        # offload-without-remat)
+        raise ValueError(
+            f"remat_offload is implemented for llama3_8b only; model "
+            f"{cfg.name!r} would silently ignore it"
+        )
     return _REGISTRY[cfg.name](cfg)
 
 

@@ -175,12 +175,13 @@ def main() -> int:
     try:
         # provenance notes (re-measurement history) are hand-curated in
         # the artifact; a routine re-sweep must not silently destroy
-        # them — carry them forward with a stamp
+        # them — carry them forward with a (non-accumulating) stamp
         with open(opath) as f:
             prior = json.load(f).get("provenance")
+        stamp = " [records since replaced by a full re-sweep]"
         if prior:
-            out["provenance"] = (prior + " [records since replaced by a "
-                                 "full re-sweep]")
+            out["provenance"] = (prior if prior.endswith(stamp)
+                                 else prior + stamp)
     except (OSError, json.JSONDecodeError):
         pass
     with open(opath, "w") as f:
