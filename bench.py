@@ -495,9 +495,19 @@ def main(argv=None) -> int:
     from pytorch_distributed_nn_tpu.config import parse_overrides
 
     overrides = parse_overrides(["--" + kv for kv in args.overrides])
-    explicit = set(overrides)
+    # TrainConfig.override normalizes dashes to underscores; the guard
+    # set must match that spelling or a dashed --set gets applied AND
+    # then clobbered by the fix-up blocks below (advisor r3 finding)
+    explicit = {k.replace("-", "_") for k in overrides}
     cfg = get_config(args.preset, **overrides)
-    cfg.steps = args.warmup + args.steps
+    # with --multistep k every dispatch runs k optimizer steps, so the
+    # schedule horizon handed to make_optimizer must cover the true
+    # optimizer-step count or cosine/warmup presets get a k x shorter
+    # LR trajectory (advisor r3 finding). The loop below runs
+    # max(warmup//k, 1) warmup dispatches plus args.steps timed ones,
+    # each k optimizer steps.
+    _k = max(args.multistep, 1)
+    cfg.steps = (max(args.warmup // _k, 1) + args.steps) * _k
     cfg.log_every = 0  # no host syncs in the timed loop
     cfg.data.batch_size = per_chip * n_chips
 
@@ -522,9 +532,10 @@ def main(argv=None) -> int:
             cfg.model.remat = False
 
     if args.preset == "llama3_8b_zero" and n_chips < 8:
-        cfg.model.extra = dict(num_layers=8, d_model=1024, num_heads=16,
-                               num_kv_heads=8, mlp_dim=3584,
-                               vocab_size=32000)
+        if "model.extra" not in explicit:
+            cfg.model.extra = dict(num_layers=8, d_model=1024,
+                                   num_heads=16, num_kv_heads=8,
+                                   mlp_dim=3584, vocab_size=32000)
         if "data.seq_len" not in explicit:
             cfg.data.seq_len = 1024
         if "data.vocab_size" not in explicit:

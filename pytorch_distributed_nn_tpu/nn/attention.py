@@ -39,12 +39,21 @@ def rotary_embedding(q, k, *, theta: float = 10000.0, positions=None):
     return rotate(q), rotate(k)
 
 
-def _auto_impl(q_shape, k_shape, *, has_mask: bool) -> str:
+def _auto_impl(q_shape, k_shape, *, has_mask: bool,
+               device_count: Optional[int] = None) -> str:
     """The 'auto' flash-vs-xla decision (see dot_product_attention's
-    docstring for the v5e measurements behind the thresholds)."""
+    docstring for the v5e measurements behind the thresholds).
+
+    ``device_count=None`` assumes the shapes are GLOBAL (jit/GSPMD
+    trace-time shapes) and divides the B*H rows by ``jax.device_count()``
+    for the fully-sharded worst case. Callers inside ``shard_map`` see
+    per-device SHARD shapes and must pass ``device_count=1`` — otherwise
+    the rows are divided twice and the T in [1024, 2048) flash upgrade
+    never fires (advisor r3 finding)."""
     T = q_shape[1]
-    rows_per_chip = (q_shape[0] * q_shape[2]) // max(
-        jax.device_count(), 1)
+    if device_count is None:
+        device_count = jax.device_count()
+    rows_per_chip = (q_shape[0] * q_shape[2]) // max(device_count, 1)
     return ("flash" if jax.default_backend() == "tpu"
             and not has_mask and k_shape[1] == T
             and (T >= 2048 or (T >= 1024 and rows_per_chip >= 64))
@@ -54,6 +63,7 @@ def _auto_impl(q_shape, k_shape, *, has_mask: bool) -> str:
 def dot_product_attention(
     q, k, v, *, causal: bool, impl: str = "xla",
     mask: Optional[jax.Array] = None,
+    device_count: Optional[int] = None,
 ):
     """q: (B, T, H, D); k/v: (B, S, Hkv, D) with H % Hkv == 0.
 
@@ -78,7 +88,8 @@ def dot_product_attention(
     per-chip batch 1 correctly stays on xla.
     """
     if impl == "auto":
-        impl = _auto_impl(q.shape, k.shape, has_mask=mask is not None)
+        impl = _auto_impl(q.shape, k.shape, has_mask=mask is not None,
+                          device_count=device_count)
     if impl not in ("xla", "flash"):
         raise ValueError(f"unknown attention impl {impl!r}")
     B, T, H, D = q.shape

@@ -346,6 +346,14 @@ def ulysses_attention(q, k, v, *, axis: str = AXIS_SEQ,
     q = cc.all_to_all(q, axis, split_axis=2, concat_axis=1)
     k = cc.all_to_all(k, axis, split_axis=2, concat_axis=1)
     v = cc.all_to_all(v, axis, split_axis=2, concat_axis=1)
-    out = dot_product_attention(q, k, v, causal=causal, impl=impl)
+    # inside this shard_map the seq axis is manual (H already divided by
+    # s) but the batch dim is still the global trace size over the auto
+    # data/fsdp axes — so the 'auto' occupancy rule must divide rows by
+    # the NON-seq mesh factor only, not the full device_count (which
+    # would double-count s) and not 1 (which would overcount occupancy
+    # by the data*fsdp factor on a pod)
+    out = dot_product_attention(
+        q, k, v, causal=causal, impl=impl,
+        device_count=max(jax.device_count() // s, 1))
     # back: (B, T, H/s, D) → (B, Tl, H, D)
     return cc.all_to_all(out, axis, split_axis=1, concat_axis=2)
