@@ -19,6 +19,7 @@ from pytorch_distributed_nn_tpu.config import ModelConfig
 from pytorch_distributed_nn_tpu.models import register
 from pytorch_distributed_nn_tpu.nn.attention import MultiHeadAttention
 from pytorch_distributed_nn_tpu.nn.dtypes import get_policy
+from pytorch_distributed_nn_tpu.nn.quantized import Int8Dense, Int8Embed
 
 
 class RMSNorm(nn.Module):
@@ -46,6 +47,7 @@ class LlamaBlock(nn.Module):
     attn_impl: str = "auto"
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
+    quantized: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False, decode: bool = False):
@@ -62,18 +64,22 @@ class LlamaBlock(nn.Module):
             num_kv_heads=self.num_kv_heads, causal=True, rotary=True,
             rope_theta=self.rope_theta, impl=self.attn_impl,
             use_bias=False, dtype=self.dtype,
-            param_dtype=self.param_dtype, name="attn",
+            param_dtype=self.param_dtype, quantized=self.quantized,
+            name="attn",
         )(y, decode=decode)
         x = x + y
         y = RMSNorm(eps=self.norm_eps, dtype=self.dtype,
                     param_dtype=self.param_dtype, name="mlp_norm")(x)
-        gate = nn.Dense(self.mlp_dim, use_bias=False, dtype=self.dtype,
-                        param_dtype=self.param_dtype, name="gate_proj")(y)
-        up = nn.Dense(self.mlp_dim, use_bias=False, dtype=self.dtype,
-                      param_dtype=self.param_dtype, name="up_proj")(y)
-        y = nn.Dense(d, use_bias=False, dtype=self.dtype,
-                     param_dtype=self.param_dtype,
-                     name="down_proj")(nn.silu(gate) * up)
+        if self.quantized:
+            dense = lambda f, name: Int8Dense(  # noqa: E731
+                f, dtype=self.dtype, name=name)
+        else:
+            dense = lambda f, name: nn.Dense(  # noqa: E731
+                f, use_bias=False, dtype=self.dtype,
+                param_dtype=self.param_dtype, name=name)
+        gate = dense(self.mlp_dim, "gate_proj")(y)
+        up = dense(self.mlp_dim, "up_proj")(y)
+        y = dense(d, "down_proj")(nn.silu(gate) * up)
         return x + y
 
 
@@ -93,6 +99,11 @@ class Llama(nn.Module):
     attn_impl: str = "auto"
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
+    # weight-only int8 (nn/quantized.py): every kernel stored int8 with
+    # per-out-channel scales, dequantized in VMEM by the Pallas matmul.
+    # ~8 GB for the true 8B params — the mode that fits the flagship on
+    # one 16 GB v5e chip (inference path; training stays float)
+    quantized: bool = False
 
     @nn.compact
     def __call__(self, tokens, *, train: bool = False,
@@ -105,9 +116,13 @@ class Llama(nn.Module):
         the final-norm'd (B, T, D) trunk output — the chunked-xent path
         (train/losses.py) applies the head blockwise so full logits
         never materialize."""
-        x = nn.Embed(self.vocab_size, self.d_model,
-                     param_dtype=self.param_dtype,
-                     name="tok_embed")(tokens).astype(self.dtype)
+        if self.quantized:
+            x = Int8Embed(self.vocab_size, self.d_model,
+                          dtype=self.dtype, name="tok_embed")(tokens)
+        else:
+            x = nn.Embed(self.vocab_size, self.d_model,
+                         param_dtype=self.param_dtype,
+                         name="tok_embed")(tokens).astype(self.dtype)
         if self.remat_offload and not self.remat:
             raise ValueError(
                 "remat_offload moves remat-saved block boundaries to "
@@ -140,7 +155,8 @@ class Llama(nn.Module):
                 mlp_dim=self.mlp_dim, rope_theta=self.rope_theta,
                 norm_eps=self.norm_eps,
                 attn_impl=self.attn_impl, dtype=self.dtype,
-                param_dtype=self.param_dtype, name=f"layer{i}",
+                param_dtype=self.param_dtype, quantized=self.quantized,
+                name=f"layer{i}",
             )(x, train, decode)
         if last_only:
             x = x[:, -1:]
@@ -148,6 +164,9 @@ class Llama(nn.Module):
                     param_dtype=self.param_dtype, name="final_norm")(x)
         if return_hidden:
             return x
+        if self.quantized:
+            return Int8Dense(self.vocab_size, dtype=jnp.float32,
+                             name="lm_head")(x)
         return nn.Dense(self.vocab_size, use_bias=False, dtype=jnp.float32,
                         param_dtype=self.param_dtype, name="lm_head")(x)
 
@@ -168,6 +187,7 @@ def build_llama3_8b(cfg: ModelConfig) -> Llama:
         remat=cfg.remat,
         remat_offload=cfg.remat_offload,
         attn_impl=e.get("attn_impl", "auto"),
+        quantized=e.get("quantized", False),
         dtype=policy.compute_dtype,
         param_dtype=policy.param_dtype,
     )

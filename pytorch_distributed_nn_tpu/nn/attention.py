@@ -17,6 +17,8 @@ import jax
 import jax.numpy as jnp
 from flax import linen as nn
 
+from pytorch_distributed_nn_tpu.nn.quantized import Int8DenseGeneral
+
 
 def rotary_embedding(q, k, *, theta: float = 10000.0, positions=None):
     """Apply rotary position embeddings to q, k of shape (B, T, H, D)."""
@@ -138,6 +140,11 @@ class MultiHeadAttention(nn.Module):
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
     use_bias: bool = True
+    # weight-only int8 projections (nn/quantized.py): q/k/v/out kernels
+    # stored int8 + per-out-channel scales, dequantized tile-wise in
+    # the Pallas matmul — the capacity mode that fits Llama-3-8B's
+    # weights in one chip's HBM. Bias-free only (the Llama family).
+    quantized: bool = False
 
     @nn.compact
     def __call__(self, x, mask: Optional[jax.Array] = None,
@@ -150,10 +157,19 @@ class MultiHeadAttention(nn.Module):
         rotary positions are absolute, and attention masks to the
         filled prefix. Causal-only (the cache is a running prefix)."""
         kv_heads = self.num_kv_heads or self.num_heads
-        dense = lambda heads, name: nn.DenseGeneral(  # noqa: E731
-            (heads, self.head_dim), axis=-1, name=name, dtype=self.dtype,
-            param_dtype=self.param_dtype, use_bias=self.use_bias,
-        )
+        if self.quantized:
+            if self.use_bias:
+                raise ValueError("quantized attention is bias-free")
+            dense = lambda heads, name: Int8DenseGeneral(  # noqa: E731
+                (heads, self.head_dim), axis=-1, name=name,
+                dtype=self.dtype,
+            )
+        else:
+            dense = lambda heads, name: nn.DenseGeneral(  # noqa: E731
+                (heads, self.head_dim), axis=-1, name=name,
+                dtype=self.dtype, param_dtype=self.param_dtype,
+                use_bias=self.use_bias,
+            )
         q = dense(self.num_heads, "query")(x)
         k = dense(kv_heads, "key")(x)
         v = dense(kv_heads, "value")(x)
@@ -271,6 +287,10 @@ class MultiHeadAttention(nn.Module):
                 q, k = q.astype(self.dtype), k.astype(self.dtype)
             out = dot_product_attention(q, k, v, causal=self.causal,
                                         impl=self.impl, mask=mask)
+        if self.quantized:
+            return Int8DenseGeneral(
+                x.shape[-1], axis=(-2, -1), name="out", dtype=self.dtype,
+            )(out)
         return nn.DenseGeneral(
             x.shape[-1], axis=(-2, -1), name="out", dtype=self.dtype,
             param_dtype=self.param_dtype, use_bias=self.use_bias,

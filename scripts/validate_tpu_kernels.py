@@ -114,6 +114,34 @@ def check_quantize() -> bool:
     return ok
 
 
+def check_int8_matmul() -> bool:
+    """The weight-only int8 dequant matmul (ops/pallas/int8_matmul.py)
+    vs its dequantized-f32 oracle — the kernel under the TRUE-8B decode
+    path — at llama layer shapes plus padded-tail geometries."""
+    from pytorch_distributed_nn_tpu.ops.pallas.int8_matmul import (
+        int8_matmul,
+        quantize_weight,
+    )
+
+    rng = np.random.RandomState(3)
+    ok = True
+    for (m, k, n) in [(16, 4096, 14336), (16, 4096, 1024),
+                      (1024, 4096, 4096), (5, 48, 200)]:
+        w = jnp.asarray(rng.randn(k, n).astype(np.float32) * 0.05)
+        x = jnp.asarray(rng.randn(m, k).astype(np.float32))
+        q, s = quantize_weight(w)
+        got = int8_matmul(x, q, s, out_dtype=jnp.float32)[:, :n]
+        ref = jnp.asarray(x, jnp.bfloat16).astype(jnp.float32) @ (
+            q.astype(jnp.float32)[:k, :n] * s[:, :n])
+        err = float(jnp.max(jnp.abs(got - ref))
+                    / (float(jnp.max(jnp.abs(ref))) + 1e-9))
+        line_ok = err < 2e-2
+        ok &= line_ok
+        print(f"int8-matmul ({m},{k},{n}): rel_err={err:.2e} "
+              f"{'OK' if line_ok else 'FAIL'}")
+    return ok
+
+
 def check_ring_block() -> bool:
     """The fused ring-attention block kernel vs its jnp oracle: a chain of
     block updates with rotating offsets — exactly what one device runs
@@ -273,7 +301,7 @@ def main() -> int:
     if jax.default_backend() != "tpu":
         print("WARNING: not on TPU — validating fallbacks only")
     ok = (check_flash() & check_flash_grad() & check_quantize()
-          & check_ring_block() & check_ring_bwd()
+          & check_int8_matmul() & check_ring_block() & check_ring_bwd()
           & check_long_context())
     print("ALL OK" if ok else "FAILURES")
     return 0 if ok else 1
