@@ -541,6 +541,9 @@ def main(argv=None) -> int:
     # each k optimizer steps.
     _k = max(args.multistep, 1)
     cfg.steps = (max(args.warmup // _k, 1) + args.steps) * _k
+    if args.multistep > 1:
+        cfg.multistep_k = args.multistep
+        cfg.multistep_pool = 4  # device-resident, cycled on device
     cfg.log_every = 0  # no host syncs in the timed loop
     cfg.data.batch_size = per_chip * n_chips
 
@@ -589,53 +592,7 @@ def main(argv=None) -> int:
             cfg.model.remat = False
 
     trainer = Trainer(cfg)
-
-    # Device-resident batch pool: the timed loop must measure device
-    # compute + collectives, not host RNG / host->device transfer (this
-    # environment reaches the chip through a network tunnel, so per-step
-    # transfer would swamp the signal; real runs use an async input
-    # pipeline that hides it).
-    pool = [trainer.loader.batch_at(i) for i in range(4)]
     state = trainer.state
-
-    if args.multistep > 1:
-        # Device-side training loop (train/multistep.py): one dispatch
-        # runs k optimizer steps via lax.scan over a stacked batch
-        # pool. For dispatch-bound presets (mlp/lenet behind the
-        # tunnel) this measures the CHIP, not the round-trip.
-        import jax.numpy as jnp
-
-        from pytorch_distributed_nn_tpu.train.multistep import (
-            make_multistep,
-        )
-
-        k = args.multistep
-        # stack only the UNIQUE pool batches; multistep cycles i % pool
-        # on device, so HBM holds 4 batches however large k is
-        n = min(len(pool), k)
-        xs = jnp.stack([pool[i][0] for i in range(n)])
-        ys = jnp.stack([pool[i][1] for i in range(n)])
-        mstep = make_multistep(trainer.step_fn, k)
-
-        def run_step(state, i):
-            return mstep(state, xs, ys)
-    else:
-        k = 1
-
-        def run_step(state, i):
-            return trainer.step_fn(state, *pool[i % len(pool)])
-
-    def fence(metrics) -> float:
-        # A scalar device_get is the only reliable execution fence when
-        # the chip sits behind a transfer tunnel (block_until_ready can
-        # return before remote execution completes there); the last step
-        # depends on every prior step, so this syncs the whole loop.
-        return float(jax.device_get(metrics["loss"]))
-
-    metrics = None
-    for i in range(max(args.warmup // k, 1)):
-        state, metrics = run_step(state, i)
-    fence(metrics)
 
     import contextlib
 
@@ -645,12 +602,58 @@ def main(argv=None) -> int:
 
         profile = xprof_trace(args.profile_dir)
 
-    t0 = time.perf_counter()
-    with profile:
-        for i in range(args.steps):
+    if args.multistep > 1:
+        # Device-side training loop: the TRAINER's multistep path
+        # (cfg.multistep_k was set above), with a 4-batch cycled pool
+        # (cfg.multistep_pool) so HBM holds 4 batches however large k
+        # is and the timed loop measures the CHIP, not transfer. One
+        # train() call per phase: the dispatches inside stay async
+        # (calling train(k) per dispatch would sync each one against
+        # the tunnel's RTT — measured 17x slower).
+        k = args.multistep
+
+        def fence(metrics) -> float:
+            return float(jax.device_get(metrics["loss"]))
+
+        trainer.train(steps=max(args.warmup // k, 1) * k)
+        fence(trainer.last_metrics)
+        t0 = time.perf_counter()
+        with profile:
+            trainer.train(steps=args.steps * k)
+            loss = fence(trainer.last_metrics)
+        dt = time.perf_counter() - t0
+        state, metrics = trainer.state, trainer.last_metrics
+    else:
+        k = 1
+        # Device-resident batch pool: the timed loop must measure
+        # device compute + collectives, not host RNG / host->device
+        # transfer (this environment reaches the chip through a network
+        # tunnel, so per-step transfer would swamp the signal; real
+        # runs use an async input pipeline that hides it).
+        pool = [trainer.loader.batch_at(i) for i in range(4)]
+
+        def run_step(state, i):
+            return trainer.step_fn(state, *pool[i % len(pool)])
+
+        def fence(metrics) -> float:
+            # A scalar device_get is the only reliable execution fence
+            # when the chip sits behind a transfer tunnel
+            # (block_until_ready can return before remote execution
+            # completes there); the last step depends on every prior
+            # step, so this syncs the whole loop.
+            return float(jax.device_get(metrics["loss"]))
+
+        metrics = None
+        for i in range(max(args.warmup // k, 1)):
             state, metrics = run_step(state, i)
-        loss = fence(metrics)
-    dt = time.perf_counter() - t0
+        fence(metrics)
+
+        t0 = time.perf_counter()
+        with profile:
+            for i in range(args.steps):
+                state, metrics = run_step(state, i)
+            loss = fence(metrics)
+        dt = time.perf_counter() - t0
     if not (loss == loss):  # NaN guard: a benchmark that diverged is void
         raise RuntimeError(f"non-finite loss {loss} in benchmark loop")
 

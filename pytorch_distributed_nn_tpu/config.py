@@ -128,6 +128,20 @@ class TrainConfig:
     preset: str = ""
     seed: int = 0
     steps: int = 100
+    # device-side training loop (train/multistep.py): fuse this many
+    # optimizer steps into ONE dispatch via lax.scan. Identical math to
+    # k sequential steps on the same batches; checkpoint/eval cadences
+    # round UP to the next dispatch boundary (the device program is not
+    # interruptible mid-scan); per-step losses still log via the scan's
+    # stacked metrics. The dispatch-latency amortizer for small models
+    # and/or a tunneled chip (r3: mlp 27x).
+    multistep_k: int = 1
+    # 0 = each fused step trains on a FRESH batch (k batches stacked and
+    # transferred per dispatch — the production setting). N > 0 = cycle
+    # a fixed pool of N device-resident batches inside the scan:
+    # repeats data, which is wrong for real training but exactly what a
+    # device-rate benchmark wants (bench.py --multistep sets 4).
+    multistep_pool: int = 0
     log_every: int = 10
     eval_every: int = 0  # 0 = no eval; else eval every N steps
     eval_batches: int = 8  # batches per eval pass (held-out seed stream)

@@ -16,7 +16,7 @@ from typing import Iterator
 
 import jax
 import numpy as np
-from jax.sharding import Mesh, NamedSharding
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from pytorch_distributed_nn_tpu.data.datasets import SyntheticDataset
 from pytorch_distributed_nn_tpu.runtime.mesh import AXIS_SEQ, batch_pspec
@@ -105,33 +105,57 @@ class DataLoader:
         """Deterministic global batch for one step (no prefetch)."""
         return tuple(self._to_global(a) for a in self.dataset.batch(step))
 
-    def __iter__(self) -> Iterator[tuple[jax.Array, ...]]:
+    def stacked_batch_at(self, step: int, k: int) -> tuple[jax.Array, ...]:
+        """Batches for steps [step, step+k) stacked on a leading pool
+        axis — the input layout of the device-side multistep loop
+        (train/multistep.py): (k, B, ...) with the pool axis unsharded
+        and the batch rows sharded exactly as :meth:`batch_at`."""
+        per_step = [self.dataset.batch(step + i) for i in range(k)]
+        out = []
+        for j in range(len(per_step[0])):
+            arr = np.stack([b[j] for b in per_step])
+            inner = array_pspec(self.mesh, arr.ndim - 1,
+                                arr.shape[2] if arr.ndim >= 3 else None)
+            sharding = NamedSharding(self.mesh,
+                                     PartitionSpec(None, *inner))
+            if jax.process_count() == 1:
+                out.append(jax.device_put(arr, sharding))
+            else:
+                n, i = jax.process_count(), jax.process_index()
+                per = arr.shape[1] // n
+                out.append(jax.make_array_from_process_local_data(
+                    sharding, arr[:, i * per:(i + 1) * per]))
+        return tuple(out)
+
+    def _prefetched(self, make_items) -> Iterator:
+        """Drive ``make_items`` (a generator of batches) through a
+        background producer thread with a ``prefetch``-deep queue, so
+        host generation + transfer overlaps device compute."""
         if self.prefetch <= 0:
-            step = self.start_step
-            while True:
-                yield self.batch_at(step)
-                step += 1
+            yield from make_items()
             return
 
         q: queue.Queue = queue.Queue(maxsize=self.prefetch)
         stop = threading.Event()
 
         def producer() -> None:
-            step = self.start_step
-            while not stop.is_set():
-                try:
-                    batch = self.batch_at(step)
-                except Exception as e:  # surface errors to the consumer
-                    q.put(e)
-                    return
-                q.put(batch)
-                step += 1
+            try:
+                for batch in make_items():
+                    if stop.is_set():
+                        return
+                    q.put(batch)
+            except Exception as e:  # surface errors to the consumer
+                q.put(e)
+                return
+            q.put(StopIteration())
 
         thread = threading.Thread(target=producer, daemon=True)
         thread.start()
         try:
             while True:
                 item = q.get()
+                if isinstance(item, StopIteration):
+                    return
                 if isinstance(item, Exception):
                     raise item
                 yield item
@@ -140,3 +164,28 @@ class DataLoader:
             # unblock a producer stuck on a full queue
             while not q.empty():
                 q.get_nowait()
+
+    def __iter__(self) -> Iterator[tuple[jax.Array, ...]]:
+        def gen():
+            step = self.start_step
+            while True:
+                yield self.batch_at(step)
+                step += 1
+
+        yield from self._prefetched(gen)
+
+    def iter_stacked(self, sizes: list[int],
+                     *, start_step: int | None = None) -> Iterator:
+        """Prefetching iterator over STACKED windows: yields
+        ``stacked_batch_at(s, k)`` for consecutive windows of the given
+        sizes — the input stream of the Trainer's device-side multistep
+        loop, with the same background-thread overlap as ``__iter__``
+        (without it the device would idle through host RNG + stack +
+        transfer of k batches between fused dispatches)."""
+        def gen():
+            step = self.start_step if start_step is None else start_step
+            for k in sizes:
+                yield self.stacked_batch_at(step, k)
+                step += k
+
+        yield from self._prefetched(gen)

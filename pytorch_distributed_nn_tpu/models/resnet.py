@@ -25,6 +25,45 @@ from pytorch_distributed_nn_tpu.models import register
 from pytorch_distributed_nn_tpu.nn.dtypes import get_policy
 
 
+def space_to_depth(x, block: int = 2):
+    """(N, H, W, C) → (N, H/b, W/b, b*b*C), channel order (bh, bw, c)."""
+    n, h, w, c = x.shape
+    if h % block or w % block:
+        raise ValueError(f"H/W {h}x{w} not divisible by block {block}")
+    x = x.reshape(n, h // block, block, w // block, block, c)
+    return x.transpose(0, 1, 3, 2, 4, 5).reshape(
+        n, h // block, w // block, block * block * c)
+
+
+def conv7_to_s2d_kernel(kernel):
+    """Exact stem rewrite: the (7, 7, C, F) stride-2/pad-3 kernel as the
+    (4, 4, 4C, F) stride-1/pad-(2,1) kernel over the 2x2 space-to-depth
+    input. Output pixel o reads original taps at input offsets
+    2o-3..2o+3; in block space that is blocks o-2..o+1 whose elements
+    sit at offsets 2o-4..2o+3 — so pad the kernel LEFT with one zero
+    tap (offset -4) to 8x8, then space-to-depth the tap grid exactly
+    like the input. Same taps, same products, regrouped — logits match
+    the 7x7 stem to float-associativity (tests/test_models.py).
+    """
+    k = jnp.pad(kernel, ((1, 0), (1, 0), (0, 0), (0, 0)))  # 8x8xCxF
+    kh, kw, c, f = k.shape
+    k = k.reshape(kh // 2, 2, kw // 2, 2, c, f)
+    # match the input's (bh, bw, c) channel interleave
+    return k.transpose(0, 2, 1, 3, 4, 5).reshape(kh // 2, kw // 2,
+                                                 4 * c, f)
+
+
+def s2d_kernel_to_conv7(kernel):
+    """Inverse of :func:`conv7_to_s2d_kernel`: (4, 4, 4C, F) → the
+    original (7, 7, C, F) — exporting an s2d-stem checkpoint back to
+    torchvision layout (utils/torch_interop.py)."""
+    kh, kw, c4, f = kernel.shape
+    c = c4 // 4
+    k = kernel.reshape(kh, kw, 2, 2, c, f).transpose(0, 2, 1, 3, 4, 5)
+    k = k.reshape(kh * 2, kw * 2, c, f)
+    return k[1:, 1:]  # strip the zero pad tap (offset -4)
+
+
 class BottleneckBlock(nn.Module):
     filters: int
     strides: int = 1
@@ -62,15 +101,32 @@ class ResNet(nn.Module):
     stage_sizes: Sequence[int] = (3, 4, 6, 3)  # ResNet-50
     width: int = 64
     num_classes: int = 1000
+    # "conv7": the torch-geometry 7x7/stride-2 stem (torchvision
+    # checkpoint interop). "s2d": the MLPerf-TPU space-to-depth stem —
+    # 2x2 s2d then a 4x4/stride-1 conv, mathematically the SAME map
+    # (conv7_to_s2d_kernel converts checkpoints exactly) but with 12
+    # input channels instead of 3, so XLA's im2col feeds the MXU dense
+    # columns instead of 3-channel-starved ones.
+    stem: str = "conv7"
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
     def __call__(self, x, *, train: bool = False):
         x = x.astype(self.dtype)
-        x = nn.Conv(self.width, (7, 7), strides=(2, 2), padding=[(3, 3)] * 2,
-                    use_bias=False, dtype=self.dtype,
-                    param_dtype=self.param_dtype, name="conv_init")(x)
+        if self.stem == "s2d":
+            x = space_to_depth(x, 2)
+            x = nn.Conv(self.width, (4, 4), strides=(1, 1),
+                        padding=[(2, 1)] * 2, use_bias=False,
+                        dtype=self.dtype, param_dtype=self.param_dtype,
+                        name="conv_init_s2d")(x)
+        elif self.stem == "conv7":
+            x = nn.Conv(self.width, (7, 7), strides=(2, 2),
+                        padding=[(3, 3)] * 2, use_bias=False,
+                        dtype=self.dtype, param_dtype=self.param_dtype,
+                        name="conv_init")(x)
+        else:
+            raise ValueError(f"unknown stem {self.stem!r}")
         x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
                          epsilon=1e-5, dtype=self.dtype,
                          param_dtype=self.param_dtype, name="bn_init")(x)
@@ -98,6 +154,7 @@ def build_resnet50(cfg: ModelConfig) -> ResNet:
         stage_sizes=tuple(cfg.extra.get("stage_sizes", (3, 4, 6, 3))),
         width=cfg.extra.get("width", 64),
         num_classes=cfg.extra.get("num_classes", 1000),
+        stem=cfg.extra.get("stem", "conv7"),
         dtype=policy.compute_dtype,
         param_dtype=policy.param_dtype,
     )

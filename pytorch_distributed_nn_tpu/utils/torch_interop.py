@@ -393,6 +393,7 @@ def resnet50_params_from_torch(
     state_dict: Mapping[str, Any],
     *,
     stage_sizes: tuple[int, ...] = (3, 4, 6, 3),
+    stem: str = "conv7",
 ) -> tuple[dict, dict]:
     """torchvision ``resnet50().state_dict()`` → (params, batch_stats)
     for models/resnet.py — the reference's config-2 model family, so a
@@ -407,9 +408,21 @@ def resnet50_params_from_torch(
     converted weights are logit-equivalent in eval mode).
     """
     tracked = _TrackingDict(state_dict)
-    params: dict = {
-        "conv_init": {"kernel": _conv_kernel(tracked["conv1.weight"])},
-    }
+    k7 = _conv_kernel(tracked["conv1.weight"])
+    if stem == "s2d":
+        # the space-to-depth stem (models/resnet.py): the 7x7 kernel
+        # rewrites EXACTLY to the 4x4/12-channel layout, so torchvision
+        # checkpoints drop into s2d models logit-equivalently too
+        from pytorch_distributed_nn_tpu.models.resnet import (
+            conv7_to_s2d_kernel,
+        )
+
+        params: dict = {"conv_init_s2d": {
+            "kernel": np.asarray(conv7_to_s2d_kernel(k7))}}
+    elif stem == "conv7":
+        params = {"conv_init": {"kernel": k7}}
+    else:
+        raise ValueError(f"unknown stem {stem!r}")
     stats: dict = {}
     params["bn_init"], stats["bn_init"] = _bn_from_torch(tracked, "bn1")
 
@@ -469,7 +482,15 @@ def resnet50_params_to_torch(params: Mapping[str, Any],
         sd[key + ".num_batches_tracked"] = torch.zeros((), dtype=torch.int64)
 
     stats = model_state["batch_stats"]
-    put_conv("conv1", params["conv_init"]["kernel"])
+    if "conv_init_s2d" in params:  # s2d stem: exact inverse rewrite
+        from pytorch_distributed_nn_tpu.models.resnet import (
+            s2d_kernel_to_conv7,
+        )
+
+        put_conv("conv1", np.asarray(
+            s2d_kernel_to_conv7(params["conv_init_s2d"]["kernel"])))
+    else:
+        put_conv("conv1", params["conv_init"]["kernel"])
     put_bn("bn1", params["bn_init"], stats["bn_init"])
     for stage, n_blocks in enumerate(stage_sizes):
         for block in range(n_blocks):

@@ -170,6 +170,11 @@ def main() -> int:
     # throughput story
     metric_runs.append(("decode_b256", "decode",
                         ["--per-chip-batch", "256"]))
+    # the flagship: the TRUE 8.03B Llama-3, weight-only int8 (fits the
+    # single chip's HBM) — latency series at b=8 and throughput at b=32
+    metric_runs.append(("decode_8b_int8", "decode", ["--real-8b-int8"]))
+    metric_runs.append(("decode_8b_int8_b32", "decode",
+                        ["--real-8b-int8", "--per-chip-batch", "32"]))
     for key, metric, extra in metric_runs:
         cmd = [sys.executable, "bench.py", "--metric", metric] + extra
         if metric == "loader":

@@ -248,3 +248,41 @@ def test_llama_remat_offload_matches_remat():
                                                 rtol=1e-6, atol=1e-7),
         base, off,
     )
+
+
+def test_resnet_s2d_stem_matches_conv7_exactly():
+    """The MLPerf space-to-depth stem is the SAME linear map as the 7x7
+    stride-2 stem — conv7_to_s2d_kernel rewrites the kernel exactly, so
+    full-model logits must agree to float tolerance (models/resnet.py).
+    """
+    import jax.numpy as jnp
+
+    from pytorch_distributed_nn_tpu.models.resnet import (
+        ResNet,
+        conv7_to_s2d_kernel,
+        space_to_depth,
+    )
+
+    kw = dict(stage_sizes=(1, 1), width=8, num_classes=5)
+    m7 = ResNet(**kw, stem="conv7")
+    ms = ResNet(**kw, stem="s2d")
+    x = jax.random.normal(jax.random.key(0), (2, 32, 32, 3), jnp.float32)
+    v7 = m7.init(jax.random.key(1), x, train=False)
+    # transplant: same weights, stem kernel rewritten
+    params = jax.tree.map(lambda a: a, v7["params"])
+    k7 = params.pop("conv_init")["kernel"]
+    params["conv_init_s2d"] = {"kernel": conv7_to_s2d_kernel(k7)}
+    ref = m7.apply(v7, x, train=False)
+    got = ms.apply({"params": params,
+                    "batch_stats": v7["batch_stats"]}, x, train=False)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=1e-4, atol=1e-4)
+    # and the raw s2d layout: block channel order (bh, bw, c)
+    y = space_to_depth(x, 2)
+    assert y.shape == (2, 16, 16, 12)
+    np.testing.assert_array_equal(np.asarray(y[0, 0, 0, :3]),
+                                  np.asarray(x[0, 0, 0]))
+    np.testing.assert_array_equal(np.asarray(y[0, 0, 0, 3:6]),
+                                  np.asarray(x[0, 0, 1]))
+    np.testing.assert_array_equal(np.asarray(y[0, 0, 0, 6:9]),
+                                  np.asarray(x[0, 1, 0]))

@@ -70,3 +70,71 @@ def test_multistep_rejects_bad_k_and_oversize_pool():
     with pytest.raises(ValueError, match="pool"):
         make_multistep(lambda s, x, y: (s, {"loss": jnp.zeros(())}), 2)(
             jnp.zeros(()), xs[0], xs[1])
+
+
+def test_trainer_multistep_matches_per_step_loop():
+    """cfg.multistep_k: the Trainer's fused-dispatch loop must train to
+    the SAME state as the per-step loop on the same data, and log
+    per-step losses at the log_every cadence (VERDICT r3 Next #5)."""
+    import numpy as np
+
+    from pytorch_distributed_nn_tpu.config import get_config
+    from pytorch_distributed_nn_tpu.train.trainer import Trainer
+
+    base = dict(steps=12, log_every=3)
+    ref = Trainer(get_config("mlp_mnist", **base))
+    ref_hist = ref.train()
+    fused = Trainer(get_config("mlp_mnist", **base, multistep_k=5))
+    fused_hist = fused.train()  # dispatches of 5, 5, 2
+
+    # identical final params (same batches, same order, same math)
+    for a, b in zip(jax.tree.leaves(ref.state.params),
+                    jax.tree.leaves(fused.state.params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-5, atol=2e-5)
+    # identical logged steps and losses
+    assert [r.step for r in fused_hist] == [r.step for r in ref_hist]
+    np.testing.assert_allclose([r.loss for r in fused_hist],
+                               [r.loss for r in ref_hist],
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_trainer_multistep_checkpoint_rounds_to_boundary(tmp_path):
+    """checkpoint_every inside a fused window saves at the dispatch
+    boundary (the scan can't pause mid-flight) — and resume continues
+    to the exact step budget."""
+    from pytorch_distributed_nn_tpu.config import get_config
+    from pytorch_distributed_nn_tpu.train.trainer import Trainer
+
+    cfg = get_config("mlp_mnist", steps=10, log_every=0, multistep_k=4,
+                     checkpoint_dir=str(tmp_path), checkpoint_every=5)
+    t = Trainer(cfg)
+    t.train()
+    t.close()
+    # windows end at 4, 8, 10; every=5 fires in [5..8] and [9..10]
+    assert t.ckpt is not None
+    restored = Trainer(cfg)  # resume=True default
+    assert restored.data_step in (8, 10)
+    restored.train()  # runs only the remaining budget
+    assert restored.data_step == 10
+    restored.close()
+
+
+def test_trainer_multistep_pool_mode_repeats_data():
+    """multistep_pool cycles a fixed device-resident pool (benchmark
+    mode): trains, and transfers only pool-many batches."""
+    from pytorch_distributed_nn_tpu.config import get_config
+    from pytorch_distributed_nn_tpu.train.trainer import Trainer
+
+    cfg = get_config("mlp_mnist", steps=9, log_every=0, multistep_k=3,
+                     multistep_pool=2)
+    t = Trainer(cfg)
+    generated = []
+    orig = t.dataset.batch
+    t.dataset.batch = lambda s: (generated.append(s), orig(s))[1]
+    t.train()
+    assert t.data_step == 9
+    assert float(jax.device_get(t.last_metrics["loss"])) > 0
+    # the pool transfers exactly pool-many batches, once — 9 fused
+    # steps cycle them on device instead of generating 9 batches
+    assert generated == [0, 1]
