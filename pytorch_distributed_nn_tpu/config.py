@@ -328,9 +328,15 @@ def _llama3_longcontext() -> TrainConfig:
                           schedule="cosine"),
         data=DataConfig(dataset="lm_synthetic", batch_size=1,
                         seq_len=32768, vocab_size=32000),
+        # head_dim 128 = the REAL Llama-3 per-head geometry (4096/32).
+        # The r1-r3 stand-in used 16 heads at d=1024 (head_dim 64),
+        # which half-fills the MXU contraction in every attention
+        # matmul — measured r4 at T=32k fwd+bwd: 165 ms vs 102 ms for
+        # the same H*D with head_dim 128 (1.62x). Same param count,
+        # same FLOPs, realistic kernel shape.
         model=ModelConfig(name="llama3_8b", remat=True,
                           extra=dict(num_layers=8, d_model=1024,
-                                     num_heads=16, num_kv_heads=8,
+                                     num_heads=8, num_kv_heads=4,
                                      mlp_dim=3584, vocab_size=32000)),
         parallel=ParallelConfig(strategy="dp"),
         # at T=32k the (T, vocab) logits are the HBM limiter (dense

@@ -81,13 +81,17 @@ class DataLoader:
                 return True
         return False
 
-    def _host_slice(self, arr: np.ndarray) -> np.ndarray:
+    def _host_slice(self, arr: np.ndarray, axis: int = 0) -> np.ndarray:
         """The rows of the global batch this process owns (contiguous
-        block layout, matching NamedSharding's row-major split)."""
+        block layout, matching NamedSharding's row-major split).
+        ``axis``: the batch-rows dimension — 0 for plain batches, 1 for
+        pool-stacked (k, B, ...) windows."""
         n = jax.process_count()
-        per = arr.shape[0] // n
+        per = arr.shape[axis] // n
         i = jax.process_index()
-        return arr[i * per:(i + 1) * per]
+        idx = [slice(None)] * arr.ndim
+        idx[axis] = slice(i * per, (i + 1) * per)
+        return arr[tuple(idx)]
 
     def _to_global(self, arr: np.ndarray) -> jax.Array:
         sharding = NamedSharding(
@@ -121,10 +125,8 @@ class DataLoader:
             if jax.process_count() == 1:
                 out.append(jax.device_put(arr, sharding))
             else:
-                n, i = jax.process_count(), jax.process_index()
-                per = arr.shape[1] // n
                 out.append(jax.make_array_from_process_local_data(
-                    sharding, arr[:, i * per:(i + 1) * per]))
+                    sharding, self._host_slice(arr, axis=1)))
         return tuple(out)
 
     def _prefetched(self, make_items) -> Iterator:

@@ -76,6 +76,7 @@ def _converted_params(arch: str, state_dict, model_cfg):
         return ti.resnet50_params_from_torch(
             state_dict,
             stage_sizes=tuple(e.get("stage_sizes", (3, 4, 6, 3))),
+            stem=e.get("stem", "conv7"),
         )
     if arch == "vit":
         return ti.vit_params_from_torch(
