@@ -391,6 +391,17 @@ def bench_decode(args) -> int:
     from pytorch_distributed_nn_tpu.models import get_model
 
     cfg = get_config("llama3_8b_zero")
+    if args.real_8b_int8 and args.tp > 1:
+        # the TP sharding rules pattern-match float param names
+        # (kernel$/embedding$, parallel/sharding_rules.py); the
+        # quantized tree's kernel_q/embedding_q leaves match nothing,
+        # so generate(mesh=) would silently REPLICATE all 8 GB and
+        # label the record tp=N — fail loudly instead of lying
+        raise SystemExit(
+            "--real-8b-int8 with --tp is not supported yet: the "
+            "int8 param layout has no tensor-parallel sharding rules "
+            "(leaves are kernel_q/scale, not kernel)"
+        )
     if args.real_8b_int8:
         # TRUE 8B dims (the preset's defaults), int8 weight-only
         cfg.model.extra = dict(quantized=True)
@@ -403,6 +414,21 @@ def bench_decode(args) -> int:
                                vocab_size=32000)
     cfg.model.remat = False
     model = get_model(cfg.model)
+    mesh = None
+    if args.tp > 1:
+        # tensor-parallel SPMD decoding (Megatron row/column layouts
+        # from shard_params_for_inference + head-sharded KV caches).
+        # With one real chip this runs on the virtual CPU mesh
+        # (JAX_PLATFORMS=cpu + xla_force_host_platform_device_count) —
+        # the record labels the backend so a CPU-relative number is
+        # never mistaken for a chip number.
+        from pytorch_distributed_nn_tpu.runtime.mesh import (
+            MeshSpec,
+            make_mesh,
+        )
+
+        mesh = make_mesh(
+            MeshSpec(tensor=args.tp, data=-1).resolve(len(jax.devices())))
     B, P, N = args.per_chip_batch or 8, 128, 128
     rng = jax.random.key(0)
     prompt = jax.random.randint(rng, (B, P), 0, model.vocab_size,
@@ -427,20 +453,35 @@ def bench_decode(args) -> int:
     # block_until_ready can return before remote execution completes
     # (same caveat as the train-loop fence above) — r4 measured it
     # inflating this metric 2.4x on the 8B run
-    _ = np.asarray(generate(model, params, prompt, N, temperature=0.0))
+    if mesh is not None:
+        # pre-shard ONCE: generate() re-places params every call
+        # (global_device_put is a no-op for already-correctly-sharded
+        # arrays), so without this the timed call would measure param
+        # layout, not decode (advisor r4 finding)
+        from pytorch_distributed_nn_tpu.inference.generate import (
+            shard_params_for_inference,
+        )
+
+        params = shard_params_for_inference(params, mesh)
+    _ = np.asarray(generate(model, params, prompt, N, temperature=0.0,
+                            mesh=mesh))
     t0 = time.perf_counter()
-    out = generate(model, params, prompt, N, temperature=0.0)
+    out = generate(model, params, prompt, N, temperature=0.0, mesh=mesh)
     _ = np.asarray(out)
     dt = time.perf_counter() - t0
     value = B * N / dt
     name = ("TRUE Llama-3-8B int8 weight-only"
             if args.real_8b_int8 else "llama scaled")
+    backend = jax.default_backend()
+    tp_note = (f", tp={args.tp} ({backend} backend"
+               + (" — CPU-RELATIVE, not a chip number" if backend != "tpu"
+                  else "") + ")") if args.tp > 1 else ""
     print(json.dumps(dict(
         metric=_METRIC_NAMES["decode"],
         value=round(value, 1), unit="tokens/sec", vs_baseline=None,
-        n_params=n_params,
+        n_params=n_params, backend=backend,
         detail=f"{name} ({n_params/1e9:.2f}B params), KV-cache greedy, "
-               f"batch {B}, prompt {P}, new {N}",
+               f"batch {B}, prompt {P}, new {N}{tp_note}",
     )))
     return 0
 
@@ -481,6 +522,11 @@ def main(argv=None) -> int:
     ap.add_argument("--probe-timeout", type=float, default=75.0,
                     help="seconds before one availability probe counts "
                          "as hung")
+    ap.add_argument("--tp", type=int, default=1,
+                    help="decode metric: tensor-parallel degree "
+                         "(generate(mesh=) SPMD decoding; on one real "
+                         "chip run under JAX_PLATFORMS=cpu with a "
+                         "virtual mesh for a relative-overhead number)")
     ap.add_argument("--real-8b-int8", action="store_true",
                     help="decode metric: run the TRUE 8.03B Llama-3 "
                          "with weight-only int8 params (fits one v5e "
