@@ -1,11 +1,15 @@
 """Host→device loader with per-process sharding and background prefetch.
 
 Replaces the reference's ``DataLoader`` + ``DistributedSampler`` pair
-(SURVEY.md §2a): each host process materialises only its slice of the
-global batch, then the slices are assembled into one global ``jax.Array``
-sharded over the mesh's data axes. A background thread keeps ``prefetch``
-batches in flight so host generation overlaps device compute (the TPU
-analogue of torch's pinned-memory worker pool).
+(SURVEY.md §2a): every host process generates the same seed-deterministic
+GLOBAL batch, and each feeds exactly the shards its devices own into one
+global ``jax.Array`` (``make_array_from_callback``) — correct under any
+mesh, including model-parallel layouts where the batch replicates across
+processes. That determinism is the correctness precondition: a
+per-process non-deterministic dataset would silently mis-assemble. A
+background thread keeps ``prefetch`` batches in flight so host
+generation overlaps device compute (the TPU analogue of torch's
+pinned-memory worker pool).
 """
 
 from __future__ import annotations
@@ -62,9 +66,9 @@ class DataLoader:
             )
         if (mesh.shape.get(AXIS_SEQ, 1) > 1 and jax.process_count() > 1
                 and self._seq_spans_processes(mesh)):
-            # _host_slice hands each process its batch rows with the
-            # FULL sequence dim; that is only the process's addressable
-            # portion when every seq-axis device is process-local
+            # the callback assembly (_assemble) could feed seq-sharded
+            # rows across processes, but the ring-attention compute
+            # path is untested across hosts and the seq axis wants ICI
             raise NotImplementedError(
                 "sequence sharding across processes is not supported: "
                 "keep the seq mesh axis within one host (it wants ICI "
@@ -81,17 +85,20 @@ class DataLoader:
                 return True
         return False
 
-    def _host_slice(self, arr: np.ndarray, axis: int = 0) -> np.ndarray:
-        """The rows of the global batch this process owns (contiguous
-        block layout, matching NamedSharding's row-major split).
-        ``axis``: the batch-rows dimension — 0 for plain batches, 1 for
-        pool-stacked (k, B, ...) windows."""
-        n = jax.process_count()
-        per = arr.shape[axis] // n
-        i = jax.process_index()
-        idx = [slice(None)] * arr.ndim
-        idx[axis] = slice(i * per, (i + 1) * per)
-        return arr[tuple(idx)]
+    def _assemble(self, arr: np.ndarray, sharding) -> jax.Array:
+        """Global jax.Array from the host-side global batch. The
+        dataset's batches are seed-deterministic and identical on every
+        process, so each process feeds exactly the shards its devices
+        own via ``make_array_from_callback`` — correct for ANY
+        sharding, including model-parallel meshes where the batch is
+        REPLICATED across processes (r4: the 2-process pipeline gang
+        test caught the old rows-split-by-process-index assembly
+        feeding half a replicated batch)."""
+        if jax.process_count() == 1:
+            return jax.device_put(arr, sharding)
+        return jax.make_array_from_callback(
+            arr.shape, sharding, lambda idx: arr[idx]
+        )
 
     def _to_global(self, arr: np.ndarray) -> jax.Array:
         sharding = NamedSharding(
@@ -99,11 +106,7 @@ class DataLoader:
             array_pspec(self.mesh, arr.ndim,
                         arr.shape[1] if arr.ndim >= 2 else None),
         )
-        if jax.process_count() == 1:
-            return jax.device_put(arr, sharding)
-        return jax.make_array_from_process_local_data(
-            sharding, self._host_slice(arr)
-        )
+        return self._assemble(arr, sharding)
 
     def batch_at(self, step: int) -> tuple[jax.Array, ...]:
         """Deterministic global batch for one step (no prefetch)."""
@@ -122,11 +125,7 @@ class DataLoader:
                                 arr.shape[2] if arr.ndim >= 3 else None)
             sharding = NamedSharding(self.mesh,
                                      PartitionSpec(None, *inner))
-            if jax.process_count() == 1:
-                out.append(jax.device_put(arr, sharding))
-            else:
-                out.append(jax.make_array_from_process_local_data(
-                    sharding, self._host_slice(arr, axis=1)))
+            out.append(self._assemble(arr, sharding))
         return tuple(out)
 
     def _prefetched(self, make_items) -> Iterator:
