@@ -52,11 +52,6 @@ class DataLoader:
         self.start_step = start_step
         self.prefetch = prefetch
         gbs = dataset.batch_size
-        n_proc = jax.process_count()
-        if gbs % n_proc:
-            raise ValueError(
-                f"global batch {gbs} not divisible by {n_proc} processes"
-            )
         from pytorch_distributed_nn_tpu.runtime.mesh import data_axis_size
 
         dp = data_axis_size(mesh)

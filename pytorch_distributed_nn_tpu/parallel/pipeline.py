@@ -655,6 +655,15 @@ def _state_placement(mesh: Mesh, part: StagePartition, S: int, step,
             raise RuntimeError("call place_state before stepping")
         return compiled["step"](state, x, y)
 
+    def jitted():
+        """The underlying jax.jit step (for AOT lowering /
+        memory_analysis — scripts/validate_pp_layout.py); available
+        after place_state."""
+        if "step" not in compiled:
+            raise RuntimeError("call place_state before jitted()")
+        return compiled["step"]
+
+    step_dispatch.jitted = jitted
     return step_dispatch, place_state
 
 

@@ -152,6 +152,8 @@ def dcn_factors(spec: MeshSpec, n_slices: int) -> dict[str, int]:
 def make_mesh(
     spec: MeshSpec | None = None,
     devices: Sequence[jax.Device] | None = None,
+    *,
+    force_slices: int | None = None,
 ) -> Mesh:
     """Build a named Mesh over ``devices`` (default: all).
 
@@ -161,16 +163,40 @@ def make_mesh(
     degrees peeled onto the outermost axes (:func:`dcn_factors`), so
     cross-slice traffic is only pipe edges / DP gradient allreduce.
     Falls back to row-major reshape (fine for CPU test meshes).
+
+    ``force_slices``: treat the device list as that many DCN-connected
+    slices (row-major groups) even when the backend reports one — the
+    CPU-harness hook that lets tests and ``dryrun_multichip`` exercise
+    the hybrid dcn-factor placement and prove the pipeline's ppermute
+    schedule lowers with ``pipe`` on the DCN axis, without TPU slices.
     """
     if devices is None:
         devices = jax.devices()
     spec = (spec or MeshSpec()).resolve(len(devices))
-    n_slices = slice_count(devices)
+    n_slices = force_slices or slice_count(devices)
+    if force_slices and len(devices) % force_slices:
+        raise ValueError(
+            f"{len(devices)} devices don't split into "
+            f"{force_slices} equal slices"
+        )
     if n_slices > 1:
         # Outside the try: an unplaceable multi-slice spec must raise,
         # not silently fall back to slice-unaware row-major placement.
         dcn = dcn_factors(spec, n_slices)
         ici_shape = tuple(s // dcn[a] for a, s in zip(AXES, spec.shape))
+    if force_slices and n_slices > 1:
+        # CPU harness: build the hybrid arrangement by hand (the real
+        # create_hybrid_device_mesh groups by device slice_index, which
+        # CPU devices lack). Row-major slice groups; axis a's index is
+        # (dcn_a, ici_a) interleaved dcn-major — the same layout the
+        # hybrid assigner produces, so pipe-over-DCN placement and the
+        # resulting ppermute lowering are exercised faithfully.
+        dcn_shape = tuple(dcn[a] for a in AXES)
+        arr = np.asarray(devices, dtype=object).reshape(
+            dcn_shape + ici_shape)
+        n = len(AXES)
+        order = [ax for i in range(n) for ax in (i, n + i)]
+        return Mesh(arr.transpose(order).reshape(spec.shape), AXES)
     try:
         from jax.experimental import mesh_utils
 

@@ -1,0 +1,214 @@
+#!/usr/bin/env python
+"""AOT-validate the config-4 pipeline layout on a virtual v5e-16
+(VERDICT r3 Missing #4 / Next #6 — the LAYOUT_8B.json treatment for
+``transformer_lm_pp``).
+
+No pod is available, so nothing is timed: the TRUE preset (GPT-2-small
+Transformer-LM, global batch 64, seq 1024, pipe=4 x data=4 on 16
+virtual CPU devices) is placed and its train step compiled through the
+SPMD partitioner for all THREE schedules — gpipe, 1f1b, and interleaved
+v=3 (12 layers / 4 stages) — proving sharding propagation + collective insertion accept each
+layout at pod shape. Per schedule the record carries:
+
+- the compiler's buffer assignment (argument/temp bytes, whole-mesh
+  CPU compile — an upper bound, see LAYOUT_8B caveats);
+- the ANALYTIC per-chip activation model keyed by each schedule's
+  OWN depth table: gpipe holds all M microbatch boundaries, 1f1b
+  holds ``Schedule.max_in_flight`` = min(M, 2S-1), interleaved holds
+  ``InterleavedSchedule.act_depth`` chunk-boundaries (the v x cost
+  VERDICT flagged: act_depth grows ~v-fold in chunk units);
+- the tick-table bubble fraction vs the closed-form model
+  ((S-1)/(M+S-1) for gpipe/1f1b; ~1/v of that for interleaved) — the
+  schedule tables must reproduce the theory EXACTLY, same cost model
+  as tests/test_pipeline_schedule.py.
+
+Usage:
+    python scripts/validate_pp_layout.py [--devices 16] [--hbm-gb 16]
+        [--out LAYOUT_PP.json] [--a.b config overrides ...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+sys.path.insert(0, ".")  # run from repo root without install
+
+
+def bubble_fraction_from_tables(schedule, *, v: int = 1) -> float:
+    """Idle fraction under the tick cost model (a tick costs the max
+    live-unit count over devices; one chunk unit = 1/v plain stage —
+    same model as tests/test_pipeline_schedule.py's bubble proof)."""
+    import numpy as np
+
+    from pytorch_distributed_nn_tpu.parallel.pipeline_schedule import (
+        NO_OP,
+    )
+
+    if v == 1:
+        live = ((schedule.fwd != NO_OP).astype(int)
+                + (schedule.bwd != NO_OP).astype(int))
+    else:
+        live = ((schedule.fwd_chunk != NO_OP).astype(int)
+                + (schedule.bwd_chunk != NO_OP).astype(int))
+    cost_plain = float(np.sum(live.max(axis=1))) / v
+    work = 2.0 * schedule.n_micro
+    return (cost_plain - work) / cost_plain
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--devices", type=int, default=16)
+    ap.add_argument("--hbm-gb", type=float, default=16.0)
+    ap.add_argument("--out", default="")
+    args, rest = ap.parse_known_args(argv)
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", args.devices)
+
+    import numpy as np
+
+    from pytorch_distributed_nn_tpu.config import get_config, parse_overrides
+    from pytorch_distributed_nn_tpu.models import get_model
+    from pytorch_distributed_nn_tpu.parallel.pipeline import (
+        make_pipeline_train_step,
+    )
+    from pytorch_distributed_nn_tpu.parallel.pipeline_schedule import (
+        interleaved_1f1b,
+        one_f_one_b,
+    )
+    from pytorch_distributed_nn_tpu.runtime.mesh import make_mesh
+    from pytorch_distributed_nn_tpu.train.losses import get_loss_fn
+    from pytorch_distributed_nn_tpu.train.optim import make_optimizer
+    from pytorch_distributed_nn_tpu.train.state import TrainState
+
+    base = get_config("transformer_lm_pp", **parse_overrides(rest))
+    mesh = make_mesh(base.mesh.resolve(args.devices))
+    S = mesh.shape["pipe"]
+    M = base.parallel.microbatches
+    B, T = base.data.batch_size, base.data.seq_len
+    budget = args.hbm_gb * (1 << 30)
+
+    model = get_model(base.model)
+    loss_fn = get_loss_fn(base.data.dataset)
+    rng = jax.random.key(0)
+    import jax.numpy as jnp
+
+    variables = model.init(rng, jnp.zeros((1, T), jnp.int32),
+                           train=False)
+    n_params = sum(int(np.prod(l.shape))
+                   for l in jax.tree.leaves(variables["params"]))
+    print(f"# model: {n_params/1e6:.1f}M params, mesh {dict(mesh.shape)}, "
+          f"B={B} T={T} M={M}", file=sys.stderr)
+
+    # per-layer boundary activation (one microbatch, bf16 compute)
+    d = getattr(model, "d_model", 768)
+    comp = 2
+    mb_boundary = (B // M) * T * d * comp
+
+    records = {}
+    # interleaved v: layers must divide S*v — the TRUE 12-layer model on
+    # 4 stages takes v=3 (12 = 4 x 3), not the generic v=2
+    for sched_name, v in (("gpipe", 1), ("1f1b", 1), ("interleaved", 3)):
+        cfg = get_config("transformer_lm_pp", **parse_overrides(rest))
+        cfg.parallel.pipeline_schedule = sched_name
+        cfg.parallel.pipe_chunks = v if sched_name == "interleaved" else 1
+        t0 = time.time()
+        tx = make_optimizer(cfg.optim, total_steps=cfg.steps)
+        state = TrainState.create(
+            apply_fn=model.apply, params=variables["params"], tx=tx,
+            model_state={k: v2 for k, v2 in variables.items()
+                         if k != "params"},
+            rng=jax.random.key(1),
+        )
+        step_fn, place_fn = make_pipeline_train_step(cfg, mesh, loss_fn,
+                                                     model)
+        placed = place_fn(state)
+        # EXACT per-chip state bytes from the placed shardings (params
+        # + both Adam moments, stage-stacked layout included) — the
+        # worst chip, since edge stages carry the embed/head tables
+        per_dev = {d: 0 for d in mesh.devices.flat}
+        for leaf in jax.tree.leaves(placed):
+            if not hasattr(leaf, "sharding"):
+                continue
+            shard_elems = int(np.prod(
+                leaf.sharding.shard_shape(tuple(leaf.shape)) or (1,)))
+            nbytes = shard_elems * leaf.dtype.itemsize
+            for d in leaf.sharding.device_set:
+                per_dev[d] += nbytes
+        state_chip_b = max(per_dev.values())
+        x = jax.ShapeDtypeStruct((B, T), jnp.int32)
+        lowered = step_fn.jitted().lower(placed, x, x)
+        ma = lowered.compile().memory_analysis()
+        mem = {
+            "argument_gib": round(
+                ma.argument_size_in_bytes / (1 << 30), 3),
+            "temp_gib_whole_mesh_cpu_upper_bound": round(
+                ma.temp_size_in_bytes / (1 << 30), 3),
+        }
+        # analytic per-chip activation depth, schedule-exact
+        if sched_name == "gpipe":
+            depth_unit, depth = "microbatch boundaries", M
+            bubble_tbl = None
+        elif sched_name == "1f1b":
+            tbl = one_f_one_b(S, M)
+            depth_unit, depth = "microbatch boundaries", tbl.max_in_flight
+            bubble_tbl = bubble_fraction_from_tables(tbl)
+        else:
+            tbl = interleaved_1f1b(S, v, M)
+            # act_depth counts CHUNK boundaries; a chunk boundary is the
+            # same (B/M, T, d) tensor — the v x cost VERDICT flagged
+            depth_unit, depth = "chunk boundaries", tbl.act_depth
+            bubble_tbl = bubble_fraction_from_tables(tbl, v=v)
+        # per-chip total = exact state (params + Adam m, v — the
+        # placed-sharding bytes above) + one f32 grad copy of the
+        # worst stage's params (state/3 ≈ one param-sized tree) +
+        # schedule-depth activations
+        grad_b = state_chip_b // 3
+        acts_b = depth * mb_boundary + state_chip_b + grad_b
+        # fill+drain cost (S-1)/v plain-stage units per direction over
+        # 2M units of work: frac = ((S-1)/v) / (M + (S-1)/v)
+        fill = (S - 1) / v
+        bubble_model = fill / (M + fill)
+        records[sched_name] = {
+            "schedule": sched_name,
+            "act_depth": depth,
+            "act_depth_unit": depth_unit,
+            "analytic_act_gib_per_chip": round(
+                depth * mb_boundary / (1 << 30), 4),
+            "state_exact_gib_worst_chip": round(
+                state_chip_b / (1 << 30), 3),
+            "analytic_total_gib_per_chip": round(acts_b / (1 << 30), 3),
+            "fits": bool(acts_b <= budget),
+            "bubble_closed_form": round(bubble_model, 4),
+            **({"bubble_from_tick_tables": round(bubble_tbl, 4)}
+               if bubble_tbl is not None else {}),
+            **mem,
+            "compile_seconds": round(time.time() - t0, 1),
+        }
+        print(f"# {sched_name}: {json.dumps(records[sched_name])}",
+              file=sys.stderr)
+
+    rec = {
+        "metric": "transformer_lm_pp pod layout (AOT, virtual "
+                  f"{args.devices}-chip mesh)",
+        "n_params_m": round(n_params / 1e6, 1),
+        "mesh": dict(mesh.shape),
+        "batch_global": B, "seq_len": T, "microbatches": M,
+        "hbm_budget_gib": args.hbm_gb,
+        "schedules": records,
+        "fits_all": all(r["fits"] for r in records.values()),
+    }
+    print(json.dumps(rec))
+    if args.out:
+        with open(args.out, "w") as f:
+            json.dump(rec, f, indent=1)
+    return 0 if rec["fits_all"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -105,3 +105,51 @@ def test_dcn_factors_warn_on_inner_axis(caplog):
         f = dcn_factors(MeshSpec(data=1, tensor=8).resolve(8), 2)
     assert f["tensor"] == 2
     assert any("ICI-hungry" in r.message for r in caplog.records)
+
+
+def test_force_slices_places_pipe_on_dcn_axis():
+    """make_mesh(force_slices=2): the hybrid dcn-factor placement puts
+    `pipe` (the outermost, DCN-tolerant axis) across the slice groups —
+    every pipe-axis neighbor pair crosses the slice boundary, and ICI
+    axes stay within one slice group."""
+    import jax
+    import numpy as np
+
+    from pytorch_distributed_nn_tpu.runtime.mesh import (
+        MeshSpec,
+        dcn_factors,
+        make_mesh,
+    )
+
+    devs = jax.devices()[:8]
+    spec = MeshSpec(pipe=2, data=4).resolve(8)
+    assert dcn_factors(spec, 2)["pipe"] == 2
+    mesh = make_mesh(spec, devices=devs, force_slices=2)
+    arr = np.asarray(mesh.devices)
+    pipe_axis = list(mesh.axis_names).index("pipe")
+    data_axis = list(mesh.axis_names).index("data")
+    # slice id = row-major group of 4 in the original device list
+    slice_of = {d: i // 4 for i, d in enumerate(devs)}
+    moved = np.moveaxis(arr, pipe_axis, 0)
+    flat = moved.reshape(2, -1)
+    # pipe index 0 devices all in slice 0, pipe index 1 all in slice 1
+    assert {slice_of[d] for d in flat[0]} == {0}
+    assert {slice_of[d] for d in flat[1]} == {1}
+    # the data axis never crosses a slice
+    moved_d = np.moveaxis(arr, data_axis, 0)
+    for line in moved_d.reshape(4, -1).T:
+        assert len({slice_of[d] for d in line}) == 1
+
+
+def test_force_slices_rejects_uneven_split():
+    import jax
+    import pytest
+
+    from pytorch_distributed_nn_tpu.runtime.mesh import (
+        MeshSpec,
+        make_mesh,
+    )
+
+    with pytest.raises(ValueError, match="slices"):
+        make_mesh(MeshSpec(pipe=2, data=3).resolve(6),
+                  devices=jax.devices()[:6], force_slices=4)

@@ -335,8 +335,9 @@ MULTISTEP_WORKER = """
 
 def test_two_process_multistep_matches_single(tmp_path):
     """The device-side fused loop across a process boundary: the
-    stacked (k, B, ...) pool assembles from per-process local rows
-    (loader.stacked_batch_at's cross-process callback assembly), and the fused run matches the single-process per-step
+    stacked (k, B, ...) pool assembles across processes from the
+    deterministic global batch (loader.stacked_batch_at's callback
+    assembly — each process feeds only the shards its devices own), and the fused run matches the single-process per-step
     loop."""
     import jax
 

@@ -1,0 +1,71 @@
+"""scripts/validate_pp_layout.py — AOT pod validation for config 4
+(VERDICT r3 Missing #4): the transformer_lm_pp layout must compile
+through the SPMD partitioner at pod shape for all three schedules, with
+schedule-exact activation depths and tick-table bubbles matching the
+closed-form model.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_bubble_tables_match_closed_form():
+    from scripts.validate_pp_layout import bubble_fraction_from_tables
+    from pytorch_distributed_nn_tpu.parallel.pipeline_schedule import (
+        interleaved_1f1b,
+        one_f_one_b,
+    )
+
+    for S, M in ((4, 8), (2, 4), (4, 16)):
+        got = bubble_fraction_from_tables(one_f_one_b(S, M))
+        assert got == pytest.approx((S - 1) / (M + S - 1))
+    for S, v, M in ((4, 3, 12), (2, 2, 4)):
+        got = bubble_fraction_from_tables(interleaved_1f1b(S, v, M), v=v)
+        fill = (S - 1) / v
+        assert got == pytest.approx(fill / (M + fill))
+
+
+def test_pp_layout_script_scaled():
+    """Same code path as the committed LAYOUT_PP.json artifact, at a
+    scaled size so the three CPU compiles stay fast: all schedules must
+    compile through the partitioner and fit, and the interleaved depth
+    must exceed 1f1b's (the v x cost the artifact quantifies)."""
+    r = subprocess.run(
+        [sys.executable, "scripts/validate_pp_layout.py",
+         "--devices", "8",
+         "--model.extra",
+         '{"num_layers": 6, "d_model": 64, "num_heads": 2, '
+         '"mlp_dim": 128, "vocab_size": 211}',
+         "--data.batch_size", "16", "--data.seq_len", "64",
+         "--data.vocab_size", "211", "--parallel.microbatches", "4",
+         "--mesh.pipe", "2", "--mesh.data", "-1",
+         "--model.remat", "false"],
+        cwd=_REPO, capture_output=True, text=True, timeout=600,
+    )
+    assert r.returncode == 0, r.stderr[-3000:]
+    rec = json.loads(r.stdout.strip().splitlines()[-1])
+    assert rec["fits_all"] is True
+    scheds = rec["schedules"]
+    assert set(scheds) == {"gpipe", "1f1b", "interleaved"}
+    for s in scheds.values():
+        assert "argument_gib" in s  # the compile actually happened
+    assert (scheds["interleaved"]["act_depth"]
+            > scheds["1f1b"]["act_depth"])
+    # tick tables reproduce the closed form exactly
+    for name in ("1f1b", "interleaved"):
+        assert scheds[name]["bubble_from_tick_tables"] == pytest.approx(
+            scheds[name]["bubble_closed_form"])
+
+
+def test_committed_artifact_is_true_size():
+    with open(os.path.join(_REPO, "LAYOUT_PP.json")) as f:
+        rec = json.load(f)
+    assert rec["n_params_m"] > 100  # the TRUE GPT-2-small preset
+    assert rec["mesh"]["pipe"] == 4 and rec["batch_global"] == 64
+    assert rec["fits_all"] is True
