@@ -199,17 +199,23 @@ def bench_loader(args) -> int:
         loader = DataLoader(dataset, mesh,
                             prefetch=max(cfg.data.prefetch, 2))
         it = iter(loader)
-        for _ in range(max(args.warmup, 1)):
-            x, y = next(it)
-        jax.block_until_ready((x, y))
-        steps = max(args.steps, 1)
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            x, y = next(it)
-        jax.block_until_ready((x, y))
-        dt = time.perf_counter() - t0
-        if hasattr(dataset, "close"):
-            dataset.close()  # don't leak decode threads across sweep points
+        try:
+            for _ in range(max(args.warmup, 1)):
+                x, y = next(it)
+            jax.block_until_ready((x, y))
+            steps = max(args.steps, 1)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                x, y = next(it)
+            jax.block_until_ready((x, y))
+            dt = time.perf_counter() - t0
+        finally:
+            # join the prefetch producer even on error: a daemon thread
+            # left mid-XLA-call at interpreter exit SIGABRTs (the race
+            # this guard exists for)
+            it.close()
+            if hasattr(dataset, "close"):
+                dataset.close()  # don't leak decode threads across sweep
         return steps * cfg.data.batch_size / dt
 
     cores = os.cpu_count() or 1

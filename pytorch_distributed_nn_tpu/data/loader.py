@@ -160,6 +160,11 @@ class DataLoader:
             # unblock a producer stuck on a full queue
             while not q.empty():
                 q.get_nowait()
+            # join so the producer finishes its in-flight batch BEFORE
+            # interpreter teardown: a daemon thread aborted mid-XLA-call
+            # at exit dies with "terminate called ... FATAL: exception
+            # not rethrown" (rare SIGABRT seen under full-suite load)
+            thread.join(timeout=10.0)
 
     def __iter__(self) -> Iterator[tuple[jax.Array, ...]]:
         def gen():
