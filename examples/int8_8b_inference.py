@@ -1,0 +1,88 @@
+#!/usr/bin/env python
+"""Serve the TRUE Llama-3-8B from ONE 16 GB v5e chip — weight-only int8.
+
+The bf16 8B weights are 16 GB: more than a single v5e's HBM. Stored
+int8 with per-output-channel scales (nn/quantized.py) they are ~8 GB,
+and every matmul dequantizes tile-wise in VMEM via the Pallas kernel
+(ops/pallas/int8_matmul.py) — measured r4: 358 tok/s greedy decode at
+batch 8 on the real chip.
+
+Two paths shown:
+
+1. **Quantize a trained/converted checkpoint** (the production path):
+   float params → `quantize_model_params` → int8 tree that applies
+   under the same model built with ``quantized=True``. Works with HF
+   checkpoints imported via utils/torch_interop + scripts/convert.py.
+2. **Synthetic weights** (what the benchmark does in this zero-egress
+   container): fill the int8 leaves directly — decode SPEED is
+   value-independent; the numerics are oracle-tested at small scale in
+   tests/test_quantized.py.
+
+Run (small model so it works anywhere, incl. the CPU fallback):
+    python examples/int8_8b_inference.py
+Real-8B benchmark on a chip:
+    python bench.py --metric decode --real-8b-int8
+"""
+
+import sys
+
+sys.path.insert(0, ".")
+
+from pytorch_distributed_nn_tpu.runtime.platform import (  # noqa: E402
+    apply_platform_overrides,
+)
+
+apply_platform_overrides()
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from pytorch_distributed_nn_tpu.inference.generate import generate  # noqa: E402
+from pytorch_distributed_nn_tpu.models.llama import Llama  # noqa: E402
+from pytorch_distributed_nn_tpu.nn.quantized import (  # noqa: E402
+    quantize_model_params,
+    synthetic_int8_params,
+)
+
+# Small dims so the example runs in seconds; for the real thing use
+# Llama() defaults (vocab 128256, 32 layers, d 4096 — 8.03B params).
+DIMS = dict(vocab_size=512, num_layers=2, d_model=128, num_heads=4,
+            num_kv_heads=2, mlp_dim=256)
+
+
+def main() -> int:
+    # ---- path 1: quantize a float checkpoint -------------------------
+    f32 = Llama(**DIMS, dtype=jnp.float32, param_dtype=jnp.float32)
+    q = Llama(**DIMS, quantized=True, dtype=jnp.bfloat16)
+    prompt = jax.random.randint(jax.random.key(0), (2, 12), 0,
+                                DIMS["vocab_size"], jnp.int32)
+    fparams = f32.init(jax.random.key(1), prompt)["params"]
+    qshapes = jax.eval_shape(
+        lambda: q.init(jax.random.key(1), prompt))["params"]
+    qparams = quantize_model_params(dict(fparams), qshapes)
+    int8_bytes = sum(x.size for x in jax.tree.leaves(qparams)
+                     if x.dtype == jnp.int8)
+    f32_bytes = sum(x.size * 4 for x in jax.tree.leaves(fparams))
+    print(f"checkpoint: {f32_bytes/1e6:.1f} MB f32 -> "
+          f"{int8_bytes/1e6:.1f} MB int8")
+
+    out = generate(q, qparams, prompt, max_new_tokens=16)
+    print("decode from quantized checkpoint:", out.shape, out.dtype)
+
+    # logit agreement vs the float oracle (the quality check the test
+    # suite runs at tolerance)
+    ref = f32.apply({"params": fparams}, prompt)
+    got = q.apply({"params": qparams}, prompt).astype(jnp.float32)
+    agree = float(jnp.mean(
+        (got.argmax(-1) == ref.argmax(-1)).astype(jnp.float32)))
+    print(f"argmax agreement vs f32 oracle: {agree:.0%}")
+
+    # ---- path 2: synthetic weights at any size -----------------------
+    sparams = synthetic_int8_params(q, prompt[:, :1])
+    out = generate(q, sparams, prompt, max_new_tokens=8)
+    print("decode from synthetic int8 params:", out.shape)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
