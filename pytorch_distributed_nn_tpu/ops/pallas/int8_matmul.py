@@ -31,11 +31,16 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-# Default tiles (r4 sweep on v5e, 470 MB weight, M=16 decode rows):
-# (BK, BN) = (512, 1024) int8 blocks = 512 KiB/tile, double-buffered
-# well under VMEM while keeping the N-major grid's accumulator small.
-_BK = 512
-_BN = 1024
+# Default tiles, swept THROUGH the real-8B decode bench on chip (r4):
+# 512x1024 = 324 tok/s, 1024x1024 = 359, **2048x1024 = 376 (default)**,
+# 1024x2048 = 371, 4096x1024 = 361, 2048x2048 = 356 — deeper K blocks
+# amortize the accumulator flush while 2 MiB int8 tiles still
+# double-buffer comfortably in VMEM. The env knobs exist for on-chip
+# block sweeps without code edits (bench A/B hygiene).
+import os as _os
+
+_BK = int(_os.environ.get("INT8_MM_BK", 2048))
+_BN = int(_os.environ.get("INT8_MM_BN", 1024))
 _BM_MAX = 128  # prefill rows per M-tile; decode uses one partial tile
 
 
