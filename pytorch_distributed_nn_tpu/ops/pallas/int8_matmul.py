@@ -43,6 +43,14 @@ _BK = int(_os.environ.get("INT8_MM_BK", 2048))
 _BN = int(_os.environ.get("INT8_MM_BN", 1024))
 _BM_MAX = 128  # prefill rows per M-tile; decode uses one partial tile
 
+# STORAGE multiples are fixed constants, decoupled from the env-tunable
+# runtime tile: padded_kn is the persisted layout contract of quantized
+# checkpoints, and letting a sweep env var change on-disk shapes would
+# break restores across runs (advisor r4). A runtime tile that doesn't
+# divide the stored padding fails loudly in _int8_matmul_tpu.
+_STORE_BK = 2048
+_STORE_BN = 1024
+
 
 def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
@@ -56,8 +64,8 @@ def padded_kn(k: int, n: int) -> tuple[int, int]:
     blocks never exceed the padded dim, so tiny test-model layers work
     on the same kernel as the 8B's 14336-wide MLP.
     """
-    kp = _round_up(k, min(_BK, _round_up(k, 32)))
-    np_ = _round_up(n, min(_BN, _round_up(n, 128)))
+    kp = _round_up(k, min(_STORE_BK, _round_up(k, 32)))
+    np_ = _round_up(n, min(_STORE_BN, _round_up(n, 128)))
     return kp, np_
 
 
@@ -85,6 +93,12 @@ def _int8_matmul_tpu(x, q, s, *, out_dtype):
     if mp != m:
         x = jnp.pad(x, ((0, mp - m), (0, 0)))
     bk, bn = min(_BK, kp), min(_BN, np_)
+    if kp % bk or np_ % bn:
+        raise ValueError(
+            f"runtime tile ({bk}, {bn}) does not divide stored padding "
+            f"({kp}, {np_}) — INT8_MM_BK/BN must divide the storage "
+            "multiples or trailing blocks would silently drop"
+        )
     out = pl.pallas_call(
         _kernel,
         grid=(mp // bm, np_ // bn, kp // bk),
