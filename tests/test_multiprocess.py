@@ -433,3 +433,72 @@ def test_two_process_tensor_parallel_matches_single(tmp_path):
     single = Trainer(cfg, mesh=mesh).train()
     distributed = float((tmp_path / "loss").read_text())
     assert abs(distributed - single[-1].loss) < 1e-5
+
+
+EP_WORKER = """
+    import sys
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 1)
+
+    from pytorch_distributed_nn_tpu.config import get_config
+    from pytorch_distributed_nn_tpu.runtime import bootstrap
+    from pytorch_distributed_nn_tpu.train.trainer import Trainer
+
+    info = bootstrap.initialize()
+    cfg = get_config("moe_lm_ep", steps=3, log_every=1)
+    cfg.model.extra = dict(num_layers=2, d_model=32, num_heads=2,
+                           mlp_dim=64, vocab_size=97, num_experts=2,
+                           max_len=16)
+    cfg.model.remat = False
+    cfg.data.batch_size = 8
+    cfg.data.seq_len = 16
+    cfg.data.vocab_size = 97
+    cfg.mesh.expert = 2
+    cfg.mesh.data = 1
+    trainer = Trainer(cfg)
+    history = trainer.train()
+    if info.is_coordinator:
+        with open(f"{sys.argv[1]}/loss", "w") as f:
+            f.write(repr(history[-1].loss))
+    bootstrap.shutdown()
+"""
+
+
+def test_two_process_expert_parallel_matches_single(tmp_path):
+    """GShard expert parallelism across a REAL process boundary: the
+    two experts live on different processes and the token dispatch
+    all-to-all crosses it; loss equals the single-process 2-device EP
+    run — completing the cross-process matrix (DP, ZeRO-3, PP, TP, EP,
+    fused loop, checkpoint resume)."""
+    import jax
+
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(EP_WORKER))
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    result = launch(
+        [str(script), str(tmp_path)],
+        LaunchConfig(nprocs=2, env={"PYTHONPATH": repo}),
+    )
+    assert result.exit_code == 0
+
+    from pytorch_distributed_nn_tpu.config import get_config
+    from pytorch_distributed_nn_tpu.runtime.mesh import MeshSpec, make_mesh
+    from pytorch_distributed_nn_tpu.train.trainer import Trainer
+
+    cfg = get_config("moe_lm_ep", steps=3, log_every=1)
+    cfg.model.extra = dict(num_layers=2, d_model=32, num_heads=2,
+                           mlp_dim=64, vocab_size=97, num_experts=2,
+                           max_len=16)
+    cfg.model.remat = False
+    cfg.data.batch_size = 8
+    cfg.data.seq_len = 16
+    cfg.data.vocab_size = 97
+    cfg.mesh.expert = 2
+    cfg.mesh.data = 1
+    mesh = make_mesh(MeshSpec(expert=2, data=1).resolve(2),
+                     devices=jax.devices()[:2])
+    single = Trainer(cfg, mesh=mesh).train()
+    distributed = float((tmp_path / "loss").read_text())
+    assert abs(distributed - single[-1].loss) < 1e-5
