@@ -468,7 +468,21 @@ def bench_decode(args) -> int:
         params = synthetic_int8_params(model, prompt[:, :1])
     else:
         params = model.init(rng, prompt[:, :1], train=False)["params"]
-    n_params = sum(int(x.size) for x in jax.tree.leaves(params))
+    if args.real_8b_int8:
+        # count LOGICAL params from the float model's shapes: the int8
+        # tree stores kernel-padded elements (lm_head 128256→129024)
+        # plus scale leaves, which would overstate the published
+        # "(X.XXB params)" (advisor r4)
+        fcfg = get_config("llama3_8b_zero").model
+        fcfg.remat = False
+        float_shapes = jax.eval_shape(
+            lambda: get_model(fcfg).init(
+                jax.random.key(0), prompt[:, :1], train=False)
+        )["params"]
+        n_params = sum(
+            int(x.size) for x in jax.tree.leaves(float_shapes))
+    else:
+        n_params = sum(int(x.size) for x in jax.tree.leaves(params))
 
     import numpy as np
 

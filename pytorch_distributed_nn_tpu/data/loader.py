@@ -133,6 +133,10 @@ class DataLoader:
 
         q: queue.Queue = queue.Queue(maxsize=self.prefetch)
         stop = threading.Event()
+        # unique end-of-stream marker: an Exception subclass (the old
+        # StopIteration() sentinel) would swallow StopIteration-derived
+        # errors escaping dataset code as a clean end of stream
+        end_of_stream = object()
 
         def producer() -> None:
             try:
@@ -143,14 +147,14 @@ class DataLoader:
             except Exception as e:  # surface errors to the consumer
                 q.put(e)
                 return
-            q.put(StopIteration())
+            q.put(end_of_stream)
 
         thread = threading.Thread(target=producer, daemon=True)
         thread.start()
         try:
             while True:
                 item = q.get()
-                if isinstance(item, StopIteration):
+                if item is end_of_stream:
                     return
                 if isinstance(item, Exception):
                     raise item

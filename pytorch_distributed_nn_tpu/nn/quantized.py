@@ -172,20 +172,31 @@ def quantize_model_params(params: Any, qparams_shapes: Any) -> Any:
     for name, leaf in params.items():
         if name == "kernel" and hasattr(leaf, "shape"):
             tgt = qparams_shapes["kernel_q"]
-            kp = tgt.shape[0]
             # split leaf axes into (in..., feat...) so prod(in) pads
-            # to kp: walk prefixes until the padded size matches
+            # to kp; distinct splits can pad to the same storage
+            # (e.g. (16,·,64): both 16×128 and 32×64 pad to 32×128),
+            # and reshaping on the wrong contraction boundary would
+            # quantize silently wrong — so demand a UNIQUE match
+            matches = []
             for split in range(1, leaf.ndim):
                 k = math.prod(leaf.shape[:split])
                 n = math.prod(leaf.shape[split:])
-                if padded_kn(k, n)[0] == kp and \
-                        padded_kn(k, n)[1] == tgt.shape[1]:
-                    break
-            else:
+                if padded_kn(k, n) == tuple(tgt.shape) and \
+                        (k, n) not in [(m[1], m[2]) for m in matches]:
+                    matches.append((split, k, n))
+            if not matches:
                 raise ValueError(
                     f"no axis split of {leaf.shape} matches padded "
                     f"storage {tgt.shape}"
                 )
+            if len(matches) > 1:
+                raise ValueError(
+                    f"ambiguous axis split of {leaf.shape}: "
+                    f"{[(m[1], m[2]) for m in matches]} all pad to "
+                    f"{tuple(tgt.shape)}; quantize with unambiguous "
+                    f"dims or pre-reshape the kernel to 2-D"
+                )
+            _, k, n = matches[0]
             q, s = quantize_weight(leaf.reshape(k, n))
             out["kernel_q"] = q
             out["scale"] = s

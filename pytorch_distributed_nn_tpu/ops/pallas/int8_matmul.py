@@ -87,7 +87,13 @@ def _kernel(x_ref, q_ref, s_ref, o_ref, acc_ref):
 def _int8_matmul_tpu(x, q, s, *, out_dtype):
     m, kp = x.shape
     kp2, np_ = q.shape
-    assert kp == kp2, (x.shape, q.shape)
+    if kp != kp2:  # loud like the tile guard below — a bare assert
+        # vanishes under -O and the mismatch would surface as an
+        # opaque pallas_call error
+        raise ValueError(
+            f"x inner dim {kp} != stored weight rows {kp2} "
+            f"(x {x.shape}, q {q.shape})"
+        )
     bm = min(_round_up(m, 16), _BM_MAX)
     mp = _round_up(m, bm)
     if mp != m:
