@@ -22,7 +22,26 @@ from flax import linen as nn
 
 from pytorch_distributed_nn_tpu.config import ModelConfig
 from pytorch_distributed_nn_tpu.models import register
+from pytorch_distributed_nn_tpu.nn.batchnorm import TpuBatchNorm
 from pytorch_distributed_nn_tpu.nn.dtypes import get_policy
+
+
+def _make_norm(bn_impl: str, *, train: bool, dtype, param_dtype,
+               **kwargs):
+    """BatchNorm factory: 'flax' = flax.linen.BatchNorm (the original
+    lowering — stats fused into conv epilogues by XLA), anything else
+    = TpuBatchNorm with that stats_impl (nn/batchnorm.py: 'fused' |
+    'unfused' | 'unfused_fwd' | 'unfused_bwd' | 'pallas'). Semantics
+    identical (oracle: tests/test_batchnorm.py); the choice is a
+    measured lowering A/B — see docs/design.md "ResNet-50 BN kernel
+    A/B"."""
+    if bn_impl == "flax":
+        return partial(nn.BatchNorm, use_running_average=not train,
+                       momentum=0.9, epsilon=1e-5, dtype=dtype,
+                       param_dtype=param_dtype, **kwargs)
+    return partial(TpuBatchNorm, use_running_average=not train,
+                   momentum=0.9, epsilon=1e-5, dtype=dtype,
+                   param_dtype=param_dtype, stats_impl=bn_impl, **kwargs)
 
 
 def space_to_depth(x, block: int = 2):
@@ -69,14 +88,14 @@ class BottleneckBlock(nn.Module):
     strides: int = 1
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
+    bn_impl: str = "flax"
 
     @nn.compact
     def __call__(self, x, *, train: bool):
         conv = partial(nn.Conv, use_bias=False, dtype=self.dtype,
                        param_dtype=self.param_dtype)
-        norm = partial(nn.BatchNorm, use_running_average=not train,
-                       momentum=0.9, epsilon=1e-5, dtype=self.dtype,
-                       param_dtype=self.param_dtype)
+        norm = _make_norm(self.bn_impl, train=train, dtype=self.dtype,
+                          param_dtype=self.param_dtype)
         residual = x
         y = conv(self.filters, (1, 1), name="conv1")(x)
         y = nn.relu(norm(name="bn1")(y))
@@ -108,6 +127,7 @@ class ResNet(nn.Module):
     # input channels instead of 3, so XLA's im2col feeds the MXU dense
     # columns instead of 3-channel-starved ones.
     stem: str = "conv7"
+    bn_impl: str = "flax"
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
 
@@ -127,9 +147,8 @@ class ResNet(nn.Module):
                         name="conv_init")(x)
         else:
             raise ValueError(f"unknown stem {self.stem!r}")
-        x = nn.BatchNorm(use_running_average=not train, momentum=0.9,
-                         epsilon=1e-5, dtype=self.dtype,
-                         param_dtype=self.param_dtype, name="bn_init")(x)
+        x = _make_norm(self.bn_impl, train=train, dtype=self.dtype,
+                       param_dtype=self.param_dtype)(name="bn_init")(x)
         x = nn.relu(x)
         # torch MaxPool2d(3, 2, padding=1) geometry (see BottleneckBlock)
         x = nn.max_pool(x, (3, 3), strides=(2, 2),
@@ -140,6 +159,7 @@ class ResNet(nn.Module):
                 x = BottleneckBlock(
                     self.width * 2 ** stage, strides=strides,
                     dtype=self.dtype, param_dtype=self.param_dtype,
+                    bn_impl=self.bn_impl,
                     name=f"stage{stage}_block{block}",
                 )(x, train=train)
         x = jnp.mean(x, axis=(1, 2))  # global average pool
@@ -155,6 +175,7 @@ def build_resnet50(cfg: ModelConfig) -> ResNet:
         width=cfg.extra.get("width", 64),
         num_classes=cfg.extra.get("num_classes", 1000),
         stem=cfg.extra.get("stem", "conv7"),
+        bn_impl=cfg.extra.get("bn_impl", "flax"),
         dtype=policy.compute_dtype,
         param_dtype=policy.param_dtype,
     )

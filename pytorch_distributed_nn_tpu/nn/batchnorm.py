@@ -1,0 +1,194 @@
+"""TPU BatchNorm with controllable statistics lowering.
+
+Round-4's ResNet-50 trace blamed "BN statistic reductions" for 50% of
+the step, prescribing a fused stats kernel (VERDICT r4 Next #1). The
+round-5 HLO inventory of the compiled step (scripts/resnet_hlo.py)
+showed the premise was inverted: XLA:TPU *already* fuses the BN sums
+into the convolutions — every fwd conv lowers to a
+``convert_reduce_fusion`` emitting (Σx, Σx², conv_out) in one pass, and
+most bwd-data convs carry the (Σdy, Σdy·x̂) epilogue the same way. The
+23.4 ms trace bucket attributed to "BN statistics" is really *convs
+slowed down by their reduction epilogues*: the compiler's own cost model
+prices the fused conv+reduce at ~2.4x a clean conv (24.7M estimated
+cycles for the 54 fwd conv+stats fusions vs ~10M for the equivalent
+bare convs).
+
+So the tunable worth having is the opposite of the prescribed one:
+**keep the stats OUT of the conv** (optimization_barrier fences), pay
+explicit HBM passes for the reductions, and run the convs at full MXU
+speed. This module provides both lowerings behind one flax interface so
+the choice is a measured A/B, not a theory:
+
+- ``stats_impl='fused'``  — plain jnp formulas; XLA fuses stats into
+  the producing conv (today's default behavior, for baseline parity).
+- ``stats_impl='unfused'`` — closed-form custom_vjp with
+  ``optimization_barrier`` around x (fwd) and dy (bwd): stats and
+  normalize become standalone passes, convs lower clean.
+- ``stats_impl='pallas'`` — like 'unfused', but the two reduction
+  passes (fwd Σx/Σx², bwd Σdy/Σdy·x) run as Pallas kernels
+  (ops/pallas/bn_stats.py) tiled for streaming HBM bandwidth; jnp
+  fallback off-TPU keeps CPU tests exact.
+
+Semantics match ``flax.linen.BatchNorm`` (feature axis -1, f32 stats,
+biased batch variance in the running stats, momentum EMA); the oracle
+test is tests/test_batchnorm.py. Under compiler-sharded DP the
+fused/unfused impls keep flax's SyncBN behavior (the jnp reductions
+span the global batch — psum inserted by the partitioner). The pallas
+impl targets the single-chip/shard_map regime; use 'unfused' on a
+multi-chip compiler-sharded mesh (pallas_call has no SPMD partitioning
+rule).
+
+Reference parity note: torch DDP BatchNorm normalizes with *local*
+per-process stats (SyncBN is opt-in there); flax-style global-batch
+stats are strictly stronger. See models/resnet.py.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+
+from pytorch_distributed_nn_tpu.ops.pallas.bn_stats import (
+    sum_and_sumsq,
+    sum_and_dot,
+)
+
+_IMPLS = ("fused", "unfused", "pallas", "unfused_fwd", "unfused_bwd")
+
+
+def _reduce_axes(ndim: int) -> tuple[int, ...]:
+    return tuple(range(ndim - 1))
+
+
+def _stats_fwd(x, impl: str):
+    """(Σx, Σx²) over all leading axes, f32, one logical pass."""
+    if impl == "pallas":
+        return sum_and_sumsq(x)
+    xf = x.astype(jnp.float32)
+    axes = _reduce_axes(x.ndim)
+    return jnp.sum(xf, axes), jnp.sum(xf * xf, axes)
+
+
+def _sums_bwd(dy, x, impl: str):
+    """(Σdy, Σdy·x) over all leading axes, f32, one logical pass."""
+    if impl == "pallas":
+        return sum_and_dot(dy, x)
+    dyf = dy.astype(jnp.float32)
+    xf = x.astype(jnp.float32)
+    axes = _reduce_axes(x.ndim)
+    return jnp.sum(dyf, axes), jnp.sum(dyf * xf, axes)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4))
+def _bn_train(x, scale, bias, epsilon: float, impl: str):
+    (y, mean, var), _res = _bn_train_fwd(x, scale, bias, epsilon, impl)
+    return y, mean, var
+
+
+def _bn_train_fwd(x, scale, bias, epsilon: float, impl: str):
+    if impl in ("unfused", "pallas", "unfused_fwd"):
+        # fence: keep the stat reductions OUT of the producing conv's
+        # fusion, so the conv lowers clean and the stats become a
+        # standalone streaming pass
+        x = jax.lax.optimization_barrier(x)
+    m = x.size // x.shape[-1]
+    s1, s2 = _stats_fwd(x, impl)
+    mean = s1 / m
+    var = s2 / m - mean * mean
+    rsig = jax.lax.rsqrt(var + epsilon)
+    # elementwise pass in f32 (flax promotes bf16·f32 the same way);
+    # converts fuse, the result lands back in x.dtype
+    y = (x.astype(jnp.float32) * (rsig * scale)
+         + (bias - mean * rsig * scale)).astype(x.dtype)
+    return (y, mean, var), (x, scale, mean, rsig)
+
+
+def _bn_train_bwd(epsilon: float, impl: str, res, cts):
+    # cts[1]/cts[2] (batch mean/var cotangents) are intentionally
+    # dropped: the stats feed the running-average EMA, a non-
+    # differentiated state update (flax's batch_stats collection has the
+    # same property — no gradient ever flows through it)
+    x, scale, mean, rsig = res
+    dy = cts[0]
+    if impl in ("unfused", "pallas", "unfused_bwd"):
+        dy = jax.lax.optimization_barrier(dy)
+    m = x.size // x.shape[-1]
+    sdy, sdyx = _sums_bwd(dy, x, impl)
+    # Σdy·x̂ from the raw moments: x̂ = (x - μ)·rsig
+    sdyxh = (sdyx - mean * sdy) * rsig
+    dbias = sdy
+    dscale = sdyxh
+    # dx = γ·rsig·(dy − Σdy/m − x̂·Σdy·x̂/m); fold μ into the x
+    # coefficient so the elementwise pass reads only x and dy:
+    # x̂·Σdy·x̂/m = x·(rsig·Σdy·x̂/m) − μ·rsig·Σdy·x̂/m
+    g = scale * rsig
+    c2 = g * rsig * sdyxh / m
+    c1 = g * sdy / m - mean * c2
+    dx = (dy.astype(jnp.float32) * g - x.astype(jnp.float32) * c2
+          - c1).astype(x.dtype)
+    return dx, dscale, dbias
+
+
+_bn_train.defvjp(_bn_train_fwd, _bn_train_bwd)
+
+
+def batch_norm_train(x, scale, bias, *, epsilon: float = 1e-5,
+                     impl: str = "unfused"):
+    """Functional train-mode batch norm: returns (y, batch_mean,
+    batch_var). Gradients flow through y only (the stats feed running-
+    average updates, which are not differentiated — matching how
+    flax.linen.BatchNorm's batch_stats are consumed)."""
+    if impl not in _IMPLS:
+        raise ValueError(f"unknown stats_impl {impl!r}; have {_IMPLS}")
+    return _bn_train(x, scale, bias, epsilon, impl)
+
+
+class TpuBatchNorm(nn.Module):
+    """Drop-in for flax.linen.BatchNorm (feature axis -1) with the
+    statistics-lowering control described in the module docstring."""
+
+    use_running_average: bool | None = None
+    momentum: float = 0.9
+    epsilon: float = 1e-5
+    dtype: Any = None
+    param_dtype: Any = jnp.float32
+    use_bias: bool = True
+    use_scale: bool = True
+    bias_init: Callable = nn.initializers.zeros
+    scale_init: Callable = nn.initializers.ones
+    stats_impl: str = "unfused"
+
+    @nn.compact
+    def __call__(self, x, use_running_average: bool | None = None):
+        use_ra = nn.merge_param(
+            "use_running_average", self.use_running_average,
+            use_running_average)
+        c = x.shape[-1]
+        dtype = self.dtype or x.dtype
+        x = x.astype(dtype)
+        scale = (self.param("scale", self.scale_init, (c,),
+                            self.param_dtype).astype(jnp.float32)
+                 if self.use_scale else jnp.ones((c,), jnp.float32))
+        bias = (self.param("bias", self.bias_init, (c,),
+                           self.param_dtype).astype(jnp.float32)
+                if self.use_bias else jnp.zeros((c,), jnp.float32))
+        ra_mean = self.variable("batch_stats", "mean",
+                                lambda: jnp.zeros((c,), jnp.float32))
+        ra_var = self.variable("batch_stats", "var",
+                               lambda: jnp.ones((c,), jnp.float32))
+        if use_ra:
+            rsig = jax.lax.rsqrt(ra_var.value + self.epsilon)
+            return (x.astype(jnp.float32) * (rsig * scale)
+                    + (bias - ra_mean.value * rsig * scale)).astype(dtype)
+        y, mean, var = batch_norm_train(
+            x, scale, bias, epsilon=self.epsilon, impl=self.stats_impl)
+        if not self.is_initializing():
+            ra_mean.value = (self.momentum * ra_mean.value
+                             + (1.0 - self.momentum) * mean)
+            ra_var.value = (self.momentum * ra_var.value
+                            + (1.0 - self.momentum) * var)
+        return y

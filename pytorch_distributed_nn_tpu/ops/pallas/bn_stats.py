@@ -1,0 +1,129 @@
+"""BatchNorm statistics reduction kernels.
+
+Two single-pass per-channel reductions over an (..., C) array, the only
+two shapes batch norm ever needs (nn/batchnorm.py):
+
+- ``sum_and_sumsq(x)``      → (Σx, Σx²)        — forward moments
+- ``sum_and_dot(dy, x)``    → (Σdy, Σdy·x)     — backward sums
+
+Both reduce over every leading axis, accumulate in f32, and return
+``(C,)`` f32 pairs. On TPU they run as Pallas kernels tiled for
+streaming HBM bandwidth: the array is viewed as (M, C) — a free
+reshape for a channels-minor array — rows are folded into the 128-lane
+dimension when C < 128 (so a C=64 plane still fills every lane), and a
+sequential grid accumulates per-block partials into a single VMEM
+accumulator (TPU grids execute in order, so read-modify-write on the
+output block is well-defined). Off TPU the jnp fallback computes the
+same sums so CPU tests and the virtual-mesh suite stay exact.
+
+Why these exist: XLA fuses these reductions into the producing
+convolution's epilogue, which slows the conv itself far more than a
+separate streaming pass costs (scripts/resnet_hlo.py, docs/design.md
+"ResNet-50 MFU"). nn/batchnorm.py fences the activations with
+``optimization_barrier`` and calls these for the standalone pass.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_LANES = 128
+# VMEM budget per input block: 512 KiB keeps ≤2 arrays double-buffered
+# well under the ~16 MiB VMEM while each DMA stays large enough to
+# stream at full HBM bandwidth
+_BLOCK_BYTES = 512 * 1024
+
+
+def _view_2d(x):
+    """(…, C) → (M, C2) with C2 = max(C, 128) by folding rows into
+    lanes when C < 128; returns (viewed, fold) where fold = C2 // C."""
+    c = x.shape[-1]
+    m = x.size // c
+    if c >= _LANES:
+        return x.reshape(m, c), 1
+    fold = _LANES // c
+    if m % fold:
+        # pathological tiny M; caller falls back to jnp
+        return None, 0
+    return x.reshape(m // fold, fold * c), fold
+
+
+def _masked(ref, i, rows, m):
+    """Block rows past the array's true end read garbage (Pallas pads
+    the trailing block); zero them so the sums stay exact."""
+    x = ref[...].astype(jnp.float32)
+    if m % rows == 0:
+        return x
+    ridx = jax.lax.broadcasted_iota(jnp.int32, x.shape, 0) + i * rows
+    return jnp.where(ridx < m, x, 0.0)
+
+
+def _run(arrays, dot: bool):
+    """arrays: one (sumsq) or two (dot) (M, C2) views, identical shape."""
+    m, c2 = arrays[0].shape
+    rows = max(8, min(_BLOCK_BYTES // (c2 * arrays[0].dtype.itemsize),
+                      m))
+    nblk = pl.cdiv(m, rows)
+
+    def kernel(*refs):
+        i = pl.program_id(0)
+        s1_ref, s2_ref = refs[-2], refs[-1]
+
+        @pl.when(i == 0)
+        def _init():
+            s1_ref[...] = jnp.zeros_like(s1_ref)
+            s2_ref[...] = jnp.zeros_like(s2_ref)
+
+        a = _masked(refs[0], i, rows, m)
+        # mask b too: tail garbage could be inf/nan and 0·inf = nan
+        b = _masked(refs[1], i, rows, m) if dot else a
+        s1_ref[...] += jnp.sum(a, 0, keepdims=True)
+        s2_ref[...] += jnp.sum(a * b, 0, keepdims=True)
+
+    block = pl.BlockSpec((rows, c2), lambda i: (i, 0))
+    out_spec = pl.BlockSpec((1, c2), lambda i: (0, 0))
+    s1, s2 = pl.pallas_call(
+        kernel,
+        grid=(nblk,),
+        in_specs=[block] * len(arrays),
+        out_specs=[out_spec, out_spec],
+        out_shape=[jax.ShapeDtypeStruct((1, c2), jnp.float32)] * 2,
+    )(*arrays)
+    return s1[0], s2[0]
+
+
+def _unfold(s, c, fold):
+    return s.reshape(fold, c).sum(0) if fold > 1 else s
+
+
+def sum_and_sumsq(x):
+    """(Σx, Σx²) over all leading axes of an (…, C) array; f32 (C,)."""
+    c = x.shape[-1]
+    if jax.default_backend() == "tpu":
+        v, fold = _view_2d(x)
+        if v is not None:
+            s1, s2 = _run([v], dot=False)
+            return _unfold(s1, c, fold), _unfold(s2, c, fold)
+    xf = x.astype(jnp.float32)
+    axes = tuple(range(x.ndim - 1))
+    return jnp.sum(xf, axes), jnp.sum(xf * xf, axes)
+
+
+def sum_and_dot(a, b):
+    """(Σa, Σa·b) over all leading axes of (…, C) arrays; f32 (C,)."""
+    if a.shape != b.shape:
+        raise ValueError(f"shape mismatch {a.shape} vs {b.shape}")
+    c = a.shape[-1]
+    if jax.default_backend() == "tpu":
+        va, fold = _view_2d(a)
+        vb, _ = _view_2d(b)
+        if va is not None:
+            s1, s2 = _run([va, vb], dot=True)
+            return _unfold(s1, c, fold), _unfold(s2, c, fold)
+    af = a.astype(jnp.float32)
+    bf = b.astype(jnp.float32)
+    axes = tuple(range(a.ndim - 1))
+    return jnp.sum(af, axes), jnp.sum(af * bf, axes)

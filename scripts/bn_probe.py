@@ -1,0 +1,121 @@
+"""BN microbenchmark: what does flax BatchNorm fwd+bwd actually cost on
+the chip, and how many HBM passes does XLA's lowering make?
+
+Round-5 groundwork for the fused BN-statistics Pallas kernel (VERDICT r4
+Next #1): before writing a kernel, establish (a) achieved GB/s of the
+XLA lowering per representative ResNet-50 shape, (b) the pass count from
+the optimized HLO, so the kernel targets the real gap, not a guessed one.
+
+Usage:  python scripts/bn_probe.py [--hlo] [--steps 20]
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+
+# the distinct (H, W, C) BN input planes in ResNet-50 at 224px, with
+# multiplicity (how many BN layers see that shape), b=128
+SHAPES = [
+    # (H, W, C, count)
+    (112, 112, 64, 1),   # stem
+    (56, 56, 64, 6),     # stage0 conv1/conv2 x3
+    (56, 56, 256, 4),    # stage0 conv3 x3 + proj
+    (28, 28, 128, 8),    # stage1 conv1/conv2 x4
+    (28, 28, 512, 5),    # stage1 conv3 x4 + proj
+    (14, 14, 256, 12),   # stage2 conv1/conv2 x6
+    (14, 14, 1024, 7),   # stage2 conv3 x6 + proj
+    (7, 7, 512, 6),      # stage3 conv1/conv2 x3
+    (7, 7, 2048, 4),     # stage3 conv3 x3 + proj
+]
+
+
+def bn_fwd_bwd(batch: int, h: int, w: int, c: int, dtype=jnp.bfloat16):
+    """Train-mode BN fwd + bwd with a REAL cotangent array (dy is an
+    input, not a constant-foldable ones), mirroring its position inside
+    a network's backward pass."""
+    bn = nn.BatchNorm(use_running_average=False, momentum=0.9,
+                      epsilon=1e-5, dtype=dtype, param_dtype=jnp.float32)
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(batch, h, w, c), dtype)
+    dy = jnp.asarray(rng.randn(batch, h, w, c), dtype)
+    variables = bn.init(jax.random.key(0), x)
+    params = variables["params"]
+
+    def apply_fn(params, x):
+        y, upd = bn.apply({"params": params}, x, mutable=["batch_stats"])
+        return y, upd["batch_stats"]
+
+    @jax.jit
+    def step(params, x, dy):
+        (y, stats), vjp = jax.vjp(lambda p, x: apply_fn(p, x), params, x)
+        dparams, dx = vjp((dy, jax.tree.map(jnp.zeros_like, stats)))
+        # scalar probes so nothing is dead-code-eliminated, everything
+        # fenced by one device_get
+        probe = (y.astype(jnp.float32).ravel()[0]
+                 + dx.astype(jnp.float32).ravel()[0]
+                 + dparams["scale"][0] + stats["mean"][0])
+        return probe, y, dx, dparams, stats
+
+    return step, params, x, dy
+
+
+def time_step(step, params, x, dy, steps=20):
+    out = step(params, x, dy)
+    float(jax.device_get(out[0]))  # compile + fence
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = step(params, x, dy)
+    float(jax.device_get(out[0]))
+    return (time.perf_counter() - t0) / steps
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--hlo", action="store_true")
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--shapes", type=str, default="")
+    args = ap.parse_args()
+
+    print(f"backend={jax.default_backend()} devices={jax.devices()}")
+    total_ms = 0.0
+    rows = []
+    shapes = SHAPES
+    if args.shapes:
+        shapes = []
+        for spec in args.shapes.split(";"):
+            h, w, c, cnt = (int(v) for v in spec.split(","))
+            shapes.append((h, w, c, cnt))
+    for h, w, c, count in shapes:
+        step, params, x, dy = bn_fwd_bwd(args.batch, h, w, c)
+        dt = time_step(step, params, x, dy, args.steps)
+        nbytes = np.prod(x.shape) * 2  # bf16
+        # minimal-traffic model: fwd reads x (stats) + reads x, writes y
+        # (normalize); bwd reads x+dy (sums) + reads x+dy, writes dx
+        # (apply) = 5 reads + 2 writes of one activation plane.
+        layer_ms = dt * 1e3
+        total_ms += layer_ms * count
+        gbs = nbytes * 7 / dt / 1e9
+        rows.append((h, w, c, count, layer_ms, gbs))
+        print(f"({args.batch},{h:4d},{w:4d},{c:4d}) x{count:2d}: "
+              f"{layer_ms:7.3f} ms  ({gbs:6.1f} GB/s at 7-pass model)")
+        if args.hlo:
+            txt = step.lower(params, x, dy).compile().as_text()
+            fusions = [ln.strip() for ln in txt.splitlines()
+                       if ("fusion(" in ln or "fusion." in ln)
+                       and "ENTRY" not in ln]
+            print(f"  --- optimized HLO fusion roots ({len(fusions)}):")
+            for ln in fusions:
+                print("   ", ln[:160])
+    print(f"\nweighted total (all 53 BN layers): {total_ms:.1f} ms/step")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,85 @@
+"""Dump + analyze the TPU-compiled HLO of the resnet50_dp train step:
+which fusions touch BN-statistics reductions, how many HBM passes over
+the activations do they make, and what does that predict for the fused
+BN kernel (VERDICT r4 Next #1 groundwork).
+
+Usage: python scripts/resnet_hlo.py [--dump /tmp/resnet_step.hlo]
+"""
+
+from __future__ import annotations
+
+import argparse
+import re
+import sys
+from collections import defaultdict
+
+sys.path.insert(0, ".")
+
+import jax
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--dump", default="")
+    ap.add_argument("--batch", type=int, default=128)
+    ap.add_argument("--stem", default="s2d")
+    ap.add_argument("--bn-impl", default="flax")
+    args = ap.parse_args()
+
+    from pytorch_distributed_nn_tpu.config import get_config
+    from pytorch_distributed_nn_tpu.train.trainer import Trainer
+
+    cfg = get_config("resnet50_dp")
+    cfg.data.batch_size = args.batch
+    cfg.model.extra = dict(stem=args.stem, bn_impl=args.bn_impl)
+    cfg.log_every = 0
+    trainer = Trainer(cfg)
+    batch = trainer.loader.batch_at(0)
+    lowered = jax.jit(trainer.step_fn.__wrapped__
+                      if hasattr(trainer.step_fn, "__wrapped__")
+                      else trainer.step_fn).lower(trainer.state, *batch)
+    compiled = lowered.compile()
+    txt = compiled.as_text()
+    if args.dump:
+        with open(args.dump, "w") as f:
+            f.write(txt)
+        print(f"dumped {len(txt)/1e6:.1f} MB to {args.dump}")
+
+    # every fusion instruction line in the entry computation
+    fusion_re = re.compile(
+        r"^\s*(?:ROOT\s+)?%?([\w.-]+)\s*=\s*(\([^)]*\)|\S+)\s+fusion\(",
+        re.M)
+    # shapes like bf16[128,56,56,256]{...}
+    shape_re = re.compile(r"(bf16|f32)\[([0-9,]+)\]")
+
+    def nbytes(dt, dims):
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        return n * (2 if dt == "bf16" else 4)
+
+    per_kind = defaultdict(lambda: [0, 0])  # kind -> [count, approx bytes]
+    bn_lines = []
+    for m in fusion_re.finditer(txt):
+        name, outshape = m.group(1), m.group(2)
+        line = txt[m.start():txt.index("\n", m.start())]
+        kind = re.sub(r"[.\d]+$", "", name)
+        total = sum(nbytes(dt, dims)
+                    for dt, dims in shape_re.findall(line))
+        per_kind[kind][0] += 1
+        per_kind[kind][1] += total
+        if "reduce" in name:
+            bn_lines.append(line.strip()[:240])
+
+    print("\n=== fusion kinds (count, Σ shape bytes on the line) ===")
+    for kind, (cnt, b) in sorted(per_kind.items(),
+                                 key=lambda kv: -kv[1][1]):
+        print(f"  {kind:40s} x{cnt:4d}  {b/1e9:8.2f} GB")
+    print(f"\n=== reduce fusions ({len(bn_lines)}) ===")
+    for ln in bn_lines[:80]:
+        print("  ", ln)
+
+
+if __name__ == "__main__":
+    main()

@@ -296,13 +296,49 @@ def check_long_context() -> bool:
     return ok
 
 
+def check_bn_stats() -> bool:
+    """BatchNorm statistics kernels (ops/pallas/bn_stats.py) vs the jnp
+    oracle, across the real ResNet channel geometries: C≥128 direct,
+    C=64 lane-folded, and a (M % block)≠0 tail-masked case. The
+    measured A/B keeps these OUT of the resnet50_dp default (XLA's
+    conv+stats epilogue fusion wins — docs/design.md "ResNet-50 MFU"),
+    but the kernels stay gated so the 'pallas' stats_impl stays
+    correct."""
+    from pytorch_distributed_nn_tpu.ops.pallas.bn_stats import (
+        sum_and_dot,
+        sum_and_sumsq,
+    )
+
+    ok = True
+    rng = np.random.RandomState(11)
+    # (N, H, W, C): C=64 exercises the fold, 7x7x512 the masked tail
+    for shape in [(8, 14, 14, 256), (8, 28, 28, 64), (16, 7, 7, 512)]:
+        x = jnp.asarray(rng.randn(*shape).astype(np.float32) * 2,
+                        jnp.bfloat16)
+        dy = jnp.asarray(rng.randn(*shape).astype(np.float32),
+                         jnp.bfloat16)
+        axes = tuple(range(x.ndim - 1))
+        xf = np.asarray(x, np.float32)
+        dyf = np.asarray(dy, np.float32)
+        s1, s2 = jax.jit(sum_and_sumsq)(x)
+        d1, d2 = jax.jit(sum_and_dot)(dy, x)
+        good = True
+        for got, want in [(s1, xf.sum(axes)), (s2, (xf * xf).sum(axes)),
+                          (d1, dyf.sum(axes)), (d2, (dyf * xf).sum(axes))]:
+            good &= bool(np.allclose(np.asarray(got), want, rtol=2e-3,
+                                     atol=2e-2 * np.sqrt(xf.size)))
+        ok &= good
+        print(f"bn_stats {shape}: {'OK' if good else 'FAIL'}")
+    return ok
+
+
 def main() -> int:
     print(f"backend: {jax.default_backend()} devices: {jax.devices()}")
     if jax.default_backend() != "tpu":
         print("WARNING: not on TPU — validating fallbacks only")
     ok = (check_flash() & check_flash_grad() & check_quantize()
           & check_int8_matmul() & check_ring_block() & check_ring_bwd()
-          & check_long_context())
+          & check_long_context() & check_bn_stats())
     print("ALL OK" if ok else "FAILURES")
     return 0 if ok else 1
 
