@@ -425,9 +425,21 @@ def bench_decode(args) -> int:
             "int8 param layout has no tensor-parallel sharding rules "
             "(leaves are kernel_q/scale, not kernel)"
         )
+    if args.kv_int8 and not args.real_8b_int8:
+        # loud like the --tp conflict above: silently running the
+        # bf16 cache while the record says otherwise would be a lie
+        raise SystemExit(
+            "--kv-int8 requires --real-8b-int8 (the int8 KV cache is "
+            "measured on the flagship decode path)"
+        )
     if args.real_8b_int8:
         # TRUE 8B dims (the preset's defaults), int8 weight-only
         cfg.model.extra = dict(quantized=True)
+        if args.kv_int8:
+            # int8 KV cache (nn/attention.py): per-(token, head)
+            # scales, ~half the cache HBM — what moves the servable
+            # batch past the bf16 cache's b=192 OOM edge
+            cfg.model.extra["cache_dtype"] = "int8"
     else:
         # scaled stand-in: the full float 8B would OOM a single chip's
         # HBM (16 GB bf16 weights alone) — int8 mode above is how the
@@ -501,14 +513,17 @@ def bench_decode(args) -> int:
 
         params = shard_params_for_inference(params, mesh)
     _ = np.asarray(generate(model, params, prompt, N, temperature=0.0,
-                            mesh=mesh))
+                            mesh=mesh, prefill_chunk=args.prefill_chunk))
     t0 = time.perf_counter()
-    out = generate(model, params, prompt, N, temperature=0.0, mesh=mesh)
+    out = generate(model, params, prompt, N, temperature=0.0, mesh=mesh,
+                   prefill_chunk=args.prefill_chunk)
     _ = np.asarray(out)
     dt = time.perf_counter() - t0
     value = B * N / dt
     name = ("TRUE Llama-3-8B int8 weight-only"
             if args.real_8b_int8 else "llama scaled")
+    if args.real_8b_int8 and args.kv_int8:
+        name += " + int8 KV cache"
     backend = jax.default_backend()
     tp_note = (f", tp={args.tp} ({backend} backend"
                + (" — CPU-RELATIVE, not a chip number" if backend != "tpu"
@@ -517,6 +532,9 @@ def bench_decode(args) -> int:
         metric=_METRIC_NAMES["decode"],
         value=round(value, 1), unit="tokens/sec", vs_baseline=None,
         n_params=n_params, backend=backend,
+        ms_per_token=round(1e3 * dt / N, 3),
+        kv_cache_dtype=("int8" if (args.real_8b_int8 and args.kv_int8)
+                        else str(jnp.dtype(jnp.bfloat16))),
         detail=f"{name} ({n_params/1e9:.2f}B params), KV-cache greedy, "
                f"batch {B}, prompt {P}, new {N}{tp_note}",
     )))
@@ -568,6 +586,16 @@ def main(argv=None) -> int:
                     help="decode metric: run the TRUE 8.03B Llama-3 "
                          "with weight-only int8 params (fits one v5e "
                          "chip) instead of the scaled stand-in")
+    ap.add_argument("--kv-int8", action="store_true",
+                    help="decode metric with --real-8b-int8: store the "
+                         "KV cache int8 (per-token-head scales) — "
+                         "halves cache HBM, extends the servable batch "
+                         "past the bf16 cache's OOM edge")
+    ap.add_argument("--prefill-chunk", type=int, default=0,
+                    help="decode metric: consume the prompt in chunks "
+                         "of this many tokens (bounds the prefill "
+                         "attention transients — what lets the largest "
+                         "batches fit)")
     ap.add_argument("--multistep", type=int, default=1,
                     help="fuse this many optimizer steps into one device "
                          "dispatch (lax.scan over a stacked batch pool) — "

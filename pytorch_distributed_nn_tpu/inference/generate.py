@@ -71,8 +71,10 @@ def _shard_cache(cache, mesh):
     tensor = mesh.shape.get(AXIS_TENSOR, 1)
 
     def spec(x):
-        if x.ndim == 4 and tensor > 1 and x.shape[2] % tensor == 0:
-            return P(None, None, AXIS_TENSOR)  # (B, T, Hkv, D)
+        # (B, T, Hkv, D) payloads and (B, T, Hkv) int8-cache scales
+        # both carry heads at axis 2
+        if x.ndim in (3, 4) and tensor > 1 and x.shape[2] % tensor == 0:
+            return P(None, None, AXIS_TENSOR)
         return P()
 
     shardings = jax.tree.map(lambda x: NamedSharding(mesh, spec(x)),

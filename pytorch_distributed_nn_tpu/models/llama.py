@@ -48,6 +48,7 @@ class LlamaBlock(nn.Module):
     dtype: jnp.dtype = jnp.float32
     param_dtype: jnp.dtype = jnp.float32
     quantized: bool = False
+    cache_dtype: str = "compute"
 
     @nn.compact
     def __call__(self, x, train: bool = False, decode: bool = False):
@@ -65,6 +66,7 @@ class LlamaBlock(nn.Module):
             rope_theta=self.rope_theta, impl=self.attn_impl,
             use_bias=False, dtype=self.dtype,
             param_dtype=self.param_dtype, quantized=self.quantized,
+            cache_dtype=self.cache_dtype,
             name="attn",
         )(y, decode=decode)
         x = x + y
@@ -104,6 +106,10 @@ class Llama(nn.Module):
     # ~8 GB for the true 8B params — the mode that fits the flagship on
     # one 16 GB v5e chip (inference path; training stays float)
     quantized: bool = False
+    # decode KV-cache storage ("compute" | "int8"): int8 halves cache
+    # HBM via per-(token, head) scales (nn/attention.py), roughly
+    # doubling the servable decode batch on one chip
+    cache_dtype: str = "compute"
 
     @nn.compact
     def __call__(self, tokens, *, train: bool = False,
@@ -156,6 +162,7 @@ class Llama(nn.Module):
                 norm_eps=self.norm_eps,
                 attn_impl=self.attn_impl, dtype=self.dtype,
                 param_dtype=self.param_dtype, quantized=self.quantized,
+                cache_dtype=self.cache_dtype,
                 name=f"layer{i}",
             )(x, train, decode)
         if last_only:
@@ -188,6 +195,7 @@ def build_llama3_8b(cfg: ModelConfig) -> Llama:
         remat_offload=cfg.remat_offload,
         attn_impl=e.get("attn_impl", "auto"),
         quantized=e.get("quantized", False),
+        cache_dtype=e.get("cache_dtype", "compute"),
         dtype=policy.compute_dtype,
         param_dtype=policy.param_dtype,
     )

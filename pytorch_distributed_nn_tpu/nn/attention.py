@@ -129,6 +129,55 @@ def dot_product_attention(
                       preferred_element_type=jnp.float32).astype(q.dtype)
 
 
+def _quantize_kv(x):
+    """(B, T, H, D) → int8 values + (B, T, H) f32 scales: symmetric
+    per-(token, head) absmax over the head dim. Zero rows (e.g. a
+    dead head) get scale 1 so the stored zeros round-trip exactly."""
+    xf = x.astype(jnp.float32)
+    absmax = jnp.max(jnp.abs(xf), axis=-1)
+    scale = jnp.where(absmax > 0, absmax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(xf / scale[..., None]), -127,
+                 127).astype(jnp.int8)
+    return q, scale
+
+
+def _cache_attention(q, k, v, pos_mask, dtype, kscale=None, vscale=None):
+    """Decode attention over the KV cache with GQA kept GROUPED: q
+    reshapes to (B, T, Hkv, G, D) instead of repeating the cached K/V.
+    (The einsum-path `jnp.repeat` materializes H/Hkv copies of the
+    whole cache every step — at the 8B's b=128/S=256 that is ~17 GB of
+    extra HBM traffic per decoded token; removing it is worth 3x+ on
+    large-batch decode, measured r5, BASELINE.md decode table.)
+
+    With ``kscale``/``vscale`` (both (B, S, Hkv) f32) the cache is the
+    int8 layout and is never dequantized into a materialized copy:
+    per-(token, head) scales commute with the two contractions — K's
+    scale multiplies the logits AFTER QK^T (each logit is linear in
+    one cached K row), V's scale multiplies the softmax probabilities
+    BEFORE PV (the output is linear in each cached V row). The int8
+    payloads go straight into the matmuls as raw integers (exact in
+    bf16: |v| ≤ 127) and the f32 scales touch only the (…, S) score
+    plane.
+
+    q: (B, T, H, D); k/v: (B, S, Hkv, D) float — or int8 when the
+    scales are given; pos_mask: (B|1, T, S). Returns (B, T, H, D)."""
+    B, T, H, D = q.shape
+    q5 = q.reshape(B, T, k.shape[2], H // k.shape[2], D)
+    logits = jnp.einsum("btkgd,bskd->bkgts", q5, k.astype(dtype),
+                        preferred_element_type=jnp.float32)
+    logits *= D ** -0.5
+    if kscale is not None:
+        logits *= kscale.transpose(0, 2, 1)[:, :, None, None, :]
+    logits = jnp.where(pos_mask[:, None, None], logits, -1e30)
+    probs = jax.nn.softmax(logits, axis=-1)
+    if vscale is not None:
+        probs = probs * vscale.transpose(0, 2, 1)[:, :, None, None, :]
+    out = jnp.einsum("bkgts,bskd->btkgd", probs.astype(dtype),
+                     v.astype(dtype),
+                     preferred_element_type=jnp.float32)
+    return out.reshape(B, T, H, D).astype(dtype)
+
+
 class MultiHeadAttention(nn.Module):
     num_heads: int
     head_dim: int
@@ -145,6 +194,14 @@ class MultiHeadAttention(nn.Module):
     # the Pallas matmul — the capacity mode that fits Llama-3-8B's
     # weights in one chip's HBM. Bias-free only (the Llama family).
     quantized: bool = False
+    # decode KV-cache storage: "compute" (the activation dtype, bf16 in
+    # the presets) or "int8" — per-(token, head) symmetric scales,
+    # halving cache HBM so the servable batch roughly doubles (the 8B
+    # b=192 OOM edge). The int8 path never materializes a dequantized
+    # cache: K's scale folds into the logits AFTER the QK^T contraction
+    # and V's scale folds into the probabilities BEFORE the PV one —
+    # algebraically exact, oracle-tested in tests/test_kv_cache.py.
+    cache_dtype: str = "compute"
 
     @nn.compact
     def __call__(self, x, mask: Optional[jax.Array] = None,
@@ -240,15 +297,31 @@ class MultiHeadAttention(nn.Module):
             )(q, k, v)
         elif decode:
             B, T = x.shape[0], x.shape[1]
+            if self.cache_dtype not in ("compute", "int8"):
+                raise ValueError(
+                    f"unknown cache_dtype {self.cache_dtype!r}; have "
+                    "('compute', 'int8')"
+                )
+            int8_cache = self.cache_dtype == "int8"
             init_k = nn.initializers.zeros
+            kv_shape = (B, T, kv_heads, self.head_dim)
             cached_k = self.variable(
-                "cache", "cached_key", init_k, None,
-                (B, T, kv_heads, self.head_dim), k.dtype,
+                "cache", "cached_key", init_k, None, kv_shape,
+                jnp.int8 if int8_cache else k.dtype,
             )
             cached_v = self.variable(
-                "cache", "cached_value", init_k, None,
-                (B, T, kv_heads, self.head_dim), v.dtype,
+                "cache", "cached_value", init_k, None, kv_shape,
+                jnp.int8 if int8_cache else v.dtype,
             )
+            if int8_cache:
+                k_scale = self.variable(
+                    "cache", "cached_key_scale", init_k, None,
+                    (B, T, kv_heads), jnp.float32,
+                )
+                v_scale = self.variable(
+                    "cache", "cached_value_scale", init_k, None,
+                    (B, T, kv_heads), jnp.float32,
+                )
             cache_index = self.variable(
                 "cache", "cache_index",
                 lambda: jnp.zeros((), jnp.int32),
@@ -266,21 +339,38 @@ class MultiHeadAttention(nn.Module):
                     q, k = rotary_embedding(q, k, theta=self.rope_theta,
                                             positions=positions)
                     q, k = q.astype(self.dtype), k.astype(self.dtype)
-                cached_k.value = jax.lax.dynamic_update_slice(
-                    cached_k.value, k, (0, idx, 0, 0)
-                )
-                cached_v.value = jax.lax.dynamic_update_slice(
-                    cached_v.value, v, (0, idx, 0, 0)
-                )
                 cache_index.value = idx + T
                 # attend to the filled prefix: k_pos <= this row's q_pos
                 k_pos = jnp.arange(S)[None, None, :]
                 q_pos = positions[:, :, None]
                 pos_mask = k_pos <= q_pos  # (1, T, S)
-                out = dot_product_attention(
-                    q, cached_k.value, cached_v.value, causal=False,
-                    impl="xla", mask=pos_mask,
-                )
+                if int8_cache:
+                    kq_new, ks_new = _quantize_kv(k)
+                    vq_new, vs_new = _quantize_kv(v)
+                    cached_k.value = jax.lax.dynamic_update_slice(
+                        cached_k.value, kq_new, (0, idx, 0, 0))
+                    cached_v.value = jax.lax.dynamic_update_slice(
+                        cached_v.value, vq_new, (0, idx, 0, 0))
+                    k_scale.value = jax.lax.dynamic_update_slice(
+                        k_scale.value, ks_new, (0, idx, 0))
+                    v_scale.value = jax.lax.dynamic_update_slice(
+                        v_scale.value, vs_new, (0, idx, 0))
+                    out = _cache_attention(
+                        q, cached_k.value, cached_v.value, pos_mask,
+                        self.dtype, kscale=k_scale.value,
+                        vscale=v_scale.value,
+                    )
+                else:
+                    cached_k.value = jax.lax.dynamic_update_slice(
+                        cached_k.value, k, (0, idx, 0, 0)
+                    )
+                    cached_v.value = jax.lax.dynamic_update_slice(
+                        cached_v.value, v, (0, idx, 0, 0)
+                    )
+                    out = _cache_attention(
+                        q, cached_k.value, cached_v.value, pos_mask,
+                        self.dtype,
+                    )
         else:
             if self.rotary:
                 q, k = rotary_embedding(q, k, theta=self.rope_theta)

@@ -288,6 +288,32 @@ def test_moe_mixed_stack_interleaved():
     np.testing.assert_allclose(il, ob, rtol=2e-5, atol=1e-5)
 
 
+def test_moe_mixed_stack_x_expert_parallel_1f1b():
+    """The composition PARITY.md called untested (VERDICT r4 Missing
+    #4): mixed dense/MoE stacks (moe_every=2) WITH the expert axis
+    sharded inside the stages, under 1F1B. Checks both that the
+    MoE-stack weights actually shard over `expert` and that the loss
+    curve matches the single-device oracle."""
+    extra = dict(TINY_MOE, moe_every=2)
+    single = _train("single", MeshSpec(data=1, pipe=1), model="moe_lm",
+                    extra=extra, devices=jax.devices()[:1])
+    trainer = _train("pipeline", MeshSpec(pipe=2, expert=2, data=2),
+                     model="moe_lm", extra=extra, schedule="1f1b",
+                     return_trainer=True, do_train=False)
+    specs = {
+        "/".join(str(getattr(k, "key", k)) for k in kp):
+            leaf.sharding.spec
+        for kp, leaf in jax.tree_util.tree_flatten_with_path(
+            trainer.state.params["stages"])[0]
+    }
+    ep_sharded = [p for p, s in specs.items() if "expert" in str(s)]
+    assert any("moe/wi" in p for p in ep_sharded), specs
+    assert any("moe/wo" in p for p in ep_sharded), specs
+    trainer.train()
+    np.testing.assert_allclose(np.array(trainer.losses()), single,
+                               rtol=2e-5, atol=1e-5)
+
+
 def test_moe_mixed_stack_misaligned_rejected():
     # 4 layers over 2 stages x 2 chunks = 1 layer per chunk: a chunk
     # would split the dense+MoE group
