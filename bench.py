@@ -17,6 +17,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import subprocess
 import sys
@@ -128,6 +129,7 @@ _METRIC_NAMES = {
     "bus_bw": "grad-allreduce bus-bw ({preset})",
     "decode": "decode tokens/sec (llama3_8b_zero)",
     "loader": "input-pipeline samples/sec ({preset})",
+    "quality": "held-out NLL (llama3_8b_zero)",
 }
 
 # Nominal GPU-class MFU for the BASELINE configs whose absolute rate
@@ -398,6 +400,114 @@ def bench_bus_bw(args) -> int:
     return 0
 
 
+def bench_quality(args) -> int:
+    """Whole-model quality for the int8 path (VERDICT r4 Missing #3).
+
+    Default: train the scaled Llama stand-in on the learnable
+    lm_synthetic stream (affine-recurrence tokens, 10% noise — a real
+    signal, so NLL drops well below ln V), quantize the trained
+    weights (nn/quantized.quantize_model_params), and report held-out
+    NLL for bf16 vs int8 on the SAME batches — the int8-vs-bf16
+    perplexity delta with one pipeline. Eval batches come from step
+    indices training never consumed (synthetic streams are stateless
+    in the step index, so that range is genuinely held out).
+
+    ``--real-8b-int8``: teacher-forced NLL of the TRUE 8.03B int8
+    model on held-out tokens. This container is zero-egress (no real
+    checkpoint exists to quantize), so the weights are synthetic and
+    the value proves the full-scale eval path on chip, labeled
+    ``synthetic_weights: true`` — the quality DELTA evidence is the
+    trained scaled run above.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_nn_tpu.config import get_config
+    from pytorch_distributed_nn_tpu.data import get_dataset
+    from pytorch_distributed_nn_tpu.models import get_model
+    from pytorch_distributed_nn_tpu.train.losses import model_nll
+
+    if args.real_8b_int8:
+        from pytorch_distributed_nn_tpu.nn.quantized import (
+            synthetic_int8_params,
+        )
+
+        cfg = get_config("llama3_8b_zero")
+        cfg.model.extra = dict(quantized=True)
+        cfg.model.remat = False
+        model = get_model(cfg.model)
+        B, T = args.per_chip_batch or 1, cfg.data.seq_len
+        ds = get_dataset("lm_synthetic", seed=cfg.seed, batch_size=B,
+                         seq_len=T, vocab_size=model.vocab_size)
+        params = synthetic_int8_params(
+            model, jnp.zeros((B, 1), jnp.int32))
+        batches = (ds.batch(10_000 + i) for i in range(args.steps))
+        nll = model_nll(model, params, batches)
+        print(json.dumps(dict(
+            metric=_METRIC_NAMES["quality"], value=round(nll, 4),
+            unit="nll/token", vs_baseline=None,
+            perplexity=round(math.exp(min(nll, 30.0)), 2),
+            n_params=8030261248, synthetic_weights=True,
+            detail=f"TRUE 8B int8, teacher-forced NLL, {args.steps} "
+                   f"held-out batches of ({B}, {T}) — synthetic "
+                   "weights (zero-egress container: full-scale eval-"
+                   "path proof; the int8-vs-bf16 delta evidence is "
+                   "the trained scaled run)",
+        )))
+        return 0
+
+    from pytorch_distributed_nn_tpu.nn.quantized import (
+        quantize_model_params,
+    )
+    from pytorch_distributed_nn_tpu.train.trainer import Trainer
+
+    cfg = get_config("llama3_8b_zero")
+    dims = dict(num_layers=8, d_model=1024, num_heads=8,
+                num_kv_heads=4, mlp_dim=3584, vocab_size=32000)
+    cfg.model.extra = dict(dims)
+    cfg.model.remat = False
+    cfg.data.seq_len = 512
+    cfg.data.vocab_size = dims["vocab_size"]
+    train_steps = max(args.steps * 10, 150)
+    cfg.steps = train_steps
+    cfg.log_every = 0
+    cfg.data.batch_size = args.per_chip_batch or 16
+    cfg.parallel.strategy = "dp"
+    trainer = Trainer(cfg)
+    trainer.train()
+    params_f = jax.device_get(trainer.state.params)
+
+    model_f = trainer.model
+    cfg_q = get_config("llama3_8b_zero").model
+    cfg_q.extra = dict(dims, quantized=True)
+    cfg_q.remat = False
+    model_q = get_model(cfg_q)
+    q_shapes = jax.eval_shape(
+        lambda: model_q.init(jax.random.key(0),
+                             jnp.zeros((1, 1), jnp.int32),
+                             train=False))["params"]
+    params_q = quantize_model_params(params_f, q_shapes)
+
+    eval_batches = [trainer.dataset.batch(train_steps + 1000 + i)
+                    for i in range(max(args.steps // 2, 8))]
+    nll_f = model_nll(model_f, params_f, iter(eval_batches))
+    nll_q = model_nll(model_q, params_q, iter(eval_batches))
+    print(json.dumps(dict(
+        metric=_METRIC_NAMES["quality"], value=round(nll_q, 4),
+        unit="nll/token", vs_baseline=round(nll_q / nll_f, 4),
+        vs_baseline_kind="int8_nll_over_bf16_nll",
+        nll_bf16=round(nll_f, 4), nll_int8=round(nll_q, 4),
+        ppl_bf16=round(math.exp(min(nll_f, 30.0)), 2),
+        ppl_int8=round(math.exp(min(nll_q, 30.0)), 2),
+        detail=f"scaled stand-in ({dims['num_layers']}L d"
+               f"{dims['d_model']}), trained {train_steps} steps on "
+               f"lm_synthetic, held-out NLL on {len(eval_batches)} "
+               "common batches; weights quantized with "
+               "quantize_model_params (per-out-channel RTN int8)",
+    )))
+    return 0
+
+
 def bench_decode(args) -> int:
     """Inference decode throughput (beyond the reference, which has no
     serving story): KV-cache greedy generation tokens/s. Default: the
@@ -433,8 +543,10 @@ def bench_decode(args) -> int:
             "measured on the flagship decode path)"
         )
     if args.real_8b_int8:
-        # TRUE 8B dims (the preset's defaults), int8 weight-only
-        cfg.model.extra = dict(quantized=True)
+        # TRUE 8B dims (the preset's defaults), int8 weight-only;
+        # fused q|k|v / gate|up projection kernels (decode is per-op-
+        # launch bound at small batch — docs/design.md "Int8 decode")
+        cfg.model.extra = dict(quantized=True, fused_proj=True)
         if args.kv_int8:
             # int8 KV cache (nn/attention.py): per-(token, head)
             # scales, ~half the cache HBM — what moves the servable
@@ -546,7 +658,8 @@ def main(argv=None) -> int:
     ap.add_argument("--preset", default="resnet50_dp",
                     choices=sorted(PER_CHIP_BATCH))
     ap.add_argument("--metric", default="throughput",
-                    choices=("throughput", "bus_bw", "decode", "loader"),
+                    choices=("throughput", "bus_bw", "decode", "loader",
+                             "quality"),
                     help="bus_bw: BASELINE's grad-allreduce bus-bandwidth "
                          "metric (use with --preset bert_base_buckets); "
                          "decode: KV-cache generation tokens/s; loader: "
@@ -625,6 +738,8 @@ def main(argv=None) -> int:
         return bench_decode(args)
     if args.metric == "loader":
         return bench_loader(args)
+    if args.metric == "quality":
+        return bench_quality(args)
 
     import jax
 

@@ -49,6 +49,7 @@ class LlamaBlock(nn.Module):
     param_dtype: jnp.dtype = jnp.float32
     quantized: bool = False
     cache_dtype: str = "compute"
+    fused_proj: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False, decode: bool = False):
@@ -67,6 +68,7 @@ class LlamaBlock(nn.Module):
             use_bias=False, dtype=self.dtype,
             param_dtype=self.param_dtype, quantized=self.quantized,
             cache_dtype=self.cache_dtype,
+            fused_qkv=self.quantized and self.fused_proj,
             name="attn",
         )(y, decode=decode)
         x = x + y
@@ -79,8 +81,16 @@ class LlamaBlock(nn.Module):
             dense = lambda f, name: nn.Dense(  # noqa: E731
                 f, use_bias=False, dtype=self.dtype,
                 param_dtype=self.param_dtype, name=name)
-        gate = dense(self.mlp_dim, "gate_proj")(y)
-        up = dense(self.mlp_dim, "up_proj")(y)
+        if self.quantized and self.fused_proj:
+            # one int8 matmul for gate|up (exact: per-out-channel
+            # scales are concat-invariant) — decode is per-op-launch
+            # bound, see MultiHeadAttention.fused_qkv
+            gate_up = dense(2 * self.mlp_dim, "gate_up")(y)
+            gate = gate_up[..., :self.mlp_dim]
+            up = gate_up[..., self.mlp_dim:]
+        else:
+            gate = dense(self.mlp_dim, "gate_proj")(y)
+            up = dense(self.mlp_dim, "up_proj")(y)
         y = dense(d, "down_proj")(nn.silu(gate) * up)
         return x + y
 
@@ -110,6 +120,15 @@ class Llama(nn.Module):
     # HBM via per-(token, head) scales (nn/attention.py), roughly
     # doubling the servable decode batch on one chip
     cache_dtype: str = "compute"
+    # quantized path: fused qkv / gate|up projection kernels (fewer,
+    # larger int8 matmuls — decode latency is per-op-launch bound;
+    # +8% at b=1, docs/design.md "Int8 decode"). Default OFF: the
+    # unfused tree is the persisted int8 checkpoint layout contract
+    # (ops/pallas/int8_matmul.py storage note), and flipping it
+    # silently would break restores of existing quantized trees.
+    # bench's decode path and new conversions opt in via
+    # model.extra["fused_proj"] = True.
+    fused_proj: bool = False
 
     @nn.compact
     def __call__(self, tokens, *, train: bool = False,
@@ -163,6 +182,7 @@ class Llama(nn.Module):
                 attn_impl=self.attn_impl, dtype=self.dtype,
                 param_dtype=self.param_dtype, quantized=self.quantized,
                 cache_dtype=self.cache_dtype,
+                fused_proj=self.fused_proj,
                 name=f"layer{i}",
             )(x, train, decode)
         if last_only:
@@ -196,6 +216,7 @@ def build_llama3_8b(cfg: ModelConfig) -> Llama:
         attn_impl=e.get("attn_impl", "auto"),
         quantized=e.get("quantized", False),
         cache_dtype=e.get("cache_dtype", "compute"),
+        fused_proj=e.get("fused_proj", False),
         dtype=policy.compute_dtype,
         param_dtype=policy.param_dtype,
     )

@@ -152,6 +152,30 @@ def synthetic_int8_params(model, sample_tokens, seed: int = 0) -> Any:
     return jax.tree_util.tree_unflatten(treedef, leaves)
 
 
+def _merge_fused_projections(params: dict, qparams_shapes: Any) -> dict:
+    """Rewrite float {query,key,value} / {gate_proj,up_proj} subtrees
+    into the fused-projection layout when the quantized target declares
+    'qkv' / 'gate_up' modules (fused_proj=True, the default). EXACT:
+    quantization scales are per-OUTPUT-channel, and concatenating
+    kernels along the output axis leaves every channel's absmax — and
+    therefore its scale and rounded int8 values — untouched, so
+    quantize(concat) == concat(quantize)."""
+    if not isinstance(qparams_shapes, dict):
+        return params
+    fused = dict(params)
+    if ("qkv" in qparams_shapes and "qkv" not in fused
+            and {"query", "key", "value"} <= fused.keys()):
+        ks = [fused.pop(n)["kernel"] for n in ("query", "key", "value")]
+        # DenseGeneral kernels: (d_model, heads, head_dim) — heads is
+        # the concat axis of the fused (H + 2*Hkv, head_dim) features
+        fused["qkv"] = {"kernel": jnp.concatenate(ks, axis=1)}
+    if ("gate_up" in qparams_shapes and "gate_up" not in fused
+            and {"gate_proj", "up_proj"} <= fused.keys()):
+        ks = [fused.pop(n)["kernel"] for n in ("gate_proj", "up_proj")]
+        fused["gate_up"] = {"kernel": jnp.concatenate(ks, axis=1)}
+    return fused
+
+
 def quantize_model_params(params: Any, qparams_shapes: Any) -> Any:
     """Convert a float flax param tree to the int8 modules' layout.
 
@@ -168,6 +192,7 @@ def quantize_model_params(params: Any, qparams_shapes: Any) -> Any:
     """
     if not isinstance(params, dict):
         return params
+    params = _merge_fused_projections(params, qparams_shapes)
     out = {}
     for name, leaf in params.items():
         if name == "kernel" and hasattr(leaf, "shape"):

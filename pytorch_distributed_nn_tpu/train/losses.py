@@ -189,3 +189,33 @@ def get_loss_fn(dataset_name: str, *, label_smoothing: float = 0.0):
             f"label_smoothing must be in [0, 1), got {label_smoothing}"
         )
     return _smoothed(base, label_smoothing)
+
+
+def model_nll(model, params, batches) -> float:
+    """Teacher-forced mean per-token NLL of a causal LM over an
+    iterable of (tokens, targets) batches — the whole-model quality
+    metric behind ``bench.py --metric quality`` (int8-vs-bf16 NLL
+    delta; VERDICT r4 Missing #3). Works for float and int8-quantized
+    param trees alike (the model's lm_head emits f32 logits either
+    way). Perplexity = exp(return value).
+
+    The xent lives INSIDE the jit: the (B, T, V) logits then exist
+    once on device (f32, 2.1 GB at the 8B's B=1/T=4096/V=128k) with
+    the log-softmax reduction fused behind them, instead of surviving
+    the program boundary and feeding eager optax temporaries of the
+    same size. Raise B with the 8B only as that peak allows."""
+
+    @jax.jit
+    def batch_nll(params, x, y):
+        logits = model.apply({"params": params}, x, train=False)
+        return lm_xent(logits, y)
+
+    total, count = 0.0, 0
+    for x, y in batches:
+        nll = batch_nll(params, jnp.asarray(x), jnp.asarray(y))
+        n = int(jnp.asarray(y).size)
+        total += float(jax.device_get(nll)) * n
+        count += n
+    if count == 0:
+        raise ValueError("model_nll needs at least one batch")
+    return total / count

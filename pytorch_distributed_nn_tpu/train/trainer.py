@@ -168,6 +168,16 @@ class Trainer:
             return self._train_multistep(steps)
         self.loader.start_step = self.data_step  # don't replay batches
         it = iter(self.loader)
+        try:
+            return self._train_loop(it, steps)
+        finally:
+            # join the prefetch producer: a daemon thread left blocked
+            # mid-queue-put at interpreter exit SIGABRTs (the same race
+            # bench.py's loader loop guards against)
+            it.close()
+
+    def _train_loop(self, it, steps: int) -> list[StepRecord]:
+        cfg = self.cfg
         t_last = time.perf_counter()
         g_last = self.data_step  # step count behind each logged record
         for i in range(steps):
@@ -258,6 +268,22 @@ class Trainer:
                 window_sizes, start_step=self.data_step)
         t_last = time.perf_counter()
         g_last = self.data_step
+        remaining = steps
+        try:
+            return self._multistep_loop(batches, pool, xs_pool if pool
+                                        else None,
+                                        ys_pool if pool else None, k,
+                                        steps, t_last, g_last)
+        finally:
+            if batches is not None:
+                # same prefetch-producer join as train(): an abandoned
+                # stacked iterator leaves a daemon thread blocked in
+                # q.put -> SIGABRT at interpreter exit
+                batches.close()
+
+    def _multistep_loop(self, batches, pool, xs_pool, ys_pool, k,
+                        steps, t_last, g_last):
+        cfg = self.cfg
         remaining = steps
         while remaining > 0:
             k_eff = min(k, remaining)

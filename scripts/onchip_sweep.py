@@ -185,6 +185,13 @@ def main() -> int:
         metric_runs.append((f"decode_8b_int8_kv8_b{b}", "decode",
                             ["--real-8b-int8", "--kv-int8",
                              "--per-chip-batch", str(b)]))
+    # whole-model int8 quality (VERDICT r4 Missing #3): the trained
+    # scaled int8-vs-bf16 NLL delta, and the TRUE-8B eval-path record
+    # (synthetic weights — labeled in the record)
+    metric_runs.append(("quality_int8_delta", "quality",
+                        ["--steps", "16"]))
+    metric_runs.append(("quality_8b_evalpath", "quality",
+                        ["--real-8b-int8", "--steps", "16"]))
     for key, metric, extra in metric_runs:
         cmd = [sys.executable, "bench.py", "--metric", metric] + extra
         if metric == "loader":

@@ -1,0 +1,83 @@
+"""Whole-model int8 quality plumbing (VERDICT r4 Missing #3): the
+pieces behind ``bench.py --metric quality`` — train a tiny Llama,
+quantize the trained weights, and compare held-out teacher-forced NLL
+bf16 vs int8 through ``train.losses.model_nll``. On CPU the int8
+matmuls run the jnp fallback; the on-chip record lands in ONCHIP via
+the bench metric."""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytorch_distributed_nn_tpu.config import get_config
+from pytorch_distributed_nn_tpu.models import get_model
+from pytorch_distributed_nn_tpu.nn.quantized import quantize_model_params
+from pytorch_distributed_nn_tpu.train.losses import model_nll
+from pytorch_distributed_nn_tpu.train.trainer import Trainer
+
+DIMS = dict(num_layers=2, d_model=64, num_heads=4, num_kv_heads=2,
+            mlp_dim=128, vocab_size=101)
+
+
+def _trained(steps=60):
+    cfg = get_config("llama3_8b_zero")
+    cfg.model.extra = dict(DIMS)
+    # f32 on the CPU mesh: a bf16 grad all-reduce trips XLA:CPU's
+    # AllReducePromotion crash (same gate as the pipeline tests)
+    cfg.model.compute_dtype = "float32"
+    cfg.model.remat = False
+    cfg.data.seq_len = 32
+    cfg.data.batch_size = 8
+    cfg.data.vocab_size = DIMS["vocab_size"]
+    # no prefetch thread: a producer blocked in q.put while the main
+    # thread is inside XLA:CPU execution intermittently aborts the
+    # interpreter on this 1-core host (the bench metric runs on TPU
+    # with prefetch; the plumbing under test is NLL, not the loader)
+    cfg.data.prefetch = 0
+    cfg.steps = steps
+    cfg.log_every = 0
+    cfg.parallel.strategy = "dp"
+    trainer = Trainer(cfg)
+    trainer.train()
+    return trainer
+
+
+def test_int8_nll_close_to_bf16_on_trained_model():
+    trainer = _trained()
+    params_f = jax.device_get(trainer.state.params)
+    model_f = trainer.model
+
+    cfg_q = get_config("llama3_8b_zero").model
+    cfg_q.extra = dict(DIMS, quantized=True)
+    cfg_q.compute_dtype = "float32"  # match the bf16-free oracle side
+    cfg_q.remat = False
+    model_q = get_model(cfg_q)
+    q_shapes = jax.eval_shape(
+        lambda: model_q.init(jax.random.key(0),
+                             jnp.zeros((1, 1), jnp.int32),
+                             train=False))["params"]
+    params_q = quantize_model_params(params_f, q_shapes)
+
+    batches = [trainer.dataset.batch(10_000 + i) for i in range(4)]
+    nll_f = model_nll(model_f, params_f, iter(batches))
+    nll_q = model_nll(model_q, params_q, iter(batches))
+
+    # training on the learnable stream must beat the uniform floor,
+    # else the delta below is vacuous
+    assert nll_f < math.log(DIMS["vocab_size"]) * 0.98, nll_f
+    assert np.isfinite(nll_q)
+    # weight-only int8 on a trained model: small relative NLL penalty
+    assert nll_q < nll_f * 1.15 + 0.05, (nll_f, nll_q)
+    # and int8 can't magically be much better (sanity both directions)
+    assert nll_q > nll_f * 0.85 - 0.05, (nll_f, nll_q)
+
+
+def test_model_nll_rejects_empty():
+    trainer = _trained(steps=1)
+    try:
+        model_nll(trainer.model, trainer.state.params, iter([]))
+        raise AssertionError("expected ValueError")
+    except ValueError:
+        pass

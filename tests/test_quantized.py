@@ -167,3 +167,29 @@ def test_quantized_param_bytes_are_int8():
     total_bytes = sum(x.size * x.dtype.itemsize for x in leaves)
     # int8 leaves must dominate storage (scales + norms are the rest)
     assert int8_bytes / total_bytes > 0.9
+
+
+def test_fused_proj_exactly_matches_unfused():
+    """fused_proj merges q/k/v and gate/up into single int8 kernels.
+    Per-output-channel scales are concat-invariant, so the fused model
+    must produce IDENTICAL logits to the unfused one from the same
+    float params (same rounded int8 values, same scales — the only
+    difference is matmul grouping, f32-accumulation exact on these
+    tiny dims)."""
+    f32 = _tiny_llama(False)
+    fused = Llama(**_TINY, quantized=True, fused_proj=True,
+                  param_dtype=jnp.float32)
+    unfused = Llama(**_TINY, quantized=True, fused_proj=False,
+                    param_dtype=jnp.float32)
+    tokens = jax.random.randint(jax.random.key(4), (2, 7), 0, 251)
+    params = f32.init(jax.random.key(0), tokens)["params"]
+
+    def qtree(model):
+        shapes = jax.eval_shape(
+            lambda: model.init(jax.random.key(0), tokens))["params"]
+        return quantize_model_params(dict(params), shapes)
+
+    out_f = fused.apply({"params": qtree(fused)}, tokens)
+    out_u = unfused.apply({"params": qtree(unfused)}, tokens)
+    np.testing.assert_allclose(np.asarray(out_f), np.asarray(out_u),
+                               rtol=1e-5, atol=1e-5)
