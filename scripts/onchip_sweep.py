@@ -182,9 +182,12 @@ def main() -> int:
                             ["--real-8b-int8", "--per-chip-batch",
                              str(b)]))
     for b in (128, 256):
+        # b=256 needs chunked prefill: the fused-projection one-shot
+        # (B, P) prefill peak exceeds HBM at the capacity edge
+        chunk = ["--prefill-chunk", "32"] if b >= 256 else []
         metric_runs.append((f"decode_8b_int8_kv8_b{b}", "decode",
                             ["--real-8b-int8", "--kv-int8",
-                             "--per-chip-batch", str(b)]))
+                             "--per-chip-batch", str(b)] + chunk))
     # whole-model int8 quality (VERDICT r4 Missing #3): the trained
     # scaled int8-vs-bf16 NLL delta, and the TRUE-8B eval-path record
     # (synthetic weights — labeled in the record)
