@@ -715,6 +715,11 @@ def main(argv=None) -> int:
                          "each of --steps then counts a k-step dispatch; "
                          "the TPU-idiomatic loop for dispatch-bound "
                          "presets")
+    ap.add_argument("--goodput", action="store_true",
+                    help="throughput metric: attach the obs goodput "
+                         "breakdown (data/compute/collective/checkpoint/"
+                         "other seconds + fractions) to the emitted "
+                         "record")
     ap.add_argument("--set", action="append", default=[], dest="overrides",
                     metavar="a.b=c",
                     help="dotted config override applied after the "
@@ -838,6 +843,7 @@ def main(argv=None) -> int:
         # step depends on every prior step, so this syncs the loop.
         return float(jax.device_get(metrics["loss"]))
 
+    goodput_summary = None
     if args.multistep > 1:
         # Device-side training loop: the TRAINER's multistep path
         # (cfg.multistep_k was set above), with a 4-batch cycled pool
@@ -849,11 +855,17 @@ def main(argv=None) -> int:
         k = args.multistep
         trainer.train(steps=max(args.warmup // k, 1) * k)
         fence(trainer.last_metrics)
+        if args.goodput:
+            # discard the warmup window: the breakdown should describe
+            # the timed steps only (compile time isn't goodput)
+            trainer.goodput.window_summary(reset=True)
         t0 = time.perf_counter()
         with profile:
             trainer.train(steps=args.steps * k)
             loss = fence(trainer.last_metrics)
         dt = time.perf_counter() - t0
+        if args.goodput:
+            goodput_summary = trainer.goodput.window_summary()
     else:
         k = 1
         # Device-resident batch pool: the timed loop must measure
@@ -871,11 +883,26 @@ def main(argv=None) -> int:
             state, metrics = run_step(state, i)
         fence(metrics)
 
+        gp = trainer.goodput
         t0 = time.perf_counter()
         with profile:
-            for i in range(args.steps):
-                state, metrics = run_step(state, i)
-            loss = fence(metrics)
+            if args.goodput:
+                # the whole timed loop is one goodput window: the pool
+                # is device-resident (data ≈ 0 by construction) and
+                # compute covers dispatch + the final fence
+                gp.window_summary(reset=True)
+                gp.step_start()
+                with gp.phase("compute"):
+                    for i in range(args.steps):
+                        state, metrics = run_step(state, i)
+                    loss = fence(metrics)
+                gp.step_end(step=args.steps - 1,
+                            steps_covered=args.steps)
+                goodput_summary = gp.window_summary()
+            else:
+                for i in range(args.steps):
+                    state, metrics = run_step(state, i)
+                loss = fence(metrics)
         dt = time.perf_counter() - t0
     if not (loss == loss):  # NaN guard: a benchmark that diverged is void
         raise RuntimeError(f"non-finite loss {loss} in benchmark loop")
@@ -939,6 +966,7 @@ def main(argv=None) -> int:
                if cfg.data.dataset in ("lm_synthetic", "mlm_synthetic",
                                        "token_file") else {}),
             **({"mfu_error": mfu_error} if mfu_error else {}),
+            **({"goodput": goodput_summary} if goodput_summary else {}),
         )
     print(json.dumps(rec))
     return 0

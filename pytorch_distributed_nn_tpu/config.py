@@ -157,9 +157,14 @@ class TrainConfig:
     resume: bool = True
     profile_dir: str = ""
     # structured JSONL metrics (utils/metrics.MetricsLogger): every
-    # log_every step + every eval as machine-readable events, emitted by
-    # the coordinator only ("" = off)
+    # log_every step + every eval + goodput breakdowns as machine-
+    # readable events, emitted by the coordinator only ("" = off)
     metrics_path: str = ""
+    # Prometheus textfile exposition (obs/registry.py): the process
+    # registry (counters/gauges/histograms, goodput, mesh topology,
+    # heartbeat state) written here at log cadence and on close
+    # ("" = off) — node_exporter textfile-collector layout
+    prom_path: str = ""
     mesh: MeshSpec = field(default_factory=MeshSpec)
     optim: OptimConfig = field(default_factory=OptimConfig)
     data: DataConfig = field(default_factory=DataConfig)

@@ -22,6 +22,7 @@ import jax
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from pytorch_distributed_nn_tpu import obs
 from pytorch_distributed_nn_tpu.data.datasets import SyntheticDataset
 from pytorch_distributed_nn_tpu.runtime.mesh import AXIS_SEQ, batch_pspec
 
@@ -105,22 +106,34 @@ class DataLoader:
 
     def batch_at(self, step: int) -> tuple[jax.Array, ...]:
         """Deterministic global batch for one step (no prefetch)."""
-        return tuple(self._to_global(a) for a in self.dataset.batch(step))
+        # span covers host generation + shard assembly/transfer; when
+        # prefetch is on it runs on the producer thread, so the trace
+        # shows host data work overlapping device compute
+        with obs.span("data/host_batch", step=step):
+            out = tuple(self._to_global(a)
+                        for a in self.dataset.batch(step))
+        obs.get_registry().counter(
+            "data_batches_total", "host batches assembled").inc()
+        return out
 
     def stacked_batch_at(self, step: int, k: int) -> tuple[jax.Array, ...]:
         """Batches for steps [step, step+k) stacked on a leading pool
         axis — the input layout of the device-side multistep loop
         (train/multistep.py): (k, B, ...) with the pool axis unsharded
         and the batch rows sharded exactly as :meth:`batch_at`."""
-        per_step = [self.dataset.batch(step + i) for i in range(k)]
-        out = []
-        for j in range(len(per_step[0])):
-            arr = np.stack([b[j] for b in per_step])
-            inner = array_pspec(self.mesh, arr.ndim - 1,
-                                arr.shape[2] if arr.ndim >= 3 else None)
-            sharding = NamedSharding(self.mesh,
-                                     PartitionSpec(None, *inner))
-            out.append(self._assemble(arr, sharding))
+        with obs.span("data/host_batch_stacked", step=step, k=k):
+            per_step = [self.dataset.batch(step + i) for i in range(k)]
+            out = []
+            for j in range(len(per_step[0])):
+                arr = np.stack([b[j] for b in per_step])
+                inner = array_pspec(
+                    self.mesh, arr.ndim - 1,
+                    arr.shape[2] if arr.ndim >= 3 else None)
+                sharding = NamedSharding(self.mesh,
+                                         PartitionSpec(None, *inner))
+                out.append(self._assemble(arr, sharding))
+        obs.get_registry().counter(
+            "data_batches_total", "host batches assembled").inc(k)
         return tuple(out)
 
     def _prefetched(self, make_items) -> Iterator:
@@ -151,9 +164,16 @@ class DataLoader:
 
         thread = threading.Thread(target=producer, daemon=True)
         thread.start()
+        depth = obs.get_registry().gauge(
+            "data_queue_depth", "prefetched batches waiting")
         try:
             while True:
-                item = q.get()
+                # the q.get wait IS the host data-wait the goodput
+                # breakdown's "data" phase measures from the trainer;
+                # the span makes it visible in traces independently
+                with obs.span("data/queue_wait", cat="data"):
+                    item = q.get()
+                depth.set(q.qsize())
                 if item is end_of_stream:
                     return
                 if isinstance(item, Exception):

@@ -29,6 +29,8 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from pytorch_distributed_nn_tpu import obs
+
 
 def shard_params_for_inference(params, mesh):
     """Place params on ``mesh`` per the TP/EP layout rules
@@ -254,19 +256,28 @@ def generate(model, params, prompt, max_new_tokens: int, *,
     # prefill: the whole prompt in one chunk, or bounded chunks for
     # long prompts (each chunk attends to the cache prefix, so live
     # scores are (chunk, filled) instead of (P, P))
-    if prefill_chunk and prefill_chunk < P_len:
-        pos = 0
-        while pos < P_len:
-            chunk = prompt[:, pos:pos + prefill_chunk]
+    with obs.span("inference/prefill", batch=B, prompt_len=P_len):
+        if prefill_chunk and prefill_chunk < P_len:
+            pos = 0
+            while pos < P_len:
+                chunk = prompt[:, pos:pos + prefill_chunk]
+                next_logits, cache = _decode_step(model, params, cache,
+                                                  chunk)
+                pos += chunk.shape[1]
+        else:
             next_logits, cache = _decode_step(model, params, cache,
-                                              chunk)
-            pos += chunk.shape[1]
-    else:
-        next_logits, cache = _decode_step(model, params, cache, prompt)
+                                              prompt)
 
     # greedy ignores the key; pass a constant so the trace is uniform
     rng0 = rng if rng is not None else jax.random.key(0)
-    toks, _ = _decode_loop(model, params, cache, next_logits, rng0,
-                           max_new_tokens, jnp.float32(temperature),
-                           int(top_k), eos_token, float(top_p))
+    # span covers dispatch of the fused scan, not device completion —
+    # callers that fence (bench) see the true decode window in-trace
+    with obs.span("inference/decode_loop", batch=B,
+                  new_tokens=max_new_tokens):
+        toks, _ = _decode_loop(model, params, cache, next_logits, rng0,
+                               max_new_tokens, jnp.float32(temperature),
+                               int(top_k), eos_token, float(top_p))
+    obs.get_registry().counter(
+        "inference_tokens_total", "tokens generated (dispatched)").inc(
+        B * max_new_tokens)
     return jnp.concatenate([prompt, toks.T.astype(jnp.int32)], axis=1)

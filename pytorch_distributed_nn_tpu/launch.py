@@ -45,6 +45,7 @@ import subprocess
 import sys
 import time
 
+from .obs import runtime_gauges
 from .runtime import failure, native
 
 log = logging.getLogger(__name__)
@@ -189,6 +190,10 @@ class ElasticAgent:
             if detector is not None:
                 alive = {base + i for i, c in enumerate(codes) if c is None}
                 stale = detector.stale_ranks(alive)
+                # agent-side observability: per-rank last-beat age and
+                # missed-beat gauges in the process registry (scraped /
+                # snapshotted like any worker metric)
+                runtime_gauges.export_detector_gauges(detector)
                 if stale:
                     log.warning("heartbeat lost from ranks %s", stale)
                     return "hang", 1

@@ -1,0 +1,41 @@
+"""Unified telemetry: metric registry, span tracing, goodput accounting.
+
+One subsystem the whole stack reports into (ISSUE 1), replacing four
+disconnected islands (JSONL logger, StepTimer, perfetto parsing, store
+heartbeats) with:
+
+- :mod:`obs.registry` — process-wide counters/gauges/histograms with
+  Prometheus text exposition and the JSONL sink as backends;
+- :mod:`obs.span` — ``with obs.span("data/next_batch"): ...`` Chrome
+  trace events per host, free when disabled;
+- :mod:`obs.goodput` — per-step wall-time decomposition into
+  data/compute/collective/checkpoint/eval/other;
+- :mod:`obs.runtime_gauges` — mesh topology + heartbeat state gauges;
+- :mod:`obs.aggregate` — cross-host snapshot aggregation through the
+  native store.
+
+``scripts/obs_report.py`` renders the JSONL/trace output;
+``bench.py --goodput`` attaches the breakdown to benchmark records.
+"""
+
+from pytorch_distributed_nn_tpu.obs.goodput import (  # noqa: F401
+    PHASES,
+    GoodputMeter,
+    StepBreakdown,
+)
+from pytorch_distributed_nn_tpu.obs.registry import (  # noqa: F401
+    Counter,
+    Gauge,
+    Histogram,
+    MetricRegistry,
+    get_registry,
+    reset_registry,
+)
+from pytorch_distributed_nn_tpu.obs.span import (  # noqa: F401
+    disable_tracing,
+    enable_tracing,
+    merge_chrome_traces,
+    span,
+    tracing_enabled,
+    write_trace,
+)

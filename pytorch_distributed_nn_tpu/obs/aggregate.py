@@ -1,0 +1,88 @@
+"""Cross-host metric aggregation through the native rendezvous store.
+
+Per-host registries are process-local; a pod-level view needs one place
+to read. The job's C++ store (native/store.cpp — already connected for
+rendezvous + heartbeats) doubles as the transport: each host publishes
+its flat registry snapshot under ``obs/<incarnation>/<rank>`` at log
+cadence, and the coordinator pulls and merges them. No new service, no
+listener ports on workers.
+
+Merging semantics: counters (``*_total``) sum across hosts; everything
+else (gauges, histogram sums/counts are also summed — a histogram count
+IS a counter) keeps per-host values under a ``rank`` label in
+:func:`merge_snapshots`'s ``per_rank`` view, with sums in ``summed``.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+
+from pytorch_distributed_nn_tpu.obs.registry import (
+    MetricRegistry,
+    get_registry,
+)
+
+log = logging.getLogger(__name__)
+
+_KEY_FMT = "obs/{incarnation}/{rank}"
+
+
+def publish_snapshot(client, *, rank: int, incarnation: int = 0,
+                     registry: MetricRegistry | None = None) -> str:
+    """Write this host's flat snapshot to the store; returns the key."""
+    reg = registry or get_registry()
+    key = _KEY_FMT.format(incarnation=incarnation, rank=rank)
+    client.set(key, json.dumps(reg.snapshot()).encode())
+    return key
+
+
+def maybe_publish(registry: MetricRegistry | None = None) -> bool:
+    """Publish through the heartbeat reporter's live store connection
+    (the one :func:`runtime.failure.maybe_start_heartbeat` opened).
+    No-op outside the elastic agent; never raises into the train loop —
+    a flaky store must not kill training for a metrics push."""
+    from pytorch_distributed_nn_tpu.runtime import failure
+
+    rep = failure.reporter()
+    if rep is None:
+        return False
+    try:
+        publish_snapshot(rep.client, rank=rep.rank,
+                         incarnation=rep.incarnation, registry=registry)
+        return True
+    except OSError as e:
+        log.warning("metric snapshot publish failed: %s", e)
+        return False
+
+
+def collect_snapshots(client, ranks, *, incarnation: int = 0,
+                      timeout_ms: int = 1000) -> dict[int, dict]:
+    """Coordinator pull: each rank's latest snapshot (absent ranks are
+    skipped — a worker that has not published yet is not an error)."""
+    out: dict[int, dict] = {}
+    for rank in ranks:
+        key = _KEY_FMT.format(incarnation=incarnation, rank=rank)
+        try:
+            if not client.check(key):
+                continue
+            out[rank] = json.loads(
+                client.get(key, timeout_ms=timeout_ms).decode())
+        except (OSError, TimeoutError, ValueError) as e:
+            log.warning("snapshot pull for rank %d failed: %s", rank, e)
+    return out
+
+
+def merge_snapshots(snapshots: dict[int, dict]) -> dict:
+    """{"summed": {metric: Σ across hosts}, "per_rank": {metric:
+    {rank: value}}} — counters read from "summed", gauges from
+    "per_rank" (summing a per-host gauge like heartbeat age would be
+    meaningless)."""
+    summed: dict[str, float] = {}
+    per_rank: dict[str, dict[int, float]] = {}
+    for rank, snap in sorted(snapshots.items()):
+        for metric, value in snap.items():
+            summed[metric] = summed.get(metric, 0.0) + float(value)
+            per_rank.setdefault(metric, {})[rank] = float(value)
+    return {"summed": summed, "per_rank": per_rank,
+            "hosts": len(snapshots)}

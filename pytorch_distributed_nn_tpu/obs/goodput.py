@@ -1,0 +1,174 @@
+"""Goodput accounting: decompose wall step time into phases.
+
+The trainer can report *that* a step took 41 ms; this meter reports
+where it went: ``data`` (host batch wait), ``compute`` (dispatch +
+device fence), ``collective`` (trace-derived share of compute, when a
+profile is available), ``checkpoint``, ``eval``, and ``other`` (the
+unattributed remainder — Python loop overhead, logging). The breakdown
+is what the EQuARX / pjit-scaling style of perf work needs: you cannot
+shrink a phase you cannot see.
+
+Accounting contract:
+
+- phases are measured on the host with ``perf_counter`` inside
+  :meth:`GoodputMeter.phase` blocks nested in a
+  :meth:`step_start`/:meth:`step_end` window;
+- ``other = wall − Σ(measured phases)`` per step, so the published
+  breakdown sums to wall by construction; ``accounted_frac`` (measured
+  phases / wall) is reported alongside so "other" can never silently
+  swallow the step;
+- async dispatch: device execution hides behind the dispatch queue, so
+  host-side "compute" is dispatch time plus whatever fence the loop
+  performs (device_get of the loss at log cadence). Per-window sums are
+  honest — within a window the device cannot outrun the host by more
+  than the queue depth;
+- the collective share cannot be host-timed inside one fused step; it
+  is either trace-derived (``utils.profiling.collective_trace_seconds``
+  over an xprof capture) or estimated downstream from the recorded
+  ``wire_bytes_per_step`` (``ops.collectives.CommRecorder``) — the
+  meter carries both so ``scripts/obs_report.py`` can cross-check one
+  against the other.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import time
+
+from pytorch_distributed_nn_tpu.obs import span as _span
+
+PHASES = ("data", "compute", "collective", "checkpoint", "eval", "other")
+
+
+@dataclasses.dataclass
+class StepBreakdown:
+    """One step (or one fused window) decomposed into phase seconds."""
+
+    step: int
+    wall_s: float
+    phases: dict[str, float]  # measured phases + computed "other"
+    accounted_frac: float  # measured (non-other) phases / wall
+
+    def as_fields(self) -> dict:
+        """Flat JSONL-able fields (the ``goodput`` event payload)."""
+        out = {"step": self.step, "wall_s": round(self.wall_s, 6),
+               "accounted_frac": round(self.accounted_frac, 4)}
+        for name in PHASES:
+            out[f"{name}_s"] = round(self.phases.get(name, 0.0), 6)
+        return out
+
+
+class GoodputMeter:
+    """Per-step phase accumulator + running totals.
+
+    One instance per training loop. Every :meth:`phase` block also
+    emits an obs span (same names), so a trace capture and the JSONL
+    breakdown describe the same windows.
+    """
+
+    def __init__(self) -> None:
+        self.totals: dict[str, float] = {p: 0.0 for p in PHASES}
+        self.total_wall_s = 0.0
+        self.steps = 0
+        self.wire_bytes_per_step: float | None = None
+        self._win_totals: dict[str, float] = {p: 0.0 for p in PHASES}
+        self._win_wall_s = 0.0
+        self._win_steps = 0
+        self._step_t0: float | None = None
+        self._step_phases: dict[str, float] = {}
+
+    # -- per-step window -------------------------------------------------
+
+    def step_start(self) -> None:
+        self._step_t0 = time.perf_counter()
+        self._step_phases = {}
+
+    @contextlib.contextmanager
+    def phase(self, name: str):
+        """Time one phase of the current step (nested spans allowed;
+        unknown names raise so breakdowns stay schema-stable)."""
+        if name not in PHASES or name == "other":
+            raise ValueError(f"unknown goodput phase {name!r}")
+        t0 = time.perf_counter()
+        with _span.span(f"goodput/{name}", cat="goodput"):
+            try:
+                yield
+            finally:
+                dt = time.perf_counter() - t0
+                self._step_phases[name] = (
+                    self._step_phases.get(name, 0.0) + dt
+                )
+
+    def add_phase_seconds(self, name: str, seconds: float) -> None:
+        """Attribute already-measured seconds (e.g. a trace-derived
+        collective share) to the current step."""
+        if name not in PHASES or name == "other":
+            raise ValueError(f"unknown goodput phase {name!r}")
+        self._step_phases[name] = (
+            self._step_phases.get(name, 0.0) + float(seconds)
+        )
+
+    def step_end(self, step: int = -1, *,
+                 steps_covered: int = 1) -> StepBreakdown:
+        """Close the window opened by :meth:`step_start`. A fused
+        multistep dispatch passes ``steps_covered=k`` so throughput
+        totals stay per-optimizer-step comparable."""
+        if self._step_t0 is None:
+            raise RuntimeError("step_end without step_start")
+        wall = time.perf_counter() - self._step_t0
+        self._step_t0 = None
+        measured = sum(self._step_phases.values())
+        phases = dict(self._step_phases)
+        # collective time is a SHARE of compute when trace-derived;
+        # never let the remainder go negative from double counting
+        phases["other"] = max(wall - measured, 0.0)
+        bd = StepBreakdown(
+            step=step, wall_s=wall, phases=phases,
+            accounted_frac=min(measured / wall, 1.0) if wall > 0 else 0.0,
+        )
+        self.steps += steps_covered
+        self.total_wall_s += wall
+        self._win_steps += steps_covered
+        self._win_wall_s += wall
+        for name, v in phases.items():
+            self.totals[name] = self.totals.get(name, 0.0) + v
+            self._win_totals[name] = self._win_totals.get(name, 0.0) + v
+        return bd
+
+    # -- windows / summaries ---------------------------------------------
+
+    def window_summary(self, *, reset: bool = True) -> dict:
+        """Aggregate since the last window flush (the log-cadence
+        ``goodput`` JSONL event payload)."""
+        out = self._summarize(self._win_totals, self._win_wall_s,
+                              self._win_steps)
+        if reset:
+            self._win_totals = {p: 0.0 for p in PHASES}
+            self._win_wall_s = 0.0
+            self._win_steps = 0
+        return out
+
+    def summary(self) -> dict:
+        """Whole-run aggregate."""
+        return self._summarize(self.totals, self.total_wall_s, self.steps)
+
+    def _summarize(self, totals: dict, wall: float, steps: int) -> dict:
+        out = {"steps": steps, "wall_s": round(wall, 6)}
+        for name in PHASES:
+            v = totals.get(name, 0.0)
+            out[f"{name}_s"] = round(v, 6)
+            out[f"{name}_frac"] = round(v / wall, 4) if wall > 0 else 0.0
+        measured = sum(totals.get(p, 0.0) for p in PHASES if p != "other")
+        out["accounted_frac"] = (round(min(measured / wall, 1.0), 4)
+                                 if wall > 0 else 0.0)
+        # goodput in the step-time sense: the share of wall doing the
+        # actual training work (device compute incl. collectives)
+        out["goodput_frac"] = (
+            round((totals.get("compute", 0.0)
+                   + totals.get("collective", 0.0)) / wall, 4)
+            if wall > 0 else 0.0
+        )
+        if self.wire_bytes_per_step is not None:
+            out["wire_bytes_per_step"] = round(self.wire_bytes_per_step, 1)
+        return out

@@ -1,0 +1,285 @@
+"""Process-wide metric registry: counters, gauges, histograms.
+
+The reference's metrics story is ``if rank == 0: print(loss)``; ours had
+a JSONL stream (utils/metrics.MetricsLogger) but no typed instruments —
+every subsystem invented its own ad-hoc fields. This registry is the one
+place the stack reports into:
+
+- **instruments**: :class:`Counter` (monotone), :class:`Gauge` (set),
+  :class:`Histogram` (observe into cumulative buckets), each with
+  optional label names and per-label-value children;
+- **backends**: Prometheus text exposition (:meth:`MetricRegistry.
+  prometheus_text` — the ``text/plain; version=0.0.4`` format) and the
+  existing JSONL sink (:meth:`MetricRegistry.emit_jsonl` feeds a
+  ``MetricsLogger``), so one instrument serves both the scrape world and
+  the benchmark-record world;
+- **process-wide default**: :func:`get_registry` — module singletons are
+  how library code reports without threading a handle through every
+  constructor (the torch/prometheus_client idiom).
+
+Thread-safe: producer threads (data prefetch, heartbeat) increment the
+same instruments the train loop does.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+import time
+from typing import Iterable, Mapping
+
+# Default histogram buckets: latency-flavored, seconds. Wide enough for
+# a 96k-token step (~13 s) and fine enough for a 1 ms MLP dispatch.
+DEFAULT_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5,
+    1.0, 2.5, 5.0, 10.0, 30.0, 60.0,
+)
+
+
+def _fmt_value(v: float) -> str:
+    """Prometheus float rendering: integers bare, +Inf spelled."""
+    if v == math.inf:
+        return "+Inf"
+    if v == int(v) and abs(v) < 1e15:
+        return str(int(v))
+    return repr(float(v))
+
+
+def _fmt_labels(names: tuple[str, ...], values: tuple[str, ...]) -> str:
+    if not names:
+        return ""
+    body = ",".join(
+        f'{k}="{_escape(v)}"' for k, v in zip(names, values)
+    )
+    return "{" + body + "}"
+
+
+def _escape(v: str) -> str:
+    return (str(v).replace("\\", r"\\").replace('"', r"\"")
+            .replace("\n", r"\n"))
+
+
+class _Instrument:
+    """Shared parent: name/help/label plumbing + child lookup."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str = "",
+                 labels: Iterable[str] = ()) -> None:
+        self.name = name
+        self.help = help
+        self.label_names = tuple(labels)
+        self._lock = threading.Lock()
+        # label-values tuple -> per-series state (subclass-defined)
+        self._series: dict[tuple[str, ...], object] = {}
+
+    def _key(self, labels: Mapping[str, object] | None) -> tuple[str, ...]:
+        labels = labels or {}
+        if set(labels) != set(self.label_names):
+            raise ValueError(
+                f"{self.name}: expected labels {self.label_names}, "
+                f"got {tuple(labels)}"
+            )
+        return tuple(str(labels[k]) for k in self.label_names)
+
+
+class Counter(_Instrument):
+    kind = "counter"
+
+    def inc(self, value: float = 1.0, **labels: object) -> None:
+        if value < 0:
+            raise ValueError(f"{self.name}: counters only go up")
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + value
+
+    def value(self, **labels: object) -> float:
+        return float(self._series.get(self._key(labels), 0.0))
+
+    def collect(self):
+        with self._lock:
+            for key, v in sorted(self._series.items()):
+                yield self.name, key, float(v)
+
+
+class Gauge(_Instrument):
+    kind = "gauge"
+
+    def set(self, value: float, **labels: object) -> None:
+        with self._lock:
+            self._series[self._key(labels)] = float(value)
+
+    def inc(self, value: float = 1.0, **labels: object) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + value
+
+    def value(self, **labels: object) -> float:
+        return float(self._series.get(self._key(labels), 0.0))
+
+    def collect(self):
+        with self._lock:
+            for key, v in sorted(self._series.items()):
+                yield self.name, key, float(v)
+
+
+class Histogram(_Instrument):
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str = "",
+                 labels: Iterable[str] = (),
+                 buckets: Iterable[float] = DEFAULT_BUCKETS) -> None:
+        super().__init__(name, help, labels)
+        bs = tuple(sorted(float(b) for b in buckets))
+        if not bs:
+            raise ValueError(f"{name}: need at least one bucket bound")
+        self.buckets = bs
+
+    def observe(self, value: float, **labels: object) -> None:
+        key = self._key(labels)
+        with self._lock:
+            state = self._series.get(key)
+            if state is None:
+                state = {"counts": [0] * (len(self.buckets) + 1),
+                         "sum": 0.0, "count": 0}
+                self._series[key] = state
+            # non-cumulative per-bucket counts; exposition cumulates
+            for i, bound in enumerate(self.buckets):
+                if value <= bound:
+                    state["counts"][i] += 1
+                    break
+            else:
+                state["counts"][-1] += 1  # +Inf bucket
+            state["sum"] += float(value)
+            state["count"] += 1
+
+    def snapshot(self, **labels: object) -> dict:
+        """{count, sum, mean} for one series (zeros when unobserved)."""
+        state = self._series.get(self._key(labels))
+        if state is None:
+            return {"count": 0, "sum": 0.0, "mean": 0.0}
+        return {"count": state["count"], "sum": state["sum"],
+                "mean": state["sum"] / max(state["count"], 1)}
+
+    def collect(self):
+        """Yield exposition rows: (_bucket rows with le=), _sum, _count."""
+        with self._lock:
+            for key, state in sorted(self._series.items()):
+                cum = 0
+                for bound, n in zip(self.buckets + (math.inf,),
+                                    state["counts"]):
+                    cum += n
+                    yield (f"{self.name}_bucket",
+                           key + (_fmt_value(bound),), float(cum))
+                yield f"{self.name}_sum", key, float(state["sum"])
+                yield f"{self.name}_count", key, float(state["count"])
+
+
+class MetricRegistry:
+    """Instrument factory + exposition. ``counter``/``gauge``/
+    ``histogram`` are get-or-create keyed by name, so call sites
+    anywhere in the stack share one series without passing handles."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._instruments: dict[str, _Instrument] = {}
+
+    def _get_or_create(self, cls, name: str, help: str,
+                       labels: Iterable[str], **kwargs) -> _Instrument:
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is None:
+                inst = cls(name, help, labels, **kwargs)
+                self._instruments[name] = inst
+                return inst
+        if not isinstance(inst, cls):
+            raise TypeError(
+                f"metric {name!r} already registered as {inst.kind}"
+            )
+        return inst
+
+    def counter(self, name: str, help: str = "",
+                labels: Iterable[str] = ()) -> Counter:
+        return self._get_or_create(Counter, name, help, labels)
+
+    def gauge(self, name: str, help: str = "",
+              labels: Iterable[str] = ()) -> Gauge:
+        return self._get_or_create(Gauge, name, help, labels)
+
+    def histogram(self, name: str, help: str = "",
+                  labels: Iterable[str] = (),
+                  buckets: Iterable[float] = DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_create(Histogram, name, help, labels,
+                                   buckets=buckets)
+
+    def instruments(self) -> list[_Instrument]:
+        with self._lock:
+            return list(self._instruments.values())
+
+    # -- backends --------------------------------------------------------
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition format 0.0.4 (what a /metrics
+        endpoint serves; ``promtool check metrics``-clean)."""
+        out: list[str] = []
+        for inst in self.instruments():
+            if inst.help:
+                out.append(f"# HELP {inst.name} {_escape(inst.help)}")
+            out.append(f"# TYPE {inst.name} {inst.kind}")
+            for name, key, value in inst.collect():
+                if name.endswith("_bucket"):
+                    lnames = inst.label_names + ("le",)
+                else:
+                    lnames = inst.label_names
+                out.append(
+                    f"{name}{_fmt_labels(lnames, key)} {_fmt_value(value)}"
+                )
+        return "\n".join(out) + ("\n" if out else "")
+
+    def write_prometheus(self, path) -> None:
+        """Textfile-collector backend (node_exporter idiom): atomic-ish
+        single write of the full exposition."""
+        from pathlib import Path
+
+        p = Path(path)
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(self.prometheus_text())
+
+    def snapshot(self) -> dict:
+        """Flat JSON-able view: {metric{labels}: value}; histograms as
+        {count, sum}. The cross-host aggregation payload."""
+        flat: dict[str, float] = {}
+        for inst in self.instruments():
+            if isinstance(inst, Histogram):
+                for name, key, value in inst.collect():
+                    if name.endswith("_bucket"):
+                        continue  # buckets stay host-local
+                    lnames = inst.label_names
+                    flat[name + _fmt_labels(lnames, key)] = value
+            else:
+                for name, key, value in inst.collect():
+                    flat[name + _fmt_labels(inst.label_names, key)] = value
+        return flat
+
+    def emit_jsonl(self, logger, event: str = "metrics_snapshot") -> None:
+        """One JSONL event holding the flat snapshot — the MetricsLogger
+        backend (the registry absorbs it as a sink rather than
+        replacing its schema)."""
+        logger.emit(event, time_unix=time.time(), metrics=self.snapshot())
+
+
+_default = MetricRegistry()
+_default_lock = threading.Lock()
+
+
+def get_registry() -> MetricRegistry:
+    """The process-wide default registry."""
+    return _default
+
+
+def reset_registry() -> MetricRegistry:
+    """Swap in a fresh default (test isolation)."""
+    global _default
+    with _default_lock:
+        _default = MetricRegistry()
+    return _default

@@ -1,0 +1,78 @@
+"""Runtime-state gauges: mesh topology + heartbeat liveness.
+
+Surfaces what :mod:`runtime.mesh` and :mod:`runtime.failure` already
+know into the metric registry, so one Prometheus scrape (or one JSONL
+snapshot) answers "what shape is this job and is everyone alive"
+without grepping logs:
+
+- ``mesh_axis_size{axis=...}``, ``mesh_devices``, ``process_count``,
+  ``slice_count`` — set once at trainer construction;
+- worker side: ``heartbeat_age_seconds``, ``heartbeat_beats_total``,
+  ``heartbeat_suppressed_total`` from the live
+  :class:`runtime.failure.HeartbeatReporter` (no-ops outside the
+  elastic agent);
+- supervisor side: ``worker_heartbeat_age_seconds{rank=...}`` and
+  ``worker_missed_beats_total{rank=...}`` from a
+  :class:`runtime.failure.FailureDetector`.
+"""
+
+from __future__ import annotations
+
+from pytorch_distributed_nn_tpu.obs.registry import (
+    MetricRegistry,
+    get_registry,
+)
+
+
+def export_mesh_gauges(mesh, registry: MetricRegistry | None = None) -> None:
+    """Topology gauges from a built ``jax.sharding.Mesh``."""
+    import jax
+
+    from pytorch_distributed_nn_tpu.runtime.mesh import slice_count
+
+    reg = registry or get_registry()
+    axis = reg.gauge("mesh_axis_size", "logical mesh axis degree",
+                     labels=("axis",))
+    for name, size in dict(mesh.shape).items():
+        axis.set(size, axis=name)
+    devs = list(mesh.devices.flat)
+    reg.gauge("mesh_devices", "devices in the mesh").set(len(devs))
+    reg.gauge("process_count", "jax process count").set(
+        jax.process_count())
+    reg.gauge("slice_count", "DCN-connected TPU slices").set(
+        slice_count(devs))
+
+
+def update_heartbeat_gauges(registry: MetricRegistry | None = None) -> None:
+    """Worker-side heartbeat state (no-op when not under the agent)."""
+    from pytorch_distributed_nn_tpu.runtime import failure
+
+    stats = failure.heartbeat_stats()
+    if stats is None:
+        return
+    reg = registry or get_registry()
+    reg.gauge("heartbeat_age_seconds",
+              "seconds since this worker's last store beat").set(
+        stats["age_s"])
+    reg.gauge("heartbeat_beats_total",
+              "beats written by this worker").set(stats["beats"])
+    reg.gauge("heartbeat_suppressed_total",
+              "beats withheld by the progress watchdog").set(
+        stats["suppressed"])
+
+
+def export_detector_gauges(detector,
+                           registry: MetricRegistry | None = None) -> None:
+    """Supervisor-side per-rank staleness gauges from a
+    :class:`runtime.failure.FailureDetector`."""
+    reg = registry or get_registry()
+    age = reg.gauge("worker_heartbeat_age_seconds",
+                    "seconds since each rank's last beat (-1 = never)",
+                    labels=("rank",))
+    missed = reg.gauge("worker_missed_beats_total",
+                       "times each rank has been reported stale",
+                       labels=("rank",))
+    for rank, a in detector.last_beat_ages().items():
+        age.set(-1.0 if a is None else a, rank=rank)
+    for rank, n in detector.missed_counts.items():
+        missed.set(n, rank=rank)

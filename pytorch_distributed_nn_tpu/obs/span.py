@@ -1,0 +1,200 @@
+"""Span tracing: Chrome trace-event JSON per host.
+
+``with obs.span("data/next_batch"): ...`` marks a host-side phase. When
+tracing is disabled (the default) a span costs one module-global read
+and yields a shared null context — no allocation, no clock read — so
+instrumentation can stay in the hot loop permanently.
+
+Enabled (:func:`enable_tracing`), spans record complete events
+(``ph: "X"``, microsecond ``ts``/``dur``) into an in-memory buffer that
+:func:`write_trace` serializes as Chrome trace-event JSON — the same
+format ``jax.profiler``'s ``perfetto_trace.json.gz`` uses, so
+:func:`merge_chrome_traces` can splice host spans and device slices
+into one timeline (chrome://tracing / Perfetto both open it).
+
+Thread-safe: producer threads (data prefetch) trace under the same
+recorder; ``tid`` keeps their tracks apart.
+"""
+
+from __future__ import annotations
+
+import gzip
+import json
+import os
+import threading
+import time
+from pathlib import Path
+
+
+class _NullSpan:
+    """Reusable disabled-tracing context (one instance, no state)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullSpan()
+
+
+class TraceRecorder:
+    """In-memory trace-event buffer for one process."""
+
+    def __init__(self, *, process_index: int = 0,
+                 process_name: str | None = None) -> None:
+        self.process_index = process_index
+        self.process_name = process_name or f"host{process_index}"
+        self._lock = threading.Lock()
+        self._events: list[dict] = []
+        self._t0 = time.perf_counter()
+        # wall-clock anchor so merged traces share an epoch
+        self.epoch_unix = time.time()
+
+    def _now_us(self) -> float:
+        return (time.perf_counter() - self._t0) * 1e6
+
+    def add_event(self, name: str, ts_us: float, dur_us: float,
+                  cat: str = "app", args: dict | None = None) -> None:
+        ev = {"name": name, "cat": cat, "ph": "X",
+              "ts": ts_us, "dur": dur_us,
+              "pid": self.process_index,
+              "tid": threading.get_ident() & 0xFFFF}
+        if args:
+            ev["args"] = args
+        with self._lock:
+            self._events.append(ev)
+
+    def instant(self, name: str, cat: str = "app",
+                args: dict | None = None) -> None:
+        ev = {"name": name, "cat": cat, "ph": "i", "s": "t",
+              "ts": self._now_us(), "pid": self.process_index,
+              "tid": threading.get_ident() & 0xFFFF}
+        if args:
+            ev["args"] = args
+        with self._lock:
+            self._events.append(ev)
+
+    def events(self) -> list[dict]:
+        with self._lock:
+            return list(self._events)
+
+    def trace_json(self) -> dict:
+        meta = [{"name": "process_name", "ph": "M",
+                 "pid": self.process_index,
+                 "args": {"name": self.process_name}}]
+        return {"traceEvents": meta + self.events(),
+                "displayTimeUnit": "ms",
+                "otherData": {"epoch_unix": self.epoch_unix}}
+
+
+class _Span:
+    __slots__ = ("_rec", "_name", "_cat", "_args", "_t0")
+
+    def __init__(self, rec: TraceRecorder, name: str, cat: str,
+                 args: dict | None) -> None:
+        self._rec = rec
+        self._name = name
+        self._cat = cat
+        self._args = args
+
+    def __enter__(self):
+        self._t0 = self._rec._now_us()
+        return self
+
+    def __exit__(self, *exc):
+        t1 = self._rec._now_us()
+        self._rec.add_event(self._name, self._t0, t1 - self._t0,
+                            self._cat, self._args)
+        return False
+
+
+_recorder: TraceRecorder | None = None
+
+
+def span(name: str, cat: str = "app", **args):
+    """Context manager marking a host-side phase. Free when disabled."""
+    rec = _recorder
+    if rec is None:
+        return _NULL
+    return _Span(rec, name, cat, args or None)
+
+
+def tracing_enabled() -> bool:
+    return _recorder is not None
+
+
+def enable_tracing(*, process_index: int | None = None) -> TraceRecorder:
+    """Start recording spans (idempotent: returns the live recorder).
+
+    ``process_index`` defaults to ``jax.process_index()`` when jax is
+    already imported, else 0 — span.py itself never imports jax (spans
+    must stay usable before/without a backend).
+    """
+    global _recorder
+    if _recorder is not None:
+        return _recorder
+    if process_index is None:
+        import sys
+
+        jax = sys.modules.get("jax")
+        process_index = jax.process_index() if jax is not None else 0
+    _recorder = TraceRecorder(process_index=process_index)
+    return _recorder
+
+
+def disable_tracing() -> TraceRecorder | None:
+    """Stop recording; returns the recorder (with its buffered events)."""
+    global _recorder
+    rec = _recorder
+    _recorder = None
+    return rec
+
+
+def write_trace(path, recorder: TraceRecorder | None = None) -> Path:
+    """Serialize the recorder (default: the live one) as Chrome
+    trace-event JSON; ``.gz`` suffix gzips — matching the xprof
+    ``perfetto_trace.json.gz`` convention."""
+    rec = recorder if recorder is not None else _recorder
+    if rec is None:
+        raise RuntimeError("tracing is not enabled and no recorder given")
+    p = Path(path)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    payload = json.dumps(rec.trace_json())
+    if p.suffix == ".gz":
+        with gzip.open(p, "wt") as f:
+            f.write(payload)
+    else:
+        p.write_text(payload)
+    return p
+
+
+def _load_trace(path) -> list[dict]:
+    p = Path(path)
+    opener = gzip.open if p.suffix == ".gz" else open
+    with opener(p, "rt") as f:
+        tr = json.load(f)
+    return tr["traceEvents"] if isinstance(tr, dict) else tr
+
+
+def merge_chrome_traces(paths, out) -> Path:
+    """Concatenate trace-event files (host spans + xprof perfetto device
+    slices) into one Chrome trace. Each input keeps its own pid tracks;
+    offset alignment is the viewer's job (both sides stamp relative
+    timestamps) — the merged file is for eyeballing phase overlap, not
+    sub-ms cross-clock skew."""
+    events: list[dict] = []
+    for path in paths:
+        events.extend(_load_trace(path))
+    p = Path(out)
+    p.parent.mkdir(parents=True, exist_ok=True)
+    payload = json.dumps({"traceEvents": events, "displayTimeUnit": "ms"})
+    if p.suffix == ".gz":
+        with gzip.open(p, "wt") as f:
+            f.write(payload)
+    else:
+        p.write_text(payload)
+    return p

@@ -71,9 +71,16 @@ class HeartbeatReporter:
                  incarnation: int = 0, interval_s: float = 1.0,
                  progress_window_s: float | None = None) -> None:
         self._client = client
+        self.rank = rank
+        self.incarnation = incarnation
         self._key = _hb_key(incarnation, rank)
         self._interval = interval_s
         self._window = progress_window_s
+        # observability counters (obs/runtime_gauges.py reads these):
+        # beats written, beats withheld by the watchdog, last beat time
+        self._beats = 0
+        self._suppressed = 0
+        self._last_beat: float | None = None
         # None until the first notify_progress: the watchdog only arms
         # once a step has completed, so an arbitrarily long first-step
         # trace+compile can't read as a hang and livelock the restarts
@@ -86,8 +93,28 @@ class HeartbeatReporter:
         self.beat()  # one synchronous beat so the detector sees us at once
         self._thread.start()
 
+    @property
+    def client(self) -> native.StoreClient:
+        """The live store connection (obs/aggregate.py publishes
+        snapshots through it — same handle, thread-safe)."""
+        return self._client
+
     def beat(self) -> None:
-        self._client.set(self._key, repr(time.time()).encode())
+        now = time.time()
+        self._client.set(self._key, repr(now).encode())
+        self._beats += 1
+        self._last_beat = now
+
+    def stats(self) -> dict:
+        """Liveness counters for the metric registry: seconds since the
+        last beat, beats written, watchdog-suppressed beats."""
+        now = time.time()
+        return {
+            "age_s": (now - self._last_beat
+                      if self._last_beat is not None else -1.0),
+            "beats": self._beats,
+            "suppressed": self._suppressed,
+        }
 
     def notify_progress(self) -> None:
         """Application-level liveness: the step loop moved forward."""
@@ -104,6 +131,7 @@ class HeartbeatReporter:
             if (self._window is not None
                     and self._last_progress is not None
                     and time.time() - self._last_progress > self._window):
+                self._suppressed += 1
                 continue  # main thread looks stuck: go silent, get flagged
             try:
                 self.beat()
@@ -159,6 +187,16 @@ def maybe_start_heartbeat(rank: int | None = None) -> HeartbeatReporter | None:
     return _reporter
 
 
+def reporter() -> HeartbeatReporter | None:
+    """The live worker-side reporter, if the agent started one."""
+    return _reporter
+
+
+def heartbeat_stats() -> dict | None:
+    """This worker's liveness counters; None outside the agent."""
+    return _reporter.stats() if _reporter is not None else None
+
+
 def notify_progress() -> None:
     """Per-step hook for training loops; no-op outside the agent."""
     if _reporter is not None:
@@ -189,6 +227,24 @@ class FailureDetector:
         self._incarnation = incarnation
         self._timeout = timeout_s
         self._first_seen: dict[int, float] = {}
+        # rank -> number of times it has been reported stale (the
+        # supervisor-side missed-beat gauge, obs/runtime_gauges.py)
+        self.missed_counts: dict[int, int] = {r: 0 for r in self._ranks}
+
+    def last_beat_ages(self) -> dict[int, float | None]:
+        """Per-rank seconds since the last beat (None = never beaten) —
+        the raw staleness signal behind :meth:`stale_ranks`, exported
+        as gauges by obs/runtime_gauges.export_detector_gauges."""
+        now = time.time()
+        ages: dict[int, float | None] = {}
+        for rank in self._ranks:
+            key = _hb_key(self._incarnation, rank)
+            if self._client.check(key):
+                ages[rank] = now - float(
+                    self._client.get(key, timeout_ms=1000))
+            else:
+                ages[rank] = None
+        return ages
 
     def stale_ranks(self, alive: set[int] | None = None) -> list[int]:
         """Ranks whose heartbeat is older than the timeout.
@@ -214,4 +270,6 @@ class FailureDetector:
                 first = self._first_seen.setdefault(rank, now)
                 if now - first > self._timeout:
                     stale.append(rank)
+        for rank in stale:
+            self.missed_counts[rank] = self.missed_counts.get(rank, 0) + 1
         return stale

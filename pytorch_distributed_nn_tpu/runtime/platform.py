@@ -21,4 +21,12 @@ def apply_platform_overrides() -> None:
         jax.config.update("jax_platforms", platforms)
     n_cpu = os.environ.get("JAX_NUM_CPU_DEVICES")
     if n_cpu:
-        jax.config.update("jax_num_cpu_devices", int(n_cpu))
+        try:
+            jax.config.update("jax_num_cpu_devices", int(n_cpu))
+        except AttributeError:
+            # older jax: no such option; the XLA flag is equivalent and
+            # read at backend init (which hasn't happened yet here)
+            os.environ["XLA_FLAGS"] = (
+                os.environ.get("XLA_FLAGS", "")
+                + f" --xla_force_host_platform_device_count={int(n_cpu)}"
+            )

@@ -32,6 +32,7 @@ import jax
 import numpy as np
 import orbax.checkpoint as ocp
 
+from pytorch_distributed_nn_tpu import obs
 from pytorch_distributed_nn_tpu.train.state import TrainState
 
 log = logging.getLogger(__name__)
@@ -67,15 +68,20 @@ class CheckpointManager:
         meta = {"data_step": int(data_step), "step": step}
         if extra_meta:
             meta.update(extra_meta)
-        saved = self._mgr.save(
-            step,
-            args=ocp.args.Composite(**{
-                _ARRAYS: ocp.args.StandardSave(_array_tree(state)),
-                _META: ocp.args.JsonSave(meta),
-            }),
-            force=force,
-        )
+        # span covers only the host-side queueing (async save): the
+        # background write shows up in `wait`/`close` spans instead
+        with obs.span("checkpoint/save", step=step):
+            saved = self._mgr.save(
+                step,
+                args=ocp.args.Composite(**{
+                    _ARRAYS: ocp.args.StandardSave(_array_tree(state)),
+                    _META: ocp.args.JsonSave(meta),
+                }),
+                force=force,
+            )
         if saved:
+            obs.get_registry().counter(
+                "checkpoint_saves_total", "checkpoint saves queued").inc()
             log.info("queued checkpoint save at step %d -> %s", step,
                      self.directory)
         return saved
@@ -100,24 +106,29 @@ class CheckpointManager:
             if isinstance(x, jax.Array) else x,
             _array_tree(template),
         )
-        restored = self._mgr.restore(
-            step,
-            args=ocp.args.Composite(**{
-                _ARRAYS: ocp.args.StandardRestore(abstract),
-                _META: ocp.args.JsonRestore(),
-            }),
-        )
+        with obs.span("checkpoint/restore", step=step):
+            restored = self._mgr.restore(
+                step,
+                args=ocp.args.Composite(**{
+                    _ARRAYS: ocp.args.StandardRestore(abstract),
+                    _META: ocp.args.JsonRestore(),
+                }),
+            )
+        obs.get_registry().counter(
+            "checkpoint_restores_total", "checkpoint restores").inc()
         state = _merge_array_tree(template, restored[_ARRAYS])
         return state, dict(restored[_META])
 
     # -- lifecycle -------------------------------------------------------
 
     def wait(self) -> None:
-        self._mgr.wait_until_finished()
+        with obs.span("checkpoint/wait"):
+            self._mgr.wait_until_finished()
 
     def close(self) -> None:
-        self._mgr.wait_until_finished()
-        self._mgr.close()
+        with obs.span("checkpoint/drain"):
+            self._mgr.wait_until_finished()
+            self._mgr.close()
 
     def all_steps(self) -> list[int]:
         return sorted(self._mgr.all_steps())

@@ -16,9 +16,13 @@ from typing import Any
 import jax
 import numpy as np
 
+from pytorch_distributed_nn_tpu import obs
 from pytorch_distributed_nn_tpu.config import TrainConfig
 from pytorch_distributed_nn_tpu.data import DataLoader, get_dataset
 from pytorch_distributed_nn_tpu.models import get_model
+from pytorch_distributed_nn_tpu.obs import aggregate as obs_aggregate
+from pytorch_distributed_nn_tpu.obs import runtime_gauges
+from pytorch_distributed_nn_tpu.ops import collectives as cc
 from pytorch_distributed_nn_tpu.runtime import failure
 from pytorch_distributed_nn_tpu.parallel import make_train_step
 from pytorch_distributed_nn_tpu.runtime.mesh import make_mesh
@@ -98,6 +102,18 @@ class Trainer:
         self._eval_step = None  # built lazily on first evaluate()
         self._eval_batches: dict[int, tuple] = {}  # device-resident cache
         self.data_step = 0  # next dataset step to consume (resume-aware)
+        # unified telemetry (obs/): goodput meter + registry instruments
+        # feeding the JSONL stream and the Prometheus exposition
+        self.goodput = obs.GoodputMeter()
+        _reg = obs.get_registry()
+        self._c_steps = _reg.counter(
+            "train_steps_total", "optimizer steps completed")
+        self._c_samples = _reg.counter(
+            "train_samples_total", "training samples consumed")
+        self._g_loss = _reg.gauge("train_loss", "last logged train loss")
+        self._h_step = _reg.histogram(
+            "train_step_seconds", "wall time per step window")
+        runtime_gauges.export_mesh_gauges(self.mesh, _reg)
         self.metrics = None
         if cfg.metrics_path:
             from pytorch_distributed_nn_tpu.utils.metrics import (
@@ -106,17 +122,34 @@ class Trainer:
 
             self.metrics = MetricsLogger(cfg.metrics_path)
         self.ckpt = None
-        if cfg.checkpoint_dir:
-            from pytorch_distributed_nn_tpu.train.checkpoint import (
-                CheckpointManager,
-            )
+        try:
+            if cfg.checkpoint_dir:
+                from pytorch_distributed_nn_tpu.train.checkpoint import (
+                    CheckpointManager,
+                )
 
-            self.ckpt = CheckpointManager(cfg.checkpoint_dir)
-            if cfg.resume and self.ckpt.latest_step() is not None:
-                self.state, meta = self.ckpt.restore(self.state)
-                self.data_step = meta["data_step"]
-                log.info("resumed from step %d (data_step %d)",
-                         meta["step"], self.data_step)
+                self.ckpt = CheckpointManager(cfg.checkpoint_dir)
+                if cfg.resume and self.ckpt.latest_step() is not None:
+                    with self.goodput.phase("checkpoint"):
+                        self.state, meta = self.ckpt.restore(self.state)
+                    self.data_step = meta["data_step"]
+                    log.info("resumed from step %d (data_step %d)",
+                             meta["step"], self.data_step)
+        except Exception:
+            # a failed restore must not leak the metrics file handle
+            # (MetricsLogger is a context manager; Trainer mirrors it)
+            if self.metrics is not None:
+                self.metrics.close()
+            raise
+
+    # context manager: `with Trainer(cfg) as t:` closes the metrics
+    # JSONL handle and drains async checkpoint writes on ANY exit path
+    def __enter__(self) -> "Trainer":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
 
     def _with_mesh(self, fn):
         """Run ``fn`` with this trainer's mesh as the ambient mesh (the
@@ -178,14 +211,32 @@ class Trainer:
 
     def _train_loop(self, it, steps: int) -> list[StepRecord]:
         cfg = self.cfg
+        gp = self.goodput
         t_last = time.perf_counter()
         g_last = self.data_step  # step count behind each logged record
         for i in range(steps):
-            x, y = next(it)
+            gp.step_start()
+            with gp.phase("data"):
+                x, y = next(it)
             self.data_step += 1
             g = self.data_step  # 1-based global step just dispatched
-            self.state, metrics = self.step_fn(self.state, x, y)
+            if i == 0 and gp.wire_bytes_per_step is None:
+                # trace-time collective accounting rides the first
+                # dispatch (the call that traces step_fn): recorded
+                # wire bytes are the goodput breakdown's cross-check
+                # for the collective share
+                with cc.recording() as comm_records:
+                    with gp.phase("compute"):
+                        self.state, metrics = self.step_fn(self.state,
+                                                           x, y)
+                if comm_records:
+                    gp.wire_bytes_per_step = cc.wire_bytes(comm_records)
+            else:
+                with gp.phase("compute"):
+                    self.state, metrics = self.step_fn(self.state, x, y)
             self.last_metrics = metrics
+            self._c_steps.inc()
+            self._c_samples.inc(cfg.data.batch_size)
             # Progress watchdog food (launch.py --progress-timeout).
             # Dispatch is async, but a hung device op stalls this loop
             # within a few iterations via dispatch-queue backpressure,
@@ -193,17 +244,25 @@ class Trainer:
             failure.notify_progress()
             if (self.ckpt is not None and cfg.checkpoint_every
                     and g % cfg.checkpoint_every == 0):
-                self.ckpt.save(self.state, data_step=self.data_step)
+                with gp.phase("checkpoint"):
+                    self.ckpt.save(self.state, data_step=self.data_step)
             if cfg.eval_every and g % cfg.eval_every == 0:
-                self.evaluate()
-            if cfg.log_every and ((g - 1) % cfg.log_every == 0
-                                  or i == steps - 1):
-                loss = float(jax.device_get(metrics["loss"]))
+                with gp.phase("eval"):
+                    self.evaluate()
+            logged = cfg.log_every and ((g - 1) % cfg.log_every == 0
+                                        or i == steps - 1)
+            if logged:
+                # the device_get is the loop's execution fence: device
+                # time queued behind async dispatch surfaces here, so
+                # it counts as compute, not "other"
+                with gp.phase("compute"):
+                    loss = float(jax.device_get(metrics["loss"]))
                 now = time.perf_counter()
                 rec = StepRecord(step=g - 1, loss=loss,
                                  seconds=now - t_last)
                 t_last = now
                 self.history.append(rec)
+                self._g_loss.set(loss)
                 if self.metrics is not None:
                     covered = g - g_last  # actual steps in this record
                     self.metrics.emit(
@@ -217,12 +276,32 @@ class Trainer:
                 if jax.process_index() == 0:
                     log.info("step %d loss %.4f (%.3fs)", g - 1, loss,
                              rec.seconds)
+            bd = gp.step_end(step=g - 1)
+            self._h_step.observe(bd.wall_s)
+            if logged:
+                self._flush_telemetry(step=g - 1)
         # sync before returning so wall-clock timings are honest
         jax.block_until_ready(self.state.params)
         # Post-loop work (checkpoint drain, eval) is unbounded: back to
         # liveness-only heartbeats so it can't read as a hang.
         failure.notify_done()
         return self.history
+
+    def _flush_telemetry(self, step: int) -> None:
+        """Log-cadence telemetry fanout: goodput window -> JSONL,
+        heartbeat/runtime gauges refreshed, registry snapshot to the
+        Prometheus textfile and (under the agent) the native store."""
+        win = self.goodput.window_summary()
+        if self.metrics is not None:
+            self.metrics.emit("goodput", step=step, **win)
+        runtime_gauges.update_heartbeat_gauges()
+        reg = obs.get_registry()
+        gp_gauge = reg.gauge("goodput_frac",
+                             "compute+collective share of wall time")
+        gp_gauge.set(win["goodput_frac"])
+        if self.cfg.prom_path:
+            reg.write_prometheus(self.cfg.prom_path)
+        obs_aggregate.maybe_publish(reg)
 
     def _get_multistep(self, k: int):
         """Compiled k-fused step, cached per k (the final dispatch of a
@@ -284,30 +363,39 @@ class Trainer:
     def _multistep_loop(self, batches, pool, xs_pool, ys_pool, k,
                         steps, t_last, g_last):
         cfg = self.cfg
+        gp = self.goodput
         remaining = steps
         while remaining > 0:
             k_eff = min(k, remaining)
-            if pool:
-                xs, ys = xs_pool, ys_pool
-                if jax.tree.leaves(xs)[0].shape[0] > k_eff:
-                    xs = jax.tree.map(lambda a: a[:k_eff], xs)
-                    ys = jax.tree.map(lambda a: a[:k_eff], ys)
-            else:
-                xs, ys = next(batches)
-            self.state, metrics = self._get_multistep(k_eff)(
-                self.state, xs, ys)
+            gp.step_start()
+            with gp.phase("data"):
+                if pool:
+                    xs, ys = xs_pool, ys_pool
+                    if jax.tree.leaves(xs)[0].shape[0] > k_eff:
+                        xs = jax.tree.map(lambda a: a[:k_eff], xs)
+                        ys = jax.tree.map(lambda a: a[:k_eff], ys)
+                else:
+                    xs, ys = next(batches)
+            with gp.phase("compute"):
+                self.state, metrics = self._get_multistep(k_eff)(
+                    self.state, xs, ys)
             self.data_step += k_eff
             remaining -= k_eff
             g = self.data_step  # 1-based step count after this window
             self.last_metrics = metrics
+            self._c_steps.inc(k_eff)
+            self._c_samples.inc(k_eff * cfg.data.batch_size)
             failure.notify_progress()
             if (self.ckpt is not None and cfg.checkpoint_every
                     and g // cfg.checkpoint_every
                     > (g - k_eff) // cfg.checkpoint_every):
-                self.ckpt.save(self.state, data_step=self.data_step)
+                with gp.phase("checkpoint"):
+                    self.ckpt.save(self.state, data_step=self.data_step)
             if (cfg.eval_every and g // cfg.eval_every
                     > (g - k_eff) // cfg.eval_every):
-                self.evaluate()
+                with gp.phase("eval"):
+                    self.evaluate()
+            logged = []
             if cfg.log_every:
                 # per-step losses from the scan's stacked metrics: one
                 # (k_eff,) fetch covers every logged step in the window
@@ -315,8 +403,9 @@ class Trainer:
                           if (s - 1) % cfg.log_every == 0
                           or (remaining == 0 and s == g)]
                 if logged:
-                    losses = np.asarray(jax.device_get(
-                        metrics["all"]["loss"]), np.float32)
+                    with gp.phase("compute"):  # fence: device catches up
+                        losses = np.asarray(jax.device_get(
+                            metrics["all"]["loss"]), np.float32)
                     now = time.perf_counter()
                     window_dt = now - t_last
                     window_span = max(g - g_last, 1)  # steps since last
@@ -342,6 +431,11 @@ class Trainer:
                             log.info("step %d loss %.4f (%.3fs)",
                                      rec.step, rec.loss, rec.seconds)
                     t_last = now
+                    self._g_loss.set(float(losses[-1]))
+            bd = gp.step_end(step=g - 1, steps_covered=k_eff)
+            self._h_step.observe(bd.wall_s)
+            if logged:
+                self._flush_telemetry(step=g - 1)
         # execution fence: ONE scalar device_get of the final fused
         # loss (which depends on every prior step). block_until_ready
         # here would issue one sync RPC per param leaf — measured
@@ -420,18 +514,20 @@ class Trainer:
         if self._eval_step is None:
             self._build_eval()
         losses, accs = [], []
-        for i in range(n):
-            if i not in self._eval_batches:
-                # the stream is deterministic, so each batch is generated
-                # and transferred once and reused by every eval pass
-                self._eval_batches[i] = self.loader.batch_at(
-                    _EVAL_STEP_OFFSET + i
-                )
-            x, y = self._eval_batches[i]
-            loss, acc = self._eval_step(self.state, x, y)
-            losses.append(float(jax.device_get(loss)))
-            accs.append(float(jax.device_get(acc)))
-            failure.notify_progress()  # eval batches are progress too
+        with obs.span("train/eval", batches=n):
+            for i in range(n):
+                if i not in self._eval_batches:
+                    # the stream is deterministic, so each batch is
+                    # generated and transferred once and reused by
+                    # every eval pass
+                    self._eval_batches[i] = self.loader.batch_at(
+                        _EVAL_STEP_OFFSET + i
+                    )
+                x, y = self._eval_batches[i]
+                loss, acc = self._eval_step(self.state, x, y)
+                losses.append(float(jax.device_get(loss)))
+                accs.append(float(jax.device_get(acc)))
+                failure.notify_progress()  # eval batches are progress
         rec = EvalRecord(step=self.data_step - 1,
                          loss=float(np.mean(losses)),
                          accuracy=float(np.mean(accs)))
@@ -454,7 +550,13 @@ class Trainer:
         if self.ckpt is not None:
             self.ckpt.close()
         if self.metrics is not None:
+            if self.goodput.steps:
+                # whole-run breakdown as the stream's closing record
+                self.metrics.emit("goodput_summary",
+                                  **self.goodput.summary())
             self.metrics.close()
+        if self.cfg.prom_path:
+            obs.get_registry().write_prometheus(self.cfg.prom_path)
 
     def losses(self) -> list[float]:
         return [r.loss for r in self.history]

@@ -66,3 +66,15 @@ class MetricsLogger:
         if self._fh is not None and self._fh not in (sys.stdout,
                                                      sys.stderr):
             self._fh.close()
+            self._fh = None  # idempotent: double-close is a no-op
+            self.enabled = False  # emit after close: silent no-op
+
+    # context manager: `with MetricsLogger(path) as m:` guarantees the
+    # file handle closes on exceptions (Trainer rides this via its own
+    # __enter__/__exit__)
+    def __enter__(self) -> "MetricsLogger":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False

@@ -134,6 +134,11 @@ class StepTimer:
         return dt
 
     def summary(self) -> dict[str, float]:
+        if not self.times:
+            # an unstarted/empty timer must summarize, not crash
+            # (np.percentile([]) raises): zeros, steps=0
+            return {"steps": 0, "mean_s": 0.0, "p50_s": 0.0,
+                    "p95_s": 0.0, "total_s": 0.0}
         ts = np.array(self.times)
         return {
             "steps": len(ts),

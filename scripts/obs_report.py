@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Render the obs telemetry stream: step-time goodput breakdown + comms.
+
+Reads the JSONL metrics file a training run wrote
+(``TrainConfig.metrics_path`` — ``train_step`` / ``goodput`` /
+``goodput_summary`` / ``eval`` events) and prints:
+
+- the per-phase goodput table (seconds and share of wall, per logged
+  window and whole-run);
+- the comms cross-check: recorded wire bytes per step
+  (ops/collectives.CommRecorder, carried in the goodput events) against
+  trace-derived collective seconds when an xprof trace dir is given
+  (``--trace``), yielding implied bus bandwidth;
+- the train/eval metric tail.
+
+Usage:
+    python scripts/obs_report.py runs/metrics.jsonl [--trace runs/xprof]
+        [--last N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+sys.path.insert(0, ".")  # run from repo root without install
+
+PHASES = ("data", "compute", "collective", "checkpoint", "eval", "other")
+
+
+def load_events(path: str) -> list[dict]:
+    events = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except json.JSONDecodeError:
+                continue  # tolerate a torn tail line from a killed run
+    return events
+
+
+def _fmt_s(v: float) -> str:
+    return f"{v:10.4f}"
+
+
+def _fmt_pct(v: float) -> str:
+    return f"{100.0 * v:6.1f}%"
+
+
+def print_goodput_table(events: list[dict], last: int) -> bool:
+    windows = [e for e in events if e.get("event") == "goodput"]
+    summary = next((e for e in events
+                    if e.get("event") == "goodput_summary"), None)
+    if not windows and summary is None:
+        print("no goodput events found (run with cfg.metrics_path set)")
+        return False
+    header = (f"{'window@step':>12} {'steps':>5} {'wall_s':>10} "
+              + " ".join(f"{p:>10}" for p in PHASES)
+              + f" {'acct':>7}")
+    print("== goodput breakdown (seconds; share of wall below) ==")
+    print(header)
+    for e in windows[-last:]:
+        wall = e.get("wall_s", 0.0)
+        row = (f"{e.get('step', -1):>12} {e.get('steps', 1):>5} "
+               + _fmt_s(wall) + " "
+               + " ".join(_fmt_s(e.get(f'{p}_s', 0.0)) for p in PHASES)
+               + f" {_fmt_pct(e.get('accounted_frac', 0.0)):>7}")
+        print(row)
+        if wall > 0:
+            print(f"{'':>12} {'':>5} {'':>10} "
+                  + " ".join(
+                      f"{_fmt_pct(e.get(f'{p}_s', 0.0) / wall):>10}"
+                      for p in PHASES))
+    if summary is not None:
+        wall = summary.get("wall_s", 0.0)
+        print("-- whole run --")
+        print(f"{'total':>12} {summary.get('steps', 0):>5} "
+              + _fmt_s(wall) + " "
+              + " ".join(_fmt_s(summary.get(f'{p}_s', 0.0))
+                         for p in PHASES)
+              + f" {_fmt_pct(summary.get('accounted_frac', 0.0)):>7}")
+        if wall > 0:
+            print(f"{'':>12} {'':>5} {'':>10} "
+                  + " ".join(
+                      f"{_fmt_pct(summary.get(f'{p}_s', 0.0) / wall):>10}"
+                      for p in PHASES))
+        print(f"goodput (compute+collective share of wall): "
+              f"{_fmt_pct(summary.get('goodput_frac', 0.0)).strip()}")
+    return True
+
+
+def print_comms_table(events: list[dict], trace_dir: str | None) -> None:
+    wire = None
+    for e in events:
+        if e.get("event") in ("goodput", "goodput_summary"):
+            wire = e.get("wire_bytes_per_step", wire)
+    summary = next((e for e in events
+                    if e.get("event") == "goodput_summary"), None)
+    if wire is None and trace_dir is None:
+        return
+    print("\n== comms ==")
+    if wire is not None:
+        print(f"recorded wire bytes/step (ring accounting): "
+              f"{wire / 1e6:.3f} MB")
+    ct = None
+    if trace_dir:
+        from pytorch_distributed_nn_tpu.utils.profiling import (
+            collective_trace_seconds,
+        )
+
+        import jax
+
+        world = len(jax.devices())
+        ct = collective_trace_seconds(trace_dir, world=world)
+        if ct is None:
+            print(f"no collective slices found under {trace_dir}")
+        else:
+            print(f"trace-derived collective time: {ct.total_s:.4f}s "
+                  f"total / {ct.per_device_s:.4f}s per device "
+                  f"({ct.n_events} events)")
+            for name, secs in sorted(ct.names.items(),
+                                     key=lambda kv: -kv[1])[:8]:
+                print(f"    {name:<40} {secs:.4f}s")
+    if wire is not None and ct is not None and summary is not None:
+        steps = max(summary.get("steps", 1), 1)
+        coll_s = ct.per_device_s / steps
+        if coll_s > 0:
+            print(f"implied bus bandwidth (wire/step ÷ collective "
+                  f"s/step): {wire / coll_s / 1e9:.3f} GB/s")
+
+
+def print_metric_tail(events: list[dict], last: int) -> None:
+    steps = [e for e in events if e.get("event") == "train_step"]
+    evals = [e for e in events if e.get("event") == "eval"]
+    if steps:
+        print("\n== train tail ==")
+        for e in steps[-last:]:
+            print(f"step {e.get('step'):>6}  loss {e.get('loss'):.4f}  "
+                  f"{e.get('samples_per_sec', 0.0):>10.1f} samples/s")
+    if evals:
+        print("== eval tail ==")
+        for e in evals[-last:]:
+            print(f"step {e.get('step'):>6}  loss {e.get('loss'):.4f}  "
+                  f"acc {e.get('accuracy'):.4f}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("jsonl", help="metrics JSONL path "
+                                  "(TrainConfig.metrics_path)")
+    ap.add_argument("--trace", default="",
+                    help="xprof trace dir (perfetto_trace.json.gz) for "
+                         "the trace-derived collective cross-check")
+    ap.add_argument("--last", type=int, default=5,
+                    help="windows/rows to show per table")
+    args = ap.parse_args(argv)
+    events = load_events(args.jsonl)
+    if not events:
+        print(f"no events in {args.jsonl}")
+        return 1
+    ok = print_goodput_table(events, args.last)
+    print_comms_table(events, args.trace or None)
+    print_metric_tail(events, args.last)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -38,11 +38,10 @@ def main(argv: list[str]) -> int:
     preset = overrides.pop("preset", "mlp_mnist")
     info = bootstrap.initialize()
     cfg = get_config(preset, **overrides)
-    trainer = Trainer(cfg)
-    try:
+    # context manager: closes the metrics JSONL handle and drains async
+    # checkpoint writes even when train() raises
+    with Trainer(cfg) as trainer:
         history = trainer.train()
-    finally:
-        trainer.close()  # drain async checkpoint writes
     if info.is_coordinator and history:
         final = history[-1]
         print(f"final: step={final.step} loss={final.loss:.4f}")

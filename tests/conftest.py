@@ -6,6 +6,8 @@ cluster"): every collective, mesh, and sharding test runs on the host
 platform with 8 virtual devices and never touches the real chip.
 """
 
+import os
+
 import jax
 
 # Force CPU even though the ambient environment selects a TPU platform
@@ -13,9 +15,25 @@ import jax
 # runs, so env vars are too late): jax.config takes effect as long as no
 # backend has been initialized yet.
 jax.config.update("jax_platforms", "cpu")
-jax.config.update("jax_num_cpu_devices", 8)
+try:
+    jax.config.update("jax_num_cpu_devices", 8)
+except AttributeError:
+    # older jax has no jax_num_cpu_devices option; the XLA flag is read
+    # at backend init, which hasn't happened yet (imports don't init)
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
 
 import pytest  # noqa: E402
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavy tests (trace capture, long training) excluded from "
+        "the tier-1 `-m 'not slow'` run",
+    )
 
 
 @pytest.fixture(scope="session")

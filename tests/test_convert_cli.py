@@ -158,6 +158,7 @@ def test_convert_safetensors_and_eps_default(tmp_path):
     assert (ckpt / "0").exists()
 
 
+@pytest.mark.slow  # ~2 min: full resnet50 torch round-trip in subprocs
 def test_convert_resnet50_checkpoint_carries_batch_stats(tmp_path):
     """--arch resnet50: BatchNorm running stats must ride the converted
     checkpoint's model_state, not get silently re-initialized."""

@@ -276,6 +276,7 @@ def test_moe_mixed_stack_under_pipeline(schedule):
     np.testing.assert_allclose(pp, single, rtol=2e-5, atol=1e-5)
 
 
+@pytest.mark.slow  # ~30s: interleaved mixed-stack compile dominates
 def test_moe_mixed_stack_interleaved():
     """Mixed stacks compose with virtual chunks: 8 layers over 2
     devices x 2 chunks, each chunk one (dense, MoE) group; oracle is
@@ -323,6 +324,7 @@ def test_moe_mixed_stack_misaligned_rejected():
                extra=extra, schedule="interleaved", pipe_chunks=2)
 
 
+@pytest.mark.slow  # ~40s each: train+resume+eval-CLI subprocess chain
 @pytest.mark.parametrize("schedule,pipe,chunks",
                          [("1f1b", 4, 1), ("interleaved", 2, 2)])
 def test_pipeline_checkpoint_resume_and_eval_cli(tmp_path, schedule,

@@ -6,10 +6,13 @@ matmuls run the jnp fallback; the on-chip record lands in ONCHIP via
 the bench metric."""
 
 import math
+import re
+from pathlib import Path
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from pytorch_distributed_nn_tpu.config import get_config
 from pytorch_distributed_nn_tpu.models import get_model
@@ -44,6 +47,28 @@ def _trained(steps=60):
     return trainer
 
 
+def test_no_bare_print_in_library_code():
+    """Telemetry flows through the obs registry / MetricsLogger /
+    logging — never bare ``print`` (the reference's `if rank == 0:
+    print(loss)` idiom). Library code only; scripts/ and bench.py are
+    CLIs whose stdout IS their interface and stay exempt."""
+    root = Path(__file__).parent.parent / "pytorch_distributed_nn_tpu"
+    # statement-position print( — string literals mentioning print and
+    # pretty_print-style names don't match
+    bare_print = re.compile(r"^\s*print\(")
+    offenders = []
+    for path in sorted(root.rglob("*.py")):
+        for lineno, line in enumerate(
+                path.read_text().splitlines(), start=1):
+            if bare_print.match(line):
+                offenders.append(f"{path.relative_to(root)}:{lineno}")
+    assert not offenders, (
+        "bare print( in library code (use obs registry / MetricsLogger "
+        f"/ logging instead): {offenders}"
+    )
+
+
+@pytest.mark.slow  # trains a small llama for 60 steps: minutes on CPU
 def test_int8_nll_close_to_bf16_on_trained_model():
     trainer = _trained()
     params_f = jax.device_get(trainer.state.params)

@@ -29,6 +29,14 @@ def test_step_timer_summary():
     assert s["total_s"] >= s["p50_s"]
 
 
+def test_step_timer_empty_summary():
+    """Regression: summary() on an empty timer used to crash in
+    np.percentile([], 50); it must return a zeroed summary instead."""
+    s = StepTimer().summary()
+    assert s == {"steps": 0, "mean_s": 0.0, "p50_s": 0.0,
+                 "p95_s": 0.0, "total_s": 0.0}
+
+
 def test_time_steps_carries_state():
     calls = []
 
@@ -58,6 +66,25 @@ def test_bus_bandwidth_allreduce_accounting(mesh8):
     expected_wire = 2 * (8 - 1) / 8 * 4096
     assert bw.wire_bytes_per_step == expected_wire
     np.testing.assert_allclose(bw.wire_gbps, expected_wire / 1e-3 / 1e9)
+
+
+def test_metrics_logger_context_manager(tmp_path):
+    """MetricsLogger is a context manager: the file handle closes on
+    exception exit (the Trainer leak the `with` form exists to stop),
+    close() is idempotent, and emit-after-close is a silent no-op."""
+    path = tmp_path / "metrics.jsonl"
+    try:
+        with MetricsLogger(path) as m:
+            m.emit("step", loss=1.0)
+            fh = m._fh
+            raise RuntimeError("boom")
+    except RuntimeError:
+        pass
+    assert fh.closed
+    m.close()  # second close: no-op, no error
+    m.emit("after_close", x=1)  # no crash, nothing written
+    lines = path.read_text().splitlines()
+    assert len(lines) == 1 and json.loads(lines[0])["event"] == "step"
 
 
 def test_metrics_logger_jsonl(tmp_path):
@@ -99,6 +126,7 @@ def test_trainer_emits_metrics_jsonl(tmp_path):
     assert {"step", "loss", "accuracy"} <= set(eval_ev)
 
 
+@pytest.mark.slow  # real jax.profiler capture: seconds of trace I/O
 def test_collective_trace_seconds(tmp_path, mesh8):
     """Profile-derived collective time (bench bus-bw cross-check): a
     profiled psum loop must yield collective slices whose summed
@@ -141,3 +169,65 @@ def test_collective_trace_none_when_absent(tmp_path):
     )
 
     assert collective_trace_seconds(str(tmp_path), world=8) is None
+
+
+def _write_perfetto_fixture(tmp_path, events):
+    import gzip
+
+    d = tmp_path / "plugins" / "profile" / "run1"
+    d.mkdir(parents=True)
+    with gzip.open(d / "perfetto_trace.json.gz", "wt") as f:
+        json.dump({"traceEvents": events}, f)
+    return tmp_path
+
+
+def test_collective_trace_slice_filtering(tmp_path):
+    """Synthetic perfetto fixture (no profiler run): `$`-prefixed
+    Python slices and paired `end:` markers are excluded, async
+    start/done pairs both count, non-collective fusions are ignored,
+    and only ph=X complete events contribute."""
+    from pytorch_distributed_nn_tpu.utils.profiling import (
+        collective_trace_seconds,
+    )
+
+    X = {"ph": "X", "ts": 0}
+    events = [
+        # counted: plain collective slices on two device tracks
+        {**X, "name": "all-reduce.3", "dur": 100.0, "pid": 1},
+        {**X, "name": "all-reduce.3", "dur": 100.0, "pid": 2},
+        # counted: async pair — start covers transfer, done the wait
+        {**X, "name": "all-reduce-start.1", "dur": 40.0, "pid": 1},
+        {**X, "name": "all-reduce-done.1", "dur": 10.0, "pid": 1},
+        # counted: XLA:CPU HLO spelling
+        {**X, "name": "psum_invariant.7", "dur": 50.0, "pid": 1},
+        # excluded: python-level slice, paired end marker, plain
+        # fusion, non-X phase, zero-information metadata
+        {**X, "name": "$train.py:42 step", "dur": 999.0, "pid": 1},
+        {**X, "name": "end: all-reduce.3", "dur": 999.0, "pid": 1},
+        {**X, "name": "fusion.1", "dur": 999.0, "pid": 1},
+        {"ph": "M", "name": "all-reduce.metadata"},
+        {"ph": "i", "name": "all-reduce.instant", "ts": 0},
+    ]
+    _write_perfetto_fixture(tmp_path, events)
+    ct = collective_trace_seconds(str(tmp_path), world=2)
+    assert ct is not None
+    assert ct.n_events == 5
+    assert ct.total_s == pytest.approx(300.0 / 1e6)
+    assert ct.per_device_s == pytest.approx(150.0 / 1e6)
+    assert ct.names["all-reduce.3"] == pytest.approx(200.0 / 1e6)
+    assert "$train.py:42 step" not in ct.names
+    assert "end: all-reduce.3" not in ct.names
+
+
+def test_collective_trace_none_when_no_collectives(tmp_path):
+    """A trace with only non-collective slices reports None (the
+    world==1 case: XLA elides the collectives entirely)."""
+    from pytorch_distributed_nn_tpu.utils.profiling import (
+        collective_trace_seconds,
+    )
+
+    _write_perfetto_fixture(tmp_path, [
+        {"ph": "X", "ts": 0, "name": "fusion.9", "dur": 10.0},
+        {"ph": "X", "ts": 0, "name": "$loop.py:1 f", "dur": 10.0},
+    ])
+    assert collective_trace_seconds(str(tmp_path), world=1) is None
