@@ -23,6 +23,7 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
 from pytorch_distributed_nn_tpu import obs
+from pytorch_distributed_nn_tpu.obs import flight
 from pytorch_distributed_nn_tpu.data.datasets import SyntheticDataset
 from pytorch_distributed_nn_tpu.runtime.mesh import AXIS_SEQ, batch_pspec
 
@@ -110,8 +111,12 @@ class DataLoader:
         # prefetch is on it runs on the producer thread, so the trace
         # shows host data work overlapping device compute
         with obs.span("data/host_batch", step=step):
-            out = tuple(self._to_global(a)
-                        for a in self.dataset.batch(step))
+            arrs = self.dataset.batch(step)
+            out = tuple(self._to_global(a) for a in arrs)
+        # loader hand-off in the flight ring: runs on the prefetch
+        # producer thread when prefetch is on
+        flight.record("data", "host_batch", step=step,
+                      nbytes=sum(int(a.nbytes) for a in arrs))
         obs.get_registry().counter(
             "data_batches_total", "host batches assembled").inc()
         return out
@@ -132,6 +137,10 @@ class DataLoader:
                 sharding = NamedSharding(self.mesh,
                                          PartitionSpec(None, *inner))
                 out.append(self._assemble(arr, sharding))
+        flight.record("data", "host_batch_stacked", step=step,
+                      note=f"k={k}",
+                      nbytes=sum(int(b[j].nbytes) for b in per_step
+                                 for j in range(len(b))))
         obs.get_registry().counter(
             "data_batches_total", "host batches assembled").inc(k)
         return tuple(out)

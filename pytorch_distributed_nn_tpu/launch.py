@@ -60,6 +60,8 @@ class LaunchConfig:
     progress_timeout_s: float | None = None  # step-progress watchdog window
     poll_interval_s: float = 0.2
     kill_grace_s: float = 5.0
+    flight_dir: str | None = None  # where workers dump flight rings
+    flight_dump_grace_s: float = 2.0  # wait for dumps before the kill
     nnodes: int = 1
     node_rank: int = 0
     master_addr: str = "127.0.0.1"
@@ -144,6 +146,10 @@ class ElasticAgent:
             )
             env[failure.ENV_RESTART] = str(incarnation)
             env[failure.ENV_HB_INTERVAL] = str(cfg.heartbeat_interval_s)
+            if cfg.flight_dir is not None:
+                from pytorch_distributed_nn_tpu.obs import flight as _fl
+
+                env[_fl.ENV_FLIGHT_DIR] = str(cfg.flight_dir)
             if cfg.progress_timeout_s is not None:
                 env[failure.ENV_PROGRESS_WINDOW] = str(cfg.progress_timeout_s)
             if store_port is not None:
@@ -196,6 +202,15 @@ class ElasticAgent:
                 runtime_gauges.export_detector_gauges(detector)
                 if stale:
                     log.warning("heartbeat lost from ranks %s", stale)
+                    # Flight-recorder forensics: ask every worker's
+                    # heartbeat thread to dump its ring, and give them
+                    # a beat interval or two to do it BEFORE the kill
+                    # (the stalled rank's main thread can't dump; its
+                    # daemon thread can).
+                    if detector.request_flight_dump(
+                            f"stale ranks {stale}"):
+                        time.sleep(max(cfg.flight_dump_grace_s,
+                                       2 * cfg.heartbeat_interval_s))
                     return "hang", 1
             time.sleep(cfg.poll_interval_s)
 
@@ -275,6 +290,10 @@ def main(args: list[str] | None = None) -> int:
                          "before a worker stops heartbeating (catches "
                          "deadlocked collectives; needs "
                          "--heartbeat-timeout)")
+    ap.add_argument("--flight-dir", default=None,
+                    help="directory where workers dump their collective "
+                         "flight rings (flight_rank<k>.json) on "
+                         "hang/crash; analyze with scripts/obs_doctor.py")
     ap.add_argument("--nnodes", type=int, default=1)
     ap.add_argument("--node-rank", type=int, default=0)
     ap.add_argument("--master-addr", default="127.0.0.1")
@@ -294,6 +313,7 @@ def main(args: list[str] | None = None) -> int:
         max_restarts=ns.max_restarts,
         heartbeat_timeout_s=ns.heartbeat_timeout,
         progress_timeout_s=ns.progress_timeout,
+        flight_dir=ns.flight_dir,
         nnodes=ns.nnodes,
         node_rank=ns.node_rank,
         master_addr=ns.master_addr,

@@ -12,12 +12,19 @@ heartbeats) with:
   data/compute/collective/checkpoint/eval/other;
 - :mod:`obs.runtime_gauges` — mesh topology + heartbeat state gauges;
 - :mod:`obs.aggregate` — cross-host snapshot aggregation through the
-  native store.
+  native store;
+- :mod:`obs.flight` — the post-mortem flight recorder (ISSUE 2): a
+  bounded per-host ring of collective/step/checkpoint/data events,
+  dumped to ``flight_rank<k>.json`` on hangs/crashes;
+- :mod:`obs.forensics` — cross-rank dump analysis (first divergent
+  collective, hang/crash/straggler classification).
 
 ``scripts/obs_report.py`` renders the JSONL/trace output;
+``scripts/obs_doctor.py`` analyzes flight dumps;
 ``bench.py --goodput`` attaches the breakdown to benchmark records.
 """
 
+from pytorch_distributed_nn_tpu.obs import flight  # noqa: F401
 from pytorch_distributed_nn_tpu.obs.goodput import (  # noqa: F401
     PHASES,
     GoodputMeter,

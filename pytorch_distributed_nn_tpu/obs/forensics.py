@@ -1,0 +1,395 @@
+"""Cross-rank flight-dump forensics: who stalled, where, on what.
+
+The producer side (:mod:`obs.flight` + the dump triggers in
+:mod:`runtime.failure` / :mod:`launch`) leaves one
+``flight_rank<k>.json`` per worker. This module is the consumer: load
+the per-rank dumps, align their collective streams by position, find
+the **first divergent collective** (a rank that never recorded it —
+the stall point — or ranks that recorded *different* ops/bytes at the
+same position — a desync), classify the failure (hang vs crash vs
+straggler), and render per-rank step-time percentiles so a slow rank
+stands out even when nothing diverged.
+
+Alignment contract: collective records are compared by their *position
+in the per-rank collective stream*, not by raw ``seq`` (raw seqs can
+drift when ranks record rank-local events like checkpoint metadata);
+an SPMD program records the same collective stream on every rank, so
+position i on rank a and position i on rank b are the same program
+point. The first position where any rank is missing, or where the
+``(op, axis, nbytes)`` signatures disagree, is the divergence.
+
+Stdlib-only (like :mod:`obs.flight`): the doctor must run on a dev box
+with nothing but the dumps.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import glob
+import json
+import os
+import re
+
+_COLLECTIVE_KINDS = ("collective",)
+_CRASH_REASON = re.compile(r"^(exception:|signal:SIGABRT)")
+_HANG_REASON = re.compile(
+    r"^(progress_watchdog|flight_watchdog|supervisor:)")
+
+# a rank whose median step time exceeds the cross-rank median by this
+# factor is flagged a straggler
+STRAGGLER_FACTOR = 1.5
+
+
+@dataclasses.dataclass
+class RankDump:
+    rank: int
+    reason: str
+    reasons: list[str]
+    dumped_at: float
+    dropped: int
+    events: list[dict]
+    path: str = ""
+
+    @property
+    def collectives(self) -> list[dict]:
+        return [e for e in self.events if e.get("kind")
+                in _COLLECTIVE_KINDS]
+
+    @property
+    def steps(self) -> list[dict]:
+        return [e for e in self.events if e.get("kind") == "step"]
+
+    def last_event(self) -> dict | None:
+        return self.events[-1] if self.events else None
+
+    def incomplete(self) -> list[dict]:
+        """Events begun but never completed — a collective here is the
+        hang's smoking gun ("enqueued, never completed")."""
+        return [e for e in self.events if e.get("t1") is None]
+
+
+def load_dump(path: str) -> RankDump:
+    with open(path) as f:
+        d = json.load(f)
+    return RankDump(
+        rank=int(d.get("rank", 0)),
+        reason=str(d.get("reason", "")),
+        reasons=[str(r) for r in d.get("reasons", [])] or
+                ([str(d["reason"])] if d.get("reason") else []),
+        dumped_at=float(d.get("dumped_at", 0.0)),
+        dropped=int(d.get("dropped", 0)),
+        events=list(d.get("events", [])),
+        path=path,
+    )
+
+
+def find_dump_paths(directory: str) -> list[str]:
+    """All ``flight_rank*.json`` under a run directory, rank order."""
+    paths = glob.glob(os.path.join(directory, "flight_rank*.json"))
+
+    def _rank(p):
+        m = re.search(r"flight_rank(\d+)\.json$", p)
+        return int(m.group(1)) if m else 1 << 30
+
+    return sorted(paths, key=_rank)
+
+
+def load_dumps(paths_or_dir) -> dict[int, RankDump]:
+    """{rank: dump} from explicit paths or a directory."""
+    if isinstance(paths_or_dir, (str, os.PathLike)):
+        paths = find_dump_paths(str(paths_or_dir))
+    else:
+        paths = [str(p) for p in paths_or_dir]
+    out: dict[int, RankDump] = {}
+    for p in paths:
+        d = load_dump(p)
+        # duplicate rank files: keep the freshest dump
+        if d.rank not in out or d.dumped_at > out[d.rank].dumped_at:
+            out[d.rank] = d
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Divergence: the first collective the ranks disagree on
+# ---------------------------------------------------------------------------
+
+def _signature(ev: dict) -> tuple:
+    return (ev.get("op", ""), ev.get("axis", ""),
+            int(ev.get("nbytes", 0)))
+
+
+@dataclasses.dataclass
+class Divergence:
+    index: int  # position in the per-rank collective stream
+    kind: str  # "missing" | "mismatch"
+    missing_ranks: list[int]
+    per_rank: dict[int, dict]  # present ranks' event at this position
+
+    def reference(self) -> dict:
+        """A surviving rank's view of the divergent collective."""
+        return next(iter(self.per_rank.values()), {})
+
+
+def find_divergence(dumps: dict[int, RankDump]) -> Divergence | None:
+    """First collective-stream position where ranks disagree; None when
+    every rank recorded an identical stream.
+
+    A ring that wrapped (``dropped > 0``) starts mid-program, so
+    position-0 alignment no longer holds; wrapped dumps are re-aligned
+    on the first *step* every rank still fully holds (step markers ride
+    the events), falling back to tail-truncation when no step numbers
+    are available."""
+    if not dumps:
+        return None
+    streams = {r: d.collectives for r, d in dumps.items()}
+    if any(d.dropped for d in dumps.values()):
+        mins = [min((e.get("step", -1) for e in s), default=-1)
+                for s in streams.values() if s]
+        start = max(mins, default=-1) + 1  # skip the torn wrap step
+        aligned = {r: [e for e in s if e.get("step", -1) >= start]
+                   for r, s in streams.items()}
+        if any(aligned.values()):
+            # an empty aligned stream = that rank stopped before the
+            # common step window even began: missing at position 0
+            streams = aligned
+        else:  # step numbers absent/degenerate: best-effort tail align
+            shortest = min(len(s) for s in streams.values())
+            streams = {r: s[len(s) - shortest:] for r, s in
+                       streams.items()}
+    longest = max(len(s) for s in streams.values())
+    for i in range(longest):
+        present = {r: s[i] for r, s in streams.items() if i < len(s)}
+        missing = sorted(r for r, s in streams.items() if i >= len(s))
+        if missing:
+            return Divergence(index=i, kind="missing",
+                              missing_ranks=missing, per_rank=present)
+        if len({_signature(e) for e in present.values()}) > 1:
+            return Divergence(index=i, kind="mismatch",
+                              missing_ranks=[], per_rank=present)
+    return None
+
+
+# ---------------------------------------------------------------------------
+# Straggler report: per-rank step-time percentiles
+# ---------------------------------------------------------------------------
+
+def _pct(sorted_vals: list[float], q: float) -> float:
+    if not sorted_vals:
+        return 0.0
+    idx = min(int(q * (len(sorted_vals) - 1) + 0.5),
+              len(sorted_vals) - 1)
+    return sorted_vals[idx]
+
+
+@dataclasses.dataclass
+class StragglerRow:
+    rank: int
+    steps: int
+    p50_s: float
+    p90_s: float
+    max_s: float
+    last_step: int
+    last_event_age_s: float  # vs the rank's own dump time
+    flagged: bool = False
+
+
+def straggler_report(dumps: dict[int, RankDump]) -> list[StragglerRow]:
+    """Per-rank inter-step wall times from the ``step`` markers. A rank
+    whose p50 exceeds the cross-rank median p50 by
+    ``STRAGGLER_FACTOR`` is flagged."""
+    rows: list[StragglerRow] = []
+    for rank in sorted(dumps):
+        d = dumps[rank]
+        ts = [e["t0"] for e in d.steps]
+        deltas = sorted(b - a for a, b in zip(ts, ts[1:]))
+        last = d.last_event()
+        last_t = (last.get("t1") or last.get("t0")) if last else None
+        rows.append(StragglerRow(
+            rank=rank,
+            steps=len(ts),
+            p50_s=_pct(deltas, 0.50),
+            p90_s=_pct(deltas, 0.90),
+            max_s=deltas[-1] if deltas else 0.0,
+            last_step=d.steps[-1]["step"] if d.steps else -1,
+            last_event_age_s=(d.dumped_at - last_t
+                              if last_t is not None else -1.0),
+        ))
+    # leave-one-out baseline: each rank is compared against the median
+    # of the OTHER ranks (a plain median of 2 ranks lands on the slow
+    # rank itself and can never flag it)
+    for r in rows:
+        others = sorted(o.p50_s for o in rows
+                        if o.rank != r.rank and o.steps > 1)
+        base = _pct(others, 0.5)
+        r.flagged = (base > 0 and r.steps > 1
+                     and r.p50_s > STRAGGLER_FACTOR * base)
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# Classification: hang vs crash vs straggler
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class Classification:
+    kind: str  # "hang" | "crash" | "straggler" | "healthy"
+    stalled_ranks: list[int]
+    crashed_ranks: list[int]
+    missing_dumps: list[int]
+    divergence: Divergence | None
+    detail: str
+
+
+def classify(dumps: dict[int, RankDump],
+             expected_ranks: list[int] | None = None) -> Classification:
+    crashed = sorted(r for r, d in dumps.items()
+                     if any(_CRASH_REASON.match(x) for x in d.reasons))
+    hang_evidence = sorted(r for r, d in dumps.items()
+                           if any(_HANG_REASON.match(x)
+                                  for x in d.reasons))
+    missing = sorted(set(expected_ranks or []) - set(dumps))
+    div = find_divergence(dumps)
+
+    if crashed:
+        return Classification(
+            kind="crash", stalled_ranks=[], crashed_ranks=crashed,
+            missing_dumps=missing, divergence=div,
+            detail=f"rank(s) {crashed} dumped on a crash reason "
+                   f"({', '.join(dumps[crashed[0]].reasons)})",
+        )
+    if div is not None and div.missing_ranks:
+        ref = div.reference()
+        return Classification(
+            kind="hang", stalled_ranks=div.missing_ranks,
+            crashed_ranks=[], missing_dumps=missing, divergence=div,
+            detail=(f"rank(s) {div.missing_ranks} never reached "
+                    f"collective #{div.index} "
+                    f"(op={ref.get('op')} step={ref.get('step')}) that "
+                    f"other ranks enqueued"),
+        )
+    if div is not None:
+        return Classification(
+            kind="hang", stalled_ranks=[], crashed_ranks=[],
+            missing_dumps=missing, divergence=div,
+            detail=(f"desync at collective #{div.index}: ranks recorded "
+                    f"different ops/bytes at the same program point"),
+        )
+    if missing and dumps:
+        return Classification(
+            kind="crash", stalled_ranks=[], crashed_ranks=missing,
+            missing_dumps=missing, divergence=None,
+            detail=f"rank(s) {missing} left no dump at all (died before "
+                   f"any trigger could fire)",
+        )
+    rows = straggler_report(dumps)
+    flagged = [r.rank for r in rows if r.flagged]
+    if flagged:
+        return Classification(
+            kind="straggler", stalled_ranks=flagged, crashed_ranks=[],
+            missing_dumps=missing, divergence=None,
+            detail=f"rank(s) {flagged} run ≥{STRAGGLER_FACTOR}x slower "
+                   f"than the median rank (see step percentiles)",
+        )
+    if hang_evidence:
+        # everyone stalled at the same program point: the rank whose
+        # event stream went quiet FIRST is the best stall candidate
+        ages = {r: d.last_event() for r, d in dumps.items()}
+        times = {r: (e.get("t1") or e.get("t0"))
+                 for r, e in ages.items() if e}
+        first_quiet = (min(times, key=times.get) if times else None)
+        return Classification(
+            kind="hang",
+            stalled_ranks=[first_quiet] if first_quiet is not None
+            else [],
+            crashed_ranks=[], missing_dumps=missing, divergence=None,
+            detail="all ranks stalled at the same collective position; "
+                   f"rank {first_quiet} went quiet first",
+        )
+    return Classification(
+        kind="healthy", stalled_ranks=[], crashed_ranks=[],
+        missing_dumps=missing, divergence=None,
+        detail="collective streams agree and no crash/hang trigger "
+               "fired",
+    )
+
+
+# ---------------------------------------------------------------------------
+# Report rendering (the doctor's output)
+# ---------------------------------------------------------------------------
+
+def _fmt_event(ev: dict) -> str:
+    t1 = ev.get("t1")
+    state = "completed" if t1 is not None else "NEVER COMPLETED"
+    extra = f" axis={ev['axis']}" if ev.get("axis") else ""
+    nb = f" nbytes={ev['nbytes']}" if ev.get("nbytes") else ""
+    note = f" [{ev['note']}]" if ev.get("note") else ""
+    return (f"seq {ev.get('seq')} {ev.get('kind')}/{ev.get('op')}"
+            f" step={ev.get('step')}{extra}{nb}{note} — {state}")
+
+
+def render_report(dumps: dict[int, RankDump],
+                  expected_ranks: list[int] | None = None,
+                  last: int = 5) -> str:
+    lines: list[str] = []
+    out = lines.append
+    ranks = sorted(dumps)
+    out(f"== flight forensics: {len(dumps)} rank dump(s) "
+        f"(ranks {ranks}) ==")
+    for r in ranks:
+        d = dumps[r]
+        out(f"  rank {r}: {len(d.events)} events "
+            f"({d.dropped} dropped), reasons: {d.reasons}")
+
+    cls = classify(dumps, expected_ranks)
+    out("")
+    out(f"classification: {cls.kind.upper()}")
+    out(f"  {cls.detail}")
+    if cls.stalled_ranks:
+        out(f"  stalled rank(s): {cls.stalled_ranks}")
+    if cls.crashed_ranks:
+        out(f"  crashed/missing rank(s): {cls.crashed_ranks}")
+
+    div = cls.divergence
+    if div is not None:
+        ref = div.reference()
+        out("")
+        out(f"first divergent collective: #{div.index} "
+            f"op={ref.get('op')} seq={ref.get('seq')} "
+            f"step={ref.get('step')}"
+            + (f" axis={ref['axis']}" if ref.get("axis") else "")
+            + (f" nbytes={ref['nbytes']}" if ref.get("nbytes") else ""))
+        for r in sorted(div.per_rank):
+            out(f"  rank {r}: {_fmt_event(div.per_rank[r])}")
+        for r in div.missing_ranks:
+            d = dumps[r]
+            tail = d.collectives[-1] if d.collectives else None
+            out(f"  rank {r}: MISSING — last collective "
+                f"{_fmt_event(tail) if tail else '(none recorded)'}")
+
+    hung = {r: d.incomplete() for r, d in dumps.items()
+            if d.incomplete()}
+    if hung:
+        out("")
+        out("in-flight at dump time (begun, never completed):")
+        for r in sorted(hung):
+            for ev in hung[r][-3:]:
+                out(f"  rank {r}: {_fmt_event(ev)}")
+
+    rows = straggler_report(dumps)
+    if any(r.steps for r in rows):
+        out("")
+        out("straggler report (inter-step wall time, seconds):")
+        out(f"  {'rank':>4} {'steps':>5} {'p50':>9} {'p90':>9} "
+            f"{'max':>9} {'last_step':>9} {'quiet_for':>9}")
+        for r in rows:
+            flag = "  <-- straggler" if r.flagged else ""
+            out(f"  {r.rank:>4} {r.steps:>5} {r.p50_s:>9.4f} "
+                f"{r.p90_s:>9.4f} {r.max_s:>9.4f} {r.last_step:>9} "
+                f"{r.last_event_age_s:>9.2f}{flag}")
+
+    out("")
+    out(f"last {last} events per rank:")
+    for r in ranks:
+        for ev in dumps[r].events[-last:]:
+            out(f"  rank {r}: {_fmt_event(ev)}")
+    return "\n".join(lines)

@@ -12,7 +12,9 @@ analogue to manage.
 Each wrapper also records its traffic with :class:`CommRecorder` at trace
 time: bytes-on-the-wire per the standard ring-algorithm accounting, which
 is what the BASELINE "grad-allreduce bus-bw" metric divides by measured
-step time (SURVEY.md §6).
+step time (SURVEY.md §6). The same ``_record`` call feeds the flight
+recorder (:mod:`obs.flight`) so every collective in a compiled program
+lands in the post-mortem ring.
 """
 
 from __future__ import annotations
@@ -26,6 +28,8 @@ from typing import Sequence
 import jax
 import jax.numpy as jnp
 from jax import lax
+
+from pytorch_distributed_nn_tpu.obs import flight as _flight
 
 AxisName = str | tuple[str, ...]
 
@@ -42,9 +46,16 @@ class CommRecord:
     axis: str
 
 
-class CommRecorder(threading.local):
+class CommRecorder:
     """Trace-time recorder. Wrappers call :meth:`record` when tracing; a
     benchmark wraps tracing in :func:`recording` and reads the totals.
+
+    Process-wide, lock-protected — NOT thread-local: tracing can happen
+    off the main thread (the data-loader prefetch producer dispatches
+    the transfer that triggers a retrace; nested shard_map tracing can
+    ride jax's own worker threads), and a thread-local recorder
+    silently dropped those records from goodput's wire-byte
+    cross-check.
 
     Per-device ring-algorithm wire accounting, with ``payload`` = the
     *input* buffer size the wrapper sees:
@@ -58,11 +69,21 @@ class CommRecorder(threading.local):
     """
 
     def __init__(self) -> None:
+        self._lock = threading.Lock()
         self.active: list[list[CommRecord]] = []
 
     def record(self, rec: CommRecord) -> None:
-        for sink in self.active:
-            sink.append(rec)
+        with self._lock:
+            for sink in self.active:
+                sink.append(rec)
+
+    def attach(self, sink: list[CommRecord]) -> None:
+        with self._lock:
+            self.active.append(sink)
+
+    def detach(self, sink: list[CommRecord]) -> None:
+        with self._lock:
+            self.active.remove(sink)
 
 
 _recorder = CommRecorder()
@@ -71,11 +92,11 @@ _recorder = CommRecorder()
 @contextlib.contextmanager
 def recording():
     sink: list[CommRecord] = []
-    _recorder.active.append(sink)
+    _recorder.attach(sink)
     try:
         yield sink
     finally:
-        _recorder.active.remove(sink)
+        _recorder.detach(sink)
 
 
 def wire_bytes(records: Sequence[CommRecord]) -> float:
@@ -114,6 +135,10 @@ def _record(op: str, x, axis: AxisName) -> None:
         bytes_wire=_WIRE[op](payload, n),
         axis=str(axis),
     ))
+    # post-mortem ring: the same trace-time call lands the collective's
+    # op/axis/bytes/shape in the flight recorder (obs/flight.py)
+    _flight.on_collective(op, axis=str(axis), nbytes=payload,
+                          shape=tuple(x.shape), dtype=str(x.dtype))
 
 
 # ---------------------------------------------------------------------------

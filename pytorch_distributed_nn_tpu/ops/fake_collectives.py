@@ -6,7 +6,10 @@ shard layouts) is tested against this world with no devices and no XLA —
 each collective is literal numpy over a list of per-rank arrays.
 
 Semantics mirror ops/collectives.py verb-for-verb so a strategy's math can
-be cross-checked between the fake world and a real shard_map.
+be cross-checked between the fake world and a real shard_map. Every verb
+also records into the flight recorder (:mod:`obs.flight`) — the fake
+world runs eagerly, so these are genuine runtime records, and the
+forensics pipeline can be exercised end to end with no devices.
 """
 
 from __future__ import annotations
@@ -14,6 +17,8 @@ from __future__ import annotations
 from typing import Sequence
 
 import numpy as np
+
+from pytorch_distributed_nn_tpu.obs import flight as _flight
 
 
 class FakeWorld:
@@ -32,8 +37,22 @@ class FakeWorld:
             )
         return [np.asarray(s) for s in shards]
 
+    def _record(self, op: str, shards: Sequence[np.ndarray] | None,
+                note: str = "fake") -> None:
+        """Flight hook: the fake world's runtime-dispatch record (same
+        fields as the trace-time hook in ops/collectives._record)."""
+        first = shards[0] if shards else None
+        _flight.record(
+            "collective", op, axis="fake",
+            nbytes=0 if first is None else int(np.asarray(first).nbytes),
+            shape=() if first is None else tuple(np.asarray(first).shape),
+            dtype="" if first is None else str(np.asarray(first).dtype),
+            note=note,
+        )
+
     def all_reduce_sum(self, shards):
         shards = self._check(shards)
+        self._record("all_reduce", shards)
         total = np.sum(shards, axis=0)
         return [total.copy() for _ in range(self.world_size)]
 
@@ -42,16 +61,19 @@ class FakeWorld:
 
     def all_reduce_max(self, shards):
         shards = self._check(shards)
+        self._record("all_reduce", shards)
         peak = np.max(shards, axis=0)
         return [peak.copy() for _ in range(self.world_size)]
 
     def all_gather(self, shards, *, gather_axis: int = 0):
         shards = self._check(shards)
+        self._record("all_gather", shards)
         full = np.concatenate(shards, axis=gather_axis)
         return [full.copy() for _ in range(self.world_size)]
 
     def reduce_scatter_sum(self, shards, *, scatter_axis: int = 0):
         shards = self._check(shards)
+        self._record("reduce_scatter", shards)
         total = np.sum(shards, axis=0)
         if total.shape[scatter_axis] % self.world_size:
             raise ValueError(
@@ -62,10 +84,12 @@ class FakeWorld:
 
     def broadcast(self, shards, *, root: int = 0):
         shards = self._check(shards)
+        self._record("broadcast", shards)
         return [shards[root].copy() for _ in range(self.world_size)]
 
     def ppermute(self, shards, perm: Sequence[tuple[int, int]]):
         shards = self._check(shards)
+        self._record("ppermute", shards)
         out = [np.zeros_like(s) for s in shards]
         seen_dst = set()
         for src, dst in perm:
@@ -87,6 +111,7 @@ class FakeWorld:
         """Point-to-point ``dist.send``/``dist.recv`` pair: dst receives
         src's buffer; everyone else keeps theirs."""
         shards = self._check(shards)
+        self._record("send_recv", shards)
         out = [s.copy() for s in shards]
         out[dst] = shards[src].copy()
         return out
@@ -94,6 +119,7 @@ class FakeWorld:
     def all_to_all(self, shards, *, split_axis: int = 0,
                    concat_axis: int = 0):
         shards = self._check(shards)
+        self._record("all_to_all", shards)
         n = self.world_size
         pieces = [np.split(s, n, axis=split_axis) for s in shards]
         return [
@@ -103,4 +129,5 @@ class FakeWorld:
         ]
 
     def barrier(self, shards=None):
+        self._record("barrier", shards if shards else None)
         return shards

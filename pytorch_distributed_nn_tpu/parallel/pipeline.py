@@ -38,6 +38,7 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from pytorch_distributed_nn_tpu.config import TrainConfig
+from pytorch_distributed_nn_tpu.ops import collectives as cc
 from pytorch_distributed_nn_tpu.runtime.mesh import (
     AXIS_PIPE,
     batch_pspec,
@@ -534,7 +535,8 @@ def _pipelined_forward(part: StagePartition, mesh: Mesh, S: int, M: int,
                 r = None
             y, aux = _stage_apply(part, stage_params, x_in, train=train,
                                   rng=r)
-            sent = lax.ppermute(y, AXIS_PIPE, fwd_edges)
+            # cc.ppermute = lax.ppermute + CommRecorder/flight record
+            sent = cc.ppermute(y, AXIS_PIPE, fwd_edges)
             # fill/drain ticks compute garbage — their aux terms must
             # not reach the objective (stage s is live for t in
             # [s, s + M))
@@ -959,8 +961,8 @@ def _make_1f1b_step(cfg: TrainConfig, mesh: Mesh, loss_fn: Callable,
             )
 
             # ---- unconditional sends -------------------------------
-            recv_f = lax.ppermute(y, AXIS_PIPE, fwd_edges)
-            recv_b = lax.ppermute(dx, AXIS_PIPE, bwd_edges)
+            recv_f = cc.ppermute(y, AXIS_PIPE, fwd_edges)
+            recv_b = cc.ppermute(dx, AXIS_PIPE, bwd_edges)
             return (recv_f, recv_b, act, sg, rg, loss_sum), None
 
         zeros_act = jnp.zeros(mb_shape, act_dtype)
@@ -1216,8 +1218,8 @@ def _make_interleaved_step(cfg: TrainConfig, mesh: Mesh,
             )
 
             # ---- 5) unconditional FULL-ring sends -------------------
-            recv_f = lax.ppermute(y, AXIS_PIPE, ring_fwd)
-            recv_b = lax.ppermute(dx, AXIS_PIPE, ring_bwd)
+            recv_f = cc.ppermute(y, AXIS_PIPE, ring_fwd)
+            recv_b = cc.ppermute(dx, AXIS_PIPE, ring_bwd)
             return (recv_f, recv_b, fin, binb, act, sg, rg,
                     loss_sum), None
 

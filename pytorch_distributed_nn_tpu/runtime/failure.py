@@ -44,6 +44,7 @@ import threading
 import time
 
 from . import native
+from pytorch_distributed_nn_tpu.obs import flight
 
 log = logging.getLogger(__name__)
 
@@ -57,6 +58,13 @@ ENV_PROGRESS_WINDOW = "TPUNN_PROGRESS_WINDOW"
 
 def _hb_key(incarnation: int, rank: int) -> str:
     return f"hb/{incarnation}/{rank}"
+
+
+def _flight_dump_key(incarnation: int) -> str:
+    """Supervisor→worker flight-dump request over the heartbeat store.
+    The heartbeat daemon thread serves it — the one thread guaranteed
+    alive when the main thread is wedged inside a hung collective."""
+    return f"flight/dump/{incarnation}"
 
 
 class HeartbeatReporter:
@@ -74,6 +82,9 @@ class HeartbeatReporter:
         self.rank = rank
         self.incarnation = incarnation
         self._key = _hb_key(incarnation, rank)
+        self._dump_key = _flight_dump_key(incarnation)
+        self._dump_served = False
+        self._was_suppressed = False
         self._interval = interval_s
         self._window = progress_window_s
         # observability counters (obs/runtime_gauges.py reads these):
@@ -126,13 +137,41 @@ class HeartbeatReporter:
         as a hang."""
         self._last_progress = None
 
+    def _maybe_serve_dump_request(self) -> None:
+        """Serve a supervisor-initiated flight-dump request (launch.py
+        sets the key when FailureDetector sees stale ranks). Runs on
+        this daemon thread precisely because the main thread may be
+        stuck inside the hung collective being diagnosed."""
+        if self._dump_served:
+            return
+        try:
+            if not self._client.check(self._dump_key):
+                return
+            reason = self._client.get(
+                self._dump_key, timeout_ms=1000).decode("utf-8", "replace")
+        except (OSError, TimeoutError):
+            return
+        self._dump_served = True
+        flight.dump_now(f"supervisor:{reason}", force=True)
+
     def _run(self) -> None:
         while not self._stop.wait(self._interval):
+            try:
+                self._maybe_serve_dump_request()
+            except Exception:  # a dump must never kill the beat thread
+                log.exception("flight dump request handling failed")
             if (self._window is not None
                     and self._last_progress is not None
                     and time.time() - self._last_progress > self._window):
+                if not self._was_suppressed:
+                    # first watchdog trip: the main loop stopped making
+                    # progress — capture the ring NOW, while the hung
+                    # collective is still the newest entry
+                    self._was_suppressed = True
+                    flight.dump_now("progress_watchdog")
                 self._suppressed += 1
                 continue  # main thread looks stuck: go silent, get flagged
+            self._was_suppressed = False
             try:
                 self.beat()
             except OSError:  # store gone: supervisor is tearing us down
@@ -184,6 +223,13 @@ def maybe_start_heartbeat(rank: int | None = None) -> HeartbeatReporter | None:
     except (native.NativeUnavailable, ConnectionError, OSError) as e:
         log.warning("heartbeat disabled: %s", e)
         return None
+    # flight-recorder dump triggers ride the agent contract: fatal
+    # signals + unhandled exceptions dump the ring, and the flight
+    # watchdog dumps when no event lands for a progress window (a
+    # collective that never completes stops the event stream)
+    flight.install_crash_hooks()
+    if window:
+        flight.start_watchdog(float(window))
     return _reporter
 
 
@@ -245,6 +291,21 @@ class FailureDetector:
             else:
                 ages[rank] = None
         return ages
+
+    def request_flight_dump(self, reason: str) -> bool:
+        """Ask every worker to dump its flight ring (served by each
+        worker's heartbeat daemon thread — see
+        :meth:`HeartbeatReporter._maybe_serve_dump_request`). Called by
+        the agent when stale ranks are detected, BEFORE the gang is
+        killed. Returns False when the store write fails (a dying store
+        must not mask the hang report)."""
+        try:
+            self._client.set(_flight_dump_key(self._incarnation),
+                             reason.encode())
+            return True
+        except OSError as e:
+            log.warning("flight dump request failed: %s", e)
+            return False
 
     def stale_ranks(self, alive: set[int] | None = None) -> list[int]:
         """Ranks whose heartbeat is older than the timeout.

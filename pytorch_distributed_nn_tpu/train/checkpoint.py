@@ -33,6 +33,7 @@ import numpy as np
 import orbax.checkpoint as ocp
 
 from pytorch_distributed_nn_tpu import obs
+from pytorch_distributed_nn_tpu.obs import flight
 from pytorch_distributed_nn_tpu.train.state import TrainState
 
 log = logging.getLogger(__name__)
@@ -70,6 +71,8 @@ class CheckpointManager:
             meta.update(extra_meta)
         # span covers only the host-side queueing (async save): the
         # background write shows up in `wait`/`close` spans instead
+        ev = flight.record("checkpoint", "save", step=step,
+                           note="queue", complete=False)
         with obs.span("checkpoint/save", step=step):
             saved = self._mgr.save(
                 step,
@@ -79,6 +82,7 @@ class CheckpointManager:
                 }),
                 force=force,
             )
+        flight.complete(ev)
         if saved:
             obs.get_registry().counter(
                 "checkpoint_saves_total", "checkpoint saves queued").inc()
@@ -106,6 +110,8 @@ class CheckpointManager:
             if isinstance(x, jax.Array) else x,
             _array_tree(template),
         )
+        ev = flight.record("checkpoint", "restore", step=step,
+                           complete=False)
         with obs.span("checkpoint/restore", step=step):
             restored = self._mgr.restore(
                 step,
@@ -114,6 +120,7 @@ class CheckpointManager:
                     _META: ocp.args.JsonRestore(),
                 }),
             )
+        flight.complete(ev)
         obs.get_registry().counter(
             "checkpoint_restores_total", "checkpoint restores").inc()
         state = _merge_array_tree(template, restored[_ARRAYS])

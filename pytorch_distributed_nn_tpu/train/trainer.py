@@ -21,6 +21,7 @@ from pytorch_distributed_nn_tpu.config import TrainConfig
 from pytorch_distributed_nn_tpu.data import DataLoader, get_dataset
 from pytorch_distributed_nn_tpu.models import get_model
 from pytorch_distributed_nn_tpu.obs import aggregate as obs_aggregate
+from pytorch_distributed_nn_tpu.obs import flight
 from pytorch_distributed_nn_tpu.obs import runtime_gauges
 from pytorch_distributed_nn_tpu.ops import collectives as cc
 from pytorch_distributed_nn_tpu.runtime import failure
@@ -121,6 +122,11 @@ class Trainer:
             )
 
             self.metrics = MetricsLogger(cfg.metrics_path)
+            # flight dumps land next to the run's JSONL unless the
+            # elastic agent's TPUNN_FLIGHT_DIR contract says otherwise
+            import pathlib
+
+            flight.set_dump_dir(pathlib.Path(cfg.metrics_path).parent)
         self.ckpt = None
         try:
             if cfg.checkpoint_dir:
@@ -220,6 +226,10 @@ class Trainer:
                 x, y = next(it)
             self.data_step += 1
             g = self.data_step  # 1-based global step just dispatched
+            # step-boundary marker in the flight ring: trace-time
+            # collective records inherit this step, and per-rank step
+            # timestamps drive obs_doctor's straggler percentiles
+            flight.mark_step(g)
             if i == 0 and gp.wire_bytes_per_step is None:
                 # trace-time collective accounting rides the first
                 # dispatch (the call that traces step_fn): recorded
@@ -227,13 +237,16 @@ class Trainer:
                 # for the collective share
                 with cc.recording() as comm_records:
                     with gp.phase("compute"):
-                        self.state, metrics = self.step_fn(self.state,
-                                                           x, y)
+                        with flight.dispatch("train_step", step=g):
+                            self.state, metrics = self.step_fn(
+                                self.state, x, y)
                 if comm_records:
                     gp.wire_bytes_per_step = cc.wire_bytes(comm_records)
             else:
                 with gp.phase("compute"):
-                    self.state, metrics = self.step_fn(self.state, x, y)
+                    with flight.dispatch("train_step", step=g):
+                        self.state, metrics = self.step_fn(self.state,
+                                                           x, y)
             self.last_metrics = metrics
             self._c_steps.inc()
             self._c_samples.inc(cfg.data.batch_size)
@@ -376,9 +389,12 @@ class Trainer:
                         ys = jax.tree.map(lambda a: a[:k_eff], ys)
                 else:
                     xs, ys = next(batches)
+            flight.mark_step(self.data_step + 1, note=f"k={k_eff}")
             with gp.phase("compute"):
-                self.state, metrics = self._get_multistep(k_eff)(
-                    self.state, xs, ys)
+                with flight.dispatch("multistep", step=self.data_step + 1,
+                                     note=f"k={k_eff}"):
+                    self.state, metrics = self._get_multistep(k_eff)(
+                        self.state, xs, ys)
             self.data_step += k_eff
             remaining -= k_eff
             g = self.data_step  # 1-based step count after this window

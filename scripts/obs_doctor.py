@@ -1,0 +1,137 @@
+#!/usr/bin/env python
+"""obs_doctor — cross-rank flight-recorder forensics.
+
+A pod run died or hung and the dump triggers (progress watchdog, fatal
+signal, unhandled exception, supervisor request — see
+docs/observability.md "Flight recorder") left ``flight_rank<k>.json``
+files next to the run's JSONL. This tool merges them, aligns the
+per-rank collective streams, names the **first divergent collective**
+(op + seq + step) and the **stalled rank**, classifies the failure
+(hang vs crash vs straggler), and prints per-rank step-time
+percentiles so a slow rank stands out even when nothing diverged.
+
+Usage:
+    python scripts/obs_doctor.py RUNDIR              # globs flight_rank*.json
+    python scripts/obs_doctor.py a.json b.json ...   # explicit dumps
+    python scripts/obs_doctor.py RUNDIR --json       # machine-readable
+    python scripts/obs_doctor.py --selftest          # synthetic hang, end to end
+"""
+
+from __future__ import annotations
+
+import argparse
+import dataclasses
+import json
+import os
+import sys
+import tempfile
+
+sys.path.insert(0, ".")  # run from repo root without install
+
+from pytorch_distributed_nn_tpu.obs import flight, forensics  # noqa: E402
+
+
+def _analyze(paths_or_dir, expect_ranks: int | None, last: int,
+             as_json: bool) -> int:
+    dumps = forensics.load_dumps(paths_or_dir)
+    if not dumps:
+        print(f"no flight_rank*.json dumps found in {paths_or_dir}")
+        return 1
+    expected = list(range(expect_ranks)) if expect_ranks else None
+    if as_json:
+        cls = forensics.classify(dumps, expected)
+        div = cls.divergence
+        print(json.dumps({
+            "classification": cls.kind,
+            "stalled_ranks": cls.stalled_ranks,
+            "crashed_ranks": cls.crashed_ranks,
+            "missing_dumps": cls.missing_dumps,
+            "detail": cls.detail,
+            "divergence": None if div is None else {
+                "index": div.index,
+                "kind": div.kind,
+                "missing_ranks": div.missing_ranks,
+                "reference": div.reference(),
+            },
+            "stragglers": [dataclasses.asdict(r) for r in
+                           forensics.straggler_report(dumps)],
+        }, indent=2))
+    else:
+        print(forensics.render_report(dumps, expected, last=last))
+    return 0
+
+
+def _selftest() -> int:
+    """Synthesize a 3-rank hang with the REAL recorder + dump path and
+    check the doctor names the stalled rank and the divergent
+    collective — an end-to-end smoke with no devices and no cluster."""
+    hang_at, world = 7, 3
+    with tempfile.TemporaryDirectory() as d:
+        for rank in range(world):
+            rec = flight.FlightRecorder(capacity=256, enabled=True)
+            for step in range(10):
+                rec.mark_step(step)
+                if step == hang_at:
+                    if rank != 1:
+                        # survivors enqueue the collective rank 1 never
+                        # reaches, and block inside it forever
+                        rec.record("collective", "all_reduce",
+                                   axis="data", nbytes=4096,
+                                   step=step, note="dispatch",
+                                   complete=False)
+                    break  # rank 1's injected stall / survivors' block
+                with rec.collective("all_reduce", axis="data",
+                                    nbytes=4096, step=step):
+                    pass
+            rec.dump("progress_watchdog" if rank == 1
+                     else "supervisor:stale ranks [1]",
+                     directory=d, rank=rank)
+        dumps = forensics.load_dumps(d)
+        assert len(dumps) == world, f"expected {world} dumps: {dumps}"
+        cls = forensics.classify(dumps, list(range(world)))
+        assert cls.kind == "hang", cls
+        assert cls.stalled_ranks == [1], cls
+        div = cls.divergence
+        assert div is not None and div.missing_ranks == [1], div
+        ref = div.reference()
+        assert ref["op"] == "all_reduce" and ref["step"] == hang_at, ref
+        report = forensics.render_report(dumps, list(range(world)))
+        for needle in ("HANG", "stalled rank(s): [1]", "all_reduce",
+                       f"step={hang_at}", "NEVER COMPLETED"):
+            assert needle in report, (needle, report)
+        print(report)
+    print("\nselftest ok: hang classified, stalled rank 1 named, "
+          f"divergent collective all_reduce @ step {hang_at} found")
+    return 0
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("dumps", nargs="*",
+                    help="run directory containing flight_rank*.json, "
+                         "or explicit dump files")
+    ap.add_argument("--expect-ranks", type=int, default=None,
+                    help="world size; ranks with no dump at all are "
+                         "reported as crashed/missing")
+    ap.add_argument("--last", type=int, default=5,
+                    help="trailing events to show per rank")
+    ap.add_argument("--json", action="store_true",
+                    help="machine-readable classification")
+    ap.add_argument("--selftest", action="store_true",
+                    help="run the built-in synthetic-hang check")
+    args = ap.parse_args(argv)
+    if args.selftest:
+        return _selftest()
+    if not args.dumps:
+        ap.error("give a run directory or dump files (or --selftest)")
+    target = (args.dumps[0]
+              if len(args.dumps) == 1 and os.path.isdir(args.dumps[0])
+              else args.dumps)
+    try:
+        return _analyze(target, args.expect_ranks, args.last, args.json)
+    except BrokenPipeError:  # `obs_doctor ... | head` is a normal use
+        return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -64,32 +64,33 @@ def print_goodput_table(events: list[dict], last: int) -> bool:
     print("== goodput breakdown (seconds; share of wall below) ==")
     print(header)
     for e in windows[-last:]:
-        wall = e.get("wall_s", 0.0)
-        row = (f"{e.get('step', -1):>12} {e.get('steps', 1):>5} "
+        wall = _num(e, "wall_s")
+        row = (f"{int(_num(e, 'step', -1)):>12} "
+               f"{int(_num(e, 'steps', 1)):>5} "
                + _fmt_s(wall) + " "
-               + " ".join(_fmt_s(e.get(f'{p}_s', 0.0)) for p in PHASES)
-               + f" {_fmt_pct(e.get('accounted_frac', 0.0)):>7}")
+               + " ".join(_fmt_s(_num(e, f'{p}_s')) for p in PHASES)
+               + f" {_fmt_pct(_num(e, 'accounted_frac')):>7}")
         print(row)
         if wall > 0:
             print(f"{'':>12} {'':>5} {'':>10} "
                   + " ".join(
-                      f"{_fmt_pct(e.get(f'{p}_s', 0.0) / wall):>10}"
+                      f"{_fmt_pct(_num(e, f'{p}_s') / wall):>10}"
                       for p in PHASES))
     if summary is not None:
-        wall = summary.get("wall_s", 0.0)
+        wall = _num(summary, "wall_s")
         print("-- whole run --")
-        print(f"{'total':>12} {summary.get('steps', 0):>5} "
+        print(f"{'total':>12} {int(_num(summary, 'steps')):>5} "
               + _fmt_s(wall) + " "
-              + " ".join(_fmt_s(summary.get(f'{p}_s', 0.0))
+              + " ".join(_fmt_s(_num(summary, f'{p}_s'))
                          for p in PHASES)
-              + f" {_fmt_pct(summary.get('accounted_frac', 0.0)):>7}")
+              + f" {_fmt_pct(_num(summary, 'accounted_frac')):>7}")
         if wall > 0:
             print(f"{'':>12} {'':>5} {'':>10} "
                   + " ".join(
-                      f"{_fmt_pct(summary.get(f'{p}_s', 0.0) / wall):>10}"
+                      f"{_fmt_pct(_num(summary, f'{p}_s') / wall):>10}"
                       for p in PHASES))
         print(f"goodput (compute+collective share of wall): "
-              f"{_fmt_pct(summary.get('goodput_frac', 0.0)).strip()}")
+              f"{_fmt_pct(_num(summary, 'goodput_frac')).strip()}")
     return True
 
 
@@ -133,19 +134,31 @@ def print_comms_table(events: list[dict], trace_dir: str | None) -> None:
                   f"s/step): {wire / coll_s / 1e9:.3f} GB/s")
 
 
+def _num(e: dict, key: str, default: float = 0.0) -> float:
+    """Field access that tolerates a torn/partial record from a killed
+    run (missing keys, JSON nulls) instead of TypeError-ing mid-table."""
+    v = e.get(key, default)
+    try:
+        return float(v)
+    except (TypeError, ValueError):
+        return default
+
+
 def print_metric_tail(events: list[dict], last: int) -> None:
     steps = [e for e in events if e.get("event") == "train_step"]
     evals = [e for e in events if e.get("event") == "eval"]
     if steps:
         print("\n== train tail ==")
         for e in steps[-last:]:
-            print(f"step {e.get('step'):>6}  loss {e.get('loss'):.4f}  "
-                  f"{e.get('samples_per_sec', 0.0):>10.1f} samples/s")
+            print(f"step {int(_num(e, 'step', -1)):>6}  "
+                  f"loss {_num(e, 'loss'):.4f}  "
+                  f"{_num(e, 'samples_per_sec'):>10.1f} samples/s")
     if evals:
         print("== eval tail ==")
         for e in evals[-last:]:
-            print(f"step {e.get('step'):>6}  loss {e.get('loss'):.4f}  "
-                  f"acc {e.get('accuracy'):.4f}")
+            print(f"step {int(_num(e, 'step', -1)):>6}  "
+                  f"loss {_num(e, 'loss'):.4f}  "
+                  f"acc {_num(e, 'accuracy'):.4f}")
 
 
 def main(argv=None) -> int:

@@ -7,6 +7,16 @@ platform with 8 virtual devices and never touches the real chip.
 """
 
 import os
+import tempfile
+
+# Flight-recorder dumps (obs/flight.py) fall back to CWD when no dir is
+# configured — fine for a production run, but tests that trip dump
+# triggers (watchdog/launch hang tests) must not litter the repo root.
+# Worker processes spawned by launch tests inherit this too; tests that
+# assert on dump locations override it per-test (monkeypatch /
+# LaunchConfig.flight_dir both win over this default).
+os.environ.setdefault(
+    "TPUNN_FLIGHT_DIR", tempfile.mkdtemp(prefix="tpunn-flight-test-"))
 
 import jax
 

@@ -117,3 +117,33 @@ def test_tree_helpers(mesh8):
     out = jax.jit(mapped)(tree)
     np.testing.assert_allclose(np.asarray(out["w"]), np.ones((N, 4)))
     np.testing.assert_allclose(np.asarray(out["b"]), np.full((N, 2), 2.0))
+
+
+def test_comm_recording_sees_other_threads(mesh8):
+    """Regression: CommRecorder was threading.local, so tracing on any
+    thread but the one that opened recording() silently dropped its
+    records — the data-loader producer thread's traffic vanished from
+    goodput's wire-byte cross-check. The recorder is process-wide now."""
+    import threading
+
+    x = np.ones((N, 64), np.float32)
+    errors = []
+
+    def trace_on_thread():
+        try:
+            # .lower() forces tracing (which is when _record fires)
+            jax.jit(jax.shard_map(
+                lambda s: cc.all_reduce_sum(s, "data"),
+                mesh=mesh8, in_specs=P("data"), out_specs=P("data"),
+            )).lower(x)
+        except Exception as e:  # surface into the assert below
+            errors.append(e)
+
+    with cc.recording() as records:
+        t = threading.Thread(target=trace_on_thread)
+        t.start()
+        t.join()
+    assert not errors, errors
+    assert len(records) == 1
+    assert records[0].op == "all_reduce"
+    assert records[0].bytes_payload == 64 * 4

@@ -1,0 +1,187 @@
+"""FailureDetector / HeartbeatReporter edge cases (no native store:
+a dict-backed fake client stands in — the detector only needs
+check/get/set, so the C++ store is exercised by test_native.py and the
+protocol logic is exercised here)."""
+
+import json
+import time
+
+import pytest
+
+from pytorch_distributed_nn_tpu.obs import flight
+from pytorch_distributed_nn_tpu.runtime import failure
+
+
+class FakeStoreClient:
+    """dict-backed stand-in for runtime.native.StoreClient."""
+
+    def __init__(self):
+        self.d: dict[str, bytes] = {}
+
+    def set(self, key, value):
+        self.d[key] = value
+
+    def get(self, key, timeout_ms=-1, **_):
+        if key not in self.d:
+            raise TimeoutError(key)
+        return self.d[key]
+
+    def check(self, key):
+        return key in self.d
+
+    def close(self):
+        pass
+
+
+def _beat(client, rank, incarnation=0, at=None):
+    client.set(f"hb/{incarnation}/{rank}",
+               repr(at if at is not None else time.time()).encode())
+
+
+# ---------------------------------------------------------------------------
+# FailureDetector.stale_ranks / missed_counts
+# ---------------------------------------------------------------------------
+
+def test_never_beaten_rank_gets_startup_grace():
+    client = FakeStoreClient()
+    det = failure.FailureDetector(client, ranks=[0, 1], incarnation=0,
+                                  timeout_s=0.15)
+    _beat(client, 0)
+    # rank 1 never beat: inside the grace window it is NOT stale ...
+    assert det.stale_ranks() == []
+    assert det.missed_counts == {0: 0, 1: 0}
+    # ... but once it has been up longer than the timeout it is
+    time.sleep(0.2)
+    _beat(client, 0)
+    assert det.stale_ranks() == [1]
+    assert det.missed_counts == {0: 0, 1: 1}
+
+
+def test_rank_removed_from_alive_is_not_reported():
+    """A rank whose process exited is the exit-code watcher's business:
+    stale heartbeats from it must not read as a hang."""
+    client = FakeStoreClient()
+    det = failure.FailureDetector(client, ranks=[0, 1], incarnation=0,
+                                  timeout_s=0.05)
+    _beat(client, 0, at=time.time() - 10.0)  # ancient beat
+    _beat(client, 1, at=time.time() - 10.0)
+    assert set(det.stale_ranks()) == {0, 1}
+    assert det.stale_ranks(alive={1}) == [1]  # 0 exited: skipped
+    assert det.stale_ranks(alive=set()) == []
+    # missed_counts only accumulate for reported ranks
+    assert det.missed_counts == {0: 1, 1: 2}
+
+
+def test_last_beat_ages_none_for_silent_rank():
+    client = FakeStoreClient()
+    det = failure.FailureDetector(client, ranks=[0, 1], incarnation=0,
+                                  timeout_s=1.0)
+    _beat(client, 0, at=time.time() - 2.5)
+    ages = det.last_beat_ages()
+    assert ages[0] == pytest.approx(2.5, abs=0.5)
+    assert ages[1] is None
+
+
+def test_incarnation_isolates_heartbeats():
+    """Beats from a previous incarnation must not vouch for the new
+    gang (fresh keys per restart)."""
+    client = FakeStoreClient()
+    _beat(client, 0, incarnation=0)
+    det = failure.FailureDetector(client, ranks=[0], incarnation=1,
+                                  timeout_s=0.05)
+    assert det.stale_ranks() == []  # startup grace arms here
+    time.sleep(0.1)
+    assert det.stale_ranks() == [0]  # inc-0 beat is invisible
+
+
+# ---------------------------------------------------------------------------
+# HeartbeatReporter: watchdog arm/disarm + clock age
+# ---------------------------------------------------------------------------
+
+def _reporter(client, **kw):
+    kw.setdefault("rank", 0)
+    kw.setdefault("interval_s", 0.03)
+    return failure.HeartbeatReporter(client, **kw)
+
+
+def test_disarmed_reporter_keeps_beating_and_age_stays_fresh():
+    """disarm() returns the watchdog to liveness-only: beats resume, so
+    the reporter's clock age (stats()['age_s']) stays ~0 through
+    unbounded post-loop work instead of aging toward a false hang."""
+    client = FakeStoreClient()
+    rep = _reporter(client, progress_window_s=0.05)
+    try:
+        rep.notify_progress()
+        time.sleep(0.25)  # progress stalls -> suppression kicks in
+        assert rep.stats()["suppressed"] > 0
+        stale_age = rep.stats()["age_s"]
+        assert stale_age > 0.1  # beats were withheld: clock aged
+        rep.disarm()
+        time.sleep(0.15)  # liveness-only again: beats resume
+        assert rep.stats()["age_s"] < stale_age
+        assert rep.stats()["age_s"] < 0.15
+    finally:
+        rep.stop()
+
+
+def test_watchdog_not_armed_before_first_progress():
+    """Before the first notify_progress the reporter is pure liveness —
+    a long first-step compile must not read as a hang."""
+    client = FakeStoreClient()
+    rep = _reporter(client, progress_window_s=0.05)
+    try:
+        time.sleep(0.2)
+        assert rep.stats()["suppressed"] == 0
+        assert rep.stats()["beats"] >= 2
+    finally:
+        rep.stop()
+
+
+# ---------------------------------------------------------------------------
+# flight-dump request protocol (supervisor -> beat thread)
+# ---------------------------------------------------------------------------
+
+def test_reporter_serves_supervisor_dump_request(tmp_path, monkeypatch):
+    monkeypatch.setenv(flight.ENV_FLIGHT_DIR, str(tmp_path))
+    rec = flight.reset_recorder(capacity=32, enabled=True)
+    rec.mark_step(4)
+    rec.record("collective", "all_reduce", axis="data", nbytes=64,
+               step=4, complete=False)
+    client = FakeStoreClient()
+    rep = _reporter(client, rank=0)
+    try:
+        det = failure.FailureDetector(client, ranks=[0], incarnation=0,
+                                      timeout_s=10.0)
+        assert det.request_flight_dump("stale ranks [1]")
+        deadline = time.time() + 2.0
+        path = tmp_path / "flight_rank0.json"
+        while not path.exists() and time.time() < deadline:
+            time.sleep(0.02)
+        d = json.loads(path.read_text())
+        assert d["reason"] == "supervisor:stale ranks [1]"
+        assert d["events"][-1]["op"] == "all_reduce"
+        assert d["events"][-1]["t1"] is None  # the hung collective
+    finally:
+        rep.stop()
+        flight.reset_recorder()
+
+
+def test_progress_watchdog_trip_dumps_ring(tmp_path, monkeypatch):
+    """The worker's own watchdog (beats suppressed because the step
+    loop stalled) captures the ring without any supervisor help."""
+    monkeypatch.setenv(flight.ENV_FLIGHT_DIR, str(tmp_path))
+    rec = flight.reset_recorder(capacity=32, enabled=True)
+    rec.record("collective", "psum", axis="data", complete=False)
+    client = FakeStoreClient()
+    rep = _reporter(client, progress_window_s=0.05)
+    try:
+        rep.notify_progress()
+        deadline = time.time() + 2.0
+        path = tmp_path / "flight_rank0.json"
+        while not path.exists() and time.time() < deadline:
+            time.sleep(0.02)
+        d = json.loads(path.read_text())
+        assert d["reason"] == "progress_watchdog"
+    finally:
+        rep.stop()
+        flight.reset_recorder()

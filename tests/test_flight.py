@@ -1,0 +1,342 @@
+"""Flight recorder + forensics: the post-mortem half of the obs stack.
+
+Covers the ring itself (bounds, seq, enqueue/complete), the trace-time
+hook in ops/collectives, the dump format, and the forensics pipeline
+(divergence, classification, straggler percentiles) the obs_doctor CLI
+fronts. The cross-process integration lives in test_multiprocess.py
+(injected hang under the elastic agent)."""
+
+import importlib.util
+import json
+import pathlib
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from pytorch_distributed_nn_tpu.obs import flight, forensics
+from pytorch_distributed_nn_tpu.ops import collectives as cc
+from pytorch_distributed_nn_tpu.ops.fake_collectives import FakeWorld
+
+
+@pytest.fixture()
+def ring():
+    rec = flight.reset_recorder(capacity=64, enabled=True)
+    yield rec
+    flight.reset_recorder()
+
+
+# ---------------------------------------------------------------------------
+# ring mechanics
+# ---------------------------------------------------------------------------
+
+def test_ring_is_bounded_and_seq_monotonic():
+    rec = flight.FlightRecorder(capacity=8, enabled=True)
+    for i in range(20):
+        rec.record("collective", "all_reduce", step=i)
+    snap = rec.snapshot()
+    assert len(snap) == 8  # bounded
+    assert [e["seq"] for e in snap] == list(range(12, 20))  # newest kept
+    assert rec.total_events == 20
+
+
+def test_begin_complete_timestamps():
+    rec = flight.FlightRecorder(capacity=8, enabled=True)
+    ev = rec.record("checkpoint", "save", complete=False)
+    assert ev.t1 is None
+    time.sleep(0.01)
+    rec.complete(ev)
+    assert ev.t1 is not None and ev.t1 - ev.t0 >= 0.01
+
+
+def test_collective_window_left_open_on_hang():
+    rec = flight.FlightRecorder(capacity=8, enabled=True)
+    with pytest.raises(RuntimeError):
+        with rec.collective("all_reduce", axis="data", nbytes=64):
+            raise RuntimeError("hang surrogate")
+    # even on an exception the window closes; a REAL hang (no exception,
+    # no return) is the one case that leaves t1=None — simulate it:
+    ev = rec.record("collective", "all_reduce", complete=False)
+    assert [e["t1"] for e in rec.snapshot()][-1] is None
+    assert ev.seq == rec.snapshot()[-1]["seq"]
+
+
+def test_mark_step_inherited_by_trace_records():
+    rec = flight.FlightRecorder(capacity=16, enabled=True)
+    rec.mark_step(7)
+    rec.on_collective("all_reduce", axis="data", nbytes=128)
+    coll = [e for e in rec.snapshot() if e["kind"] == "collective"]
+    assert coll[-1]["step"] == 7
+    assert coll[-1]["note"] == "trace"
+
+
+def test_disabled_recorder_is_inert(tmp_path):
+    rec = flight.FlightRecorder(capacity=8, enabled=False)
+    assert rec.record("collective", "x") is None
+    rec.mark_step(3)
+    assert rec.snapshot() == []
+    assert rec.dump("r", directory=tmp_path, rank=0) is None
+    assert not list(tmp_path.iterdir())
+
+
+def test_ring_thread_safety():
+    rec = flight.FlightRecorder(capacity=10_000, enabled=True)
+
+    def worker(k):
+        for i in range(200):
+            rec.record("collective", f"op{k}", step=i)
+
+    threads = [threading.Thread(target=worker, args=(k,))
+               for k in range(4)]
+    [t.start() for t in threads]
+    [t.join() for t in threads]
+    snap = rec.snapshot()
+    assert len(snap) == 800
+    assert sorted(e["seq"] for e in snap) == list(range(800))
+
+
+# ---------------------------------------------------------------------------
+# dump format + triggers
+# ---------------------------------------------------------------------------
+
+def test_dump_schema_and_dedupe(tmp_path):
+    rec = flight.FlightRecorder(capacity=8, enabled=True)
+    rec.mark_step(1)
+    with rec.collective("all_reduce", axis="data", nbytes=32):
+        pass
+    path = rec.dump("progress_watchdog", directory=tmp_path, rank=3)
+    assert path == flight.flight_path(tmp_path, 3)
+    d = json.loads(pathlib.Path(path).read_text())
+    assert d["version"] == flight.DUMP_VERSION
+    assert d["rank"] == 3
+    assert d["reasons"] == ["progress_watchdog"]
+    assert d["total_events"] == 2 and d["dropped"] == 0
+    assert [e["kind"] for e in d["events"]] == ["step", "collective"]
+    # same reason again: deduped (no rewrite); force and new reasons win
+    assert rec.dump("progress_watchdog", directory=tmp_path,
+                    rank=3) is None
+    assert rec.dump("signal:SIGTERM", directory=tmp_path,
+                    rank=3) is not None
+    d2 = json.loads(pathlib.Path(path).read_text())
+    assert d2["reasons"] == ["progress_watchdog", "signal:SIGTERM"]
+
+
+def test_dump_dir_resolution_env_wins(tmp_path, monkeypatch):
+    rec = flight.FlightRecorder(capacity=8, enabled=True)
+    rec.record("step", "start")
+    a, b = tmp_path / "env", tmp_path / "set"
+    a.mkdir(), b.mkdir()
+    rec.set_dump_dir(b)
+    monkeypatch.setenv(flight.ENV_FLIGHT_DIR, str(a))
+    rec.dump("r1")
+    assert (a / "flight_rank0.json").exists()  # env beats set_dump_dir
+    monkeypatch.delenv(flight.ENV_FLIGHT_DIR)
+    rec.dump("r2")
+    assert (b / "flight_rank0.json").exists()
+
+
+def test_watchdog_dumps_on_quiet_ring(tmp_path, monkeypatch, ring):
+    monkeypatch.setenv(flight.ENV_FLIGHT_DIR, str(tmp_path))
+    monkeypatch.setattr(flight, "_watchdog_started", False)
+    ring.record("collective", "all_reduce")  # arm
+    assert flight.start_watchdog(0.2)
+    deadline = time.time() + 5.0
+    path = pathlib.Path(flight.flight_path(tmp_path, flight.default_rank()))
+    while not path.exists() and time.time() < deadline:
+        time.sleep(0.05)
+    d = json.loads(path.read_text())
+    assert d["reason"] == "flight_watchdog"
+
+
+# ---------------------------------------------------------------------------
+# hooks: real trace-time records + fake world
+# ---------------------------------------------------------------------------
+
+def test_collective_wrappers_feed_flight_ring(mesh8, ring):
+    x = np.ones((8, 256), np.float32)
+    jax.jit(jax.shard_map(
+        lambda v: cc.all_reduce_sum(v, "data"),
+        mesh=mesh8, in_specs=P("data"), out_specs=P("data"),
+    )).lower(x)  # tracing fires the _record hook
+    coll = [e for e in ring.snapshot() if e["kind"] == "collective"]
+    assert len(coll) == 1
+    ev = coll[0]
+    assert ev["op"] == "all_reduce" and ev["axis"] == "data"
+    assert ev["nbytes"] == 256 * 4  # per-device shard bytes
+    assert ev["note"] == "trace"
+    assert ev["dtype"] == "float32"
+
+
+def test_fake_world_records_runtime_collectives(ring):
+    w = FakeWorld(2)
+    shards = [np.ones((4,), np.float32), np.ones((4,), np.float32)]
+    w.all_reduce_sum(shards)
+    w.ppermute(shards, [(0, 1), (1, 0)])
+    w.shift_left(shards)
+    w.barrier()
+    ops = [e["op"] for e in ring.snapshot()]
+    assert ops == ["all_reduce", "ppermute", "ppermute", "barrier"]
+    assert all(e["note"] == "fake" for e in ring.snapshot())
+
+
+# ---------------------------------------------------------------------------
+# forensics
+# ---------------------------------------------------------------------------
+
+def _synth_dumps(tmp_path, world=3, hang_rank=1, hang_at=5, steps=8,
+                 reason_for=None):
+    """World of recorders driving the REAL dump path; hang_rank stops
+    before enqueuing collective #hang_at."""
+    for rank in range(world):
+        rec = flight.FlightRecorder(capacity=256, enabled=True)
+        for step in range(steps):
+            rec.mark_step(step)
+            if step == hang_at:
+                if rank != hang_rank:
+                    rec.record("collective", "all_reduce", axis="data",
+                               nbytes=64, step=step, complete=False)
+                break
+            with rec.collective("all_reduce", axis="data", nbytes=64,
+                                step=step):
+                pass
+        reason = (reason_for or {}).get(
+            rank, "progress_watchdog" if rank == hang_rank
+            else "supervisor:stale")
+        rec.dump(reason, directory=tmp_path, rank=rank)
+    return forensics.load_dumps(tmp_path)
+
+
+def test_forensics_names_stalled_rank_and_divergence(tmp_path):
+    dumps = _synth_dumps(tmp_path)
+    div = forensics.find_divergence(dumps)
+    assert div is not None and div.kind == "missing"
+    assert div.index == 5 and div.missing_ranks == [1]
+    ref = div.reference()
+    assert ref["op"] == "all_reduce" and ref["step"] == 5
+    cls = forensics.classify(dumps, expected_ranks=[0, 1, 2])
+    assert cls.kind == "hang" and cls.stalled_ranks == [1]
+    report = forensics.render_report(dumps, [0, 1, 2])
+    assert "HANG" in report and "stalled rank(s): [1]" in report
+    assert "NEVER COMPLETED" in report
+
+
+def test_forensics_detects_desync_mismatch(tmp_path):
+    for rank in range(2):
+        rec = flight.FlightRecorder(capacity=64, enabled=True)
+        rec.mark_step(0)
+        with rec.collective("all_reduce", axis="data", nbytes=64):
+            pass
+        # rank 1 issues a DIFFERENT collective at position 1: desync
+        op = "all_gather" if rank else "all_reduce"
+        with rec.collective(op, axis="data", nbytes=64):
+            pass
+        rec.dump("supervisor:stale", directory=tmp_path, rank=rank)
+    dumps = forensics.load_dumps(tmp_path)
+    div = forensics.find_divergence(dumps)
+    assert div is not None and div.kind == "mismatch" and div.index == 1
+    cls = forensics.classify(dumps)
+    assert cls.kind == "hang" and "desync" in cls.detail
+
+
+def test_forensics_classifies_crash(tmp_path):
+    dumps = _synth_dumps(tmp_path, reason_for={
+        0: "exception:ValueError", 1: "supervisor:stale",
+        2: "supervisor:stale"})
+    cls = forensics.classify(dumps)
+    assert cls.kind == "crash" and cls.crashed_ranks == [0]
+
+
+def test_forensics_missing_dump_is_reported(tmp_path):
+    dumps = _synth_dumps(tmp_path, world=2, hang_rank=99)  # no hang
+    cls = forensics.classify(dumps, expected_ranks=[0, 1, 2])
+    assert cls.missing_dumps == [2]
+    assert cls.kind == "crash" and cls.crashed_ranks == [2]
+
+
+def test_forensics_straggler_percentiles(tmp_path):
+    now = time.time()
+    for rank, dt in ((0, 0.010), (1, 0.040)):  # rank 1 is 4x slower
+        events = []
+        for i in range(20):
+            events.append({"seq": i, "kind": "step", "op": "start",
+                           "step": i, "t0": now + i * dt,
+                           "t1": now + i * dt, "axis": "", "nbytes": 0,
+                           "shape": [], "dtype": "", "note": ""})
+        (tmp_path / f"flight_rank{rank}.json").write_text(json.dumps({
+            "version": 1, "rank": rank, "reason": "", "reasons": [],
+            "dumped_at": now + 1.0, "dropped": 0, "events": events}))
+    dumps = forensics.load_dumps(tmp_path)
+    rows = {r.rank: r for r in forensics.straggler_report(dumps)}
+    assert rows[0].p50_s == pytest.approx(0.010, rel=0.01)
+    assert rows[1].p50_s == pytest.approx(0.040, rel=0.01)
+    assert rows[1].flagged and not rows[0].flagged
+    cls = forensics.classify(dumps)
+    assert cls.kind == "straggler" and cls.stalled_ranks == [1]
+
+
+def test_forensics_wrapped_ring_realigns_by_step(tmp_path):
+    """A wrapped ring (dropped > 0) loses absolute position; alignment
+    falls back to the first step every rank fully holds."""
+    for rank in range(2):
+        rec = flight.FlightRecorder(capacity=6, enabled=True)
+        steps = 10 if rank == 0 else 8  # rank 1 stalls at step 8
+        for step in range(steps):
+            rec.mark_step(step)
+            with rec.collective("all_reduce", axis="data", nbytes=64,
+                                step=step):
+                pass
+        rec.dump("supervisor:stale", directory=tmp_path, rank=rank)
+    dumps = forensics.load_dumps(tmp_path)
+    assert all(d.dropped for d in dumps.values())
+    div = forensics.find_divergence(dumps)
+    assert div is not None and div.missing_ranks == [1]
+    assert div.reference()["step"] >= 8
+
+
+# ---------------------------------------------------------------------------
+# the doctor CLI
+# ---------------------------------------------------------------------------
+
+def _doctor():
+    spec = importlib.util.spec_from_file_location(
+        "obs_doctor",
+        pathlib.Path(__file__).parent.parent / "scripts" / "obs_doctor.py",
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_obs_doctor_renders_hang(tmp_path, capsys):
+    _synth_dumps(tmp_path)
+    rc = _doctor().main([str(tmp_path), "--expect-ranks", "3"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "HANG" in out and "stalled rank(s): [1]" in out
+    assert "op=all_reduce" in out and "step=5" in out
+
+
+def test_obs_doctor_json_output(tmp_path, capsys):
+    _synth_dumps(tmp_path)
+    rc = _doctor().main([str(tmp_path), "--json"])
+    assert rc == 0
+    d = json.loads(capsys.readouterr().out)
+    assert d["classification"] == "hang"
+    assert d["stalled_ranks"] == [1]
+    assert d["divergence"]["reference"]["op"] == "all_reduce"
+
+
+def test_obs_doctor_selftest(capsys):
+    rc = _doctor().main(["--selftest"])
+    assert rc == 0
+    assert "selftest ok" in capsys.readouterr().out
+
+
+def test_obs_doctor_empty_dir(tmp_path, capsys):
+    rc = _doctor().main([str(tmp_path)])
+    assert rc == 1
+    assert "no flight" in capsys.readouterr().out

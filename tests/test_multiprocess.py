@@ -502,3 +502,110 @@ def test_two_process_expert_parallel_matches_single(tmp_path):
     single = Trainer(cfg, mesh=mesh).train()
     distributed = float((tmp_path / "loss").read_text())
     assert abs(distributed - single[-1].loss) < 1e-5
+
+
+HANG_WORKER = """
+    import os
+    import time
+
+    from pytorch_distributed_nn_tpu.obs import flight
+    from pytorch_distributed_nn_tpu.runtime import failure, native
+
+    # Launched by the elastic agent: the heartbeat env contract is set.
+    rank = int(os.environ["RANK"])
+    rep = failure.maybe_start_heartbeat(rank)
+    assert rep is not None, "agent store contract missing"
+
+    # The collective under test is a REAL cross-process blocking sync
+    # (the agent store's barrier): a rank that skips it leaves every
+    # other rank blocked inside, exactly like a skipped psum leaves
+    # peers wedged in the ICI ring. (The XLA cross-process psum path
+    # is exercised by test_two_process_psum; this test targets the
+    # hang-forensics machinery and must hang deterministically.)
+    client = native.StoreClient(
+        os.environ[failure.ENV_STORE_HOST],
+        int(os.environ[failure.ENV_STORE_PORT]),
+    )
+
+    HANG_AT = 7
+    for step in range(100):
+        flight.mark_step(step)
+        if rank == 1 and step == HANG_AT:
+            # the injected fault: this rank never joins step 7's
+            # collective; rank 0 enqueues it and blocks inside
+            time.sleep(600)
+        with flight.collective("barrier", axis="world", nbytes=8,
+                               step=step):
+            client.barrier(f"step{step}", 2, timeout_ms=600_000)
+        failure.notify_progress()
+        time.sleep(0.02)
+"""
+
+
+def test_injected_hang_dumps_flight_rings_and_doctor_names_rank(tmp_path):
+    """ISSUE 2 acceptance: one rank deliberately skips a collective;
+    the agent's watchdog + supervisor dump request make every
+    SURVIVING rank (whose main thread is wedged inside the hung psum)
+    dump its flight ring via the heartbeat daemon thread, and
+    obs_doctor names the stalled rank and the first divergent
+    collective (op + seq + step)."""
+    import importlib.util
+    import pathlib
+
+    script = tmp_path / "worker.py"
+    script.write_text(textwrap.dedent(HANG_WORKER))
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    result = launch(
+        [str(script)],
+        LaunchConfig(
+            nprocs=2,
+            heartbeat_timeout_s=1.0,
+            heartbeat_interval_s=0.1,
+            progress_timeout_s=0.5,
+            flight_dir=str(tmp_path),
+            flight_dump_grace_s=1.0,
+            kill_grace_s=1.0,
+            env={"PYTHONPATH": repo},
+        ),
+    )
+    assert result.reason == "hang", result
+    assert result.exit_code != 0
+
+    # every rank dumped — including rank 0, whose main thread was stuck
+    # inside the collective (the beat thread dumped for it)
+    dump0 = tmp_path / "flight_rank0.json"
+    dump1 = tmp_path / "flight_rank1.json"
+    assert dump0.exists() and dump1.exists(), list(tmp_path.iterdir())
+
+    from pytorch_distributed_nn_tpu.obs import forensics
+
+    dumps = forensics.load_dumps(str(tmp_path))
+    cls = forensics.classify(dumps, expected_ranks=[0, 1])
+    assert cls.kind == "hang", cls
+    assert cls.stalled_ranks == [1], cls
+    div = cls.divergence
+    assert div is not None and div.missing_ranks == [1]
+    ref = div.reference()
+    assert ref["op"] == "barrier"
+    assert ref["step"] == 7
+    assert ref["t1"] is None  # rank 0 enqueued it, never completed
+    assert isinstance(ref["seq"], int)
+
+    # and the CLI renders the same verdict
+    spec = importlib.util.spec_from_file_location(
+        "obs_doctor",
+        pathlib.Path(repo) / "scripts" / "obs_doctor.py",
+    )
+    doctor = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(doctor)
+    import contextlib
+    import io
+
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = doctor.main([str(tmp_path), "--expect-ranks", "2"])
+    out = buf.getvalue()
+    assert rc == 0
+    assert "HANG" in out
+    assert "stalled rank(s): [1]" in out
+    assert "op=barrier" in out and "step=7" in out

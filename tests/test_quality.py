@@ -5,8 +5,11 @@ bf16 vs int8 through ``train.losses.model_nll``. On CPU the int8
 matmuls run the jnp fallback; the on-chip record lands in ONCHIP via
 the bench metric."""
 
+import ast
 import math
 import re
+import subprocess
+import sys
 from pathlib import Path
 
 import jax
@@ -66,6 +69,83 @@ def test_no_bare_print_in_library_code():
         "bare print( in library code (use obs registry / MetricsLogger "
         f"/ logging instead): {offenders}"
     )
+
+
+_OPS = Path(__file__).parent.parent / "pytorch_distributed_nn_tpu" / "ops"
+# the data-moving lax verbs; axis_index/axis_size are metadata, not comm
+_LAX_COMM_VERBS = {"psum", "pmean", "pmax", "all_gather", "psum_scatter",
+                   "ppermute", "all_to_all", "pshuffle"}
+
+
+def _calls_in(node) -> set[str]:
+    """Names/attribute-tails called anywhere inside ``node``."""
+    out = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Call):
+            f = n.func
+            if isinstance(f, ast.Attribute):
+                out.add(f.attr)
+            elif isinstance(f, ast.Name):
+                out.add(f.id)
+    return out
+
+
+def test_every_collective_wrapper_goes_through_record_hook():
+    """Observability lint: a collective wrapper that skips ``_record``
+    is invisible to BOTH the wire-byte accounting and the flight
+    recorder — a new verb must not be able to dodge the post-mortem
+    ring silently. Real wrappers (ops/collectives.py): any public
+    function dispatching a lax comm verb must call ``_record`` (or
+    delegate to a public wrapper that does). Fake world
+    (ops/fake_collectives.py): every public FakeWorld method must call
+    ``self._record`` or delegate to a recorded sibling."""
+    tree = ast.parse((_OPS / "collectives.py").read_text())
+    public = {n.name: n for n in tree.body
+              if isinstance(n, ast.FunctionDef)
+              and not n.name.startswith("_")}
+    offenders = []
+    for name, fn in public.items():
+        calls = _calls_in(fn)
+        if not calls & _LAX_COMM_VERBS:
+            continue  # metadata helper, not a comm wrapper
+        delegates = calls & set(public) - {name}
+        if "_record" not in calls and not delegates:
+            offenders.append(f"collectives.{name}")
+    assert public, "collectives.py parse found no public functions"
+    assert not offenders, (
+        f"collective wrappers missing the _record/flight hook: "
+        f"{offenders}"
+    )
+
+    fake_tree = ast.parse((_OPS / "fake_collectives.py").read_text())
+    world = next(n for n in fake_tree.body
+                 if isinstance(n, ast.ClassDef) and n.name == "FakeWorld")
+    methods = {n.name: n for n in world.body
+               if isinstance(n, ast.FunctionDef)}
+    pub_methods = {m for m in methods if not m.startswith("_")}
+    offenders = []
+    for name in sorted(pub_methods):
+        calls = _calls_in(methods[name])
+        if "_record" not in calls and not (calls & pub_methods - {name}):
+            offenders.append(f"FakeWorld.{name}")
+    assert not offenders, (
+        f"fake collectives missing the flight _record hook: {offenders}"
+    )
+
+
+def test_obs_doctor_selftest_smoke():
+    """The doctor's built-in synthetic-hang check, run exactly as an
+    operator would (fresh interpreter, repo root)."""
+    repo = Path(__file__).parent.parent
+    proc = subprocess.run(
+        [sys.executable, str(repo / "scripts" / "obs_doctor.py"),
+         "--selftest"],
+        capture_output=True, text=True, timeout=300, cwd=repo,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr or proc.stdout
+    assert "selftest ok" in proc.stdout
+    assert "stalled rank 1" in proc.stdout
 
 
 @pytest.mark.slow  # trains a small llama for 60 steps: minutes on CPU
