@@ -68,6 +68,15 @@ _PROBE = (
 )
 
 
+def restart_ctx() -> dict:
+    """Restart/backoff/chaos accounting merged into --goodput records
+    (obs.goodput.restart_context; imported lazily — bench must parse
+    args before touching the package)."""
+    from pytorch_distributed_nn_tpu.obs.goodput import restart_context
+
+    return restart_context()
+
+
 def wait_for_backend(attempts: int = 3, probe_timeout: float = 75.0,
                      ) -> str | None:
     """Block until `jax.devices()` works in a fresh subprocess.
@@ -966,7 +975,10 @@ def main(argv=None) -> int:
                if cfg.data.dataset in ("lm_synthetic", "mlm_synthetic",
                                        "token_file") else {}),
             **({"mfu_error": mfu_error} if mfu_error else {}),
-            **({"goodput": goodput_summary} if goodput_summary else {}),
+            # restart/backoff/chaos context rides the goodput record so
+            # interrupted (agent-restarted) runs account their lost time
+            **({"goodput": {**goodput_summary, **restart_ctx()}}
+               if goodput_summary else {}),
         )
     print(json.dumps(rec))
     return 0

@@ -18,8 +18,13 @@ worker failure tears the gang down and restarts it up to
   never exits, so exit codes are not enough); each node's agent watches
   only the ranks it spawned;
 - on failure, kills the whole gang and relaunches it with an
-  incremented ``TPUNN_RESTART`` incarnation. Recovery of *progress* is
-  the worker's job: resume from the latest checkpoint
+  incremented ``TPUNN_RESTART`` incarnation, governed by
+  :class:`RestartPolicy`: a restart-budget *window* (max N per T
+  seconds), exponential backoff + jitter between incarnations,
+  fail-fast on repeated identical pre-heartbeat crashes, and free
+  restarts for graceful preemption exits
+  (``failure.GRACEFUL_EXIT_CODE`` — docs/robustness.md). Recovery of
+  *progress* is the worker's job: resume from the latest checkpoint
   (``train.checkpoint.CheckpointManager.restore``), the standard TPU
   fail-fast + restart-from-checkpoint practice.
 
@@ -39,6 +44,7 @@ import argparse
 import dataclasses
 import logging
 import os
+import random
 import signal
 import socket
 import subprocess
@@ -62,6 +68,18 @@ class LaunchConfig:
     kill_grace_s: float = 5.0
     flight_dir: str | None = None  # where workers dump flight rings
     flight_dump_grace_s: float = 2.0  # wait for dumps before the kill
+    # restart policy (RestartPolicy): max_restarts per restart_window_s
+    # seconds (None → per job lifetime), exponential backoff with
+    # jitter between incarnations, fail-fast on repeated identical
+    # pre-heartbeat crashes
+    restart_window_s: float | None = None
+    backoff_base_s: float = 1.0
+    backoff_max_s: float = 30.0
+    backoff_factor: float = 2.0
+    backoff_jitter: float = 0.1
+    failfast_repeats: int = 2
+    failfast_startup_s: float = 5.0
+    restart_seed: int = 0
     nnodes: int = 1
     node_rank: int = 0
     master_addr: str = "127.0.0.1"
@@ -70,10 +88,148 @@ class LaunchConfig:
 
 
 @dataclasses.dataclass
+class IncarnationRecord:
+    """One gang incarnation's outcome (LaunchResult.incarnations)."""
+
+    reason: str  # "ok" | "crash" | "hang" | "preempt"
+    code: int
+    duration_s: float
+
+
+@dataclasses.dataclass
 class LaunchResult:
     exit_code: int
     restarts: int  # incarnations actually consumed (0 = clean first run)
-    reason: str = "ok"  # "ok" | "crash" | "hang"
+    reason: str = "ok"  # "ok" | "crash" | "hang" | "preempt"
+    stop_reason: str = ""  # why the agent stopped restarting
+    incarnations: list[IncarnationRecord] = dataclasses.field(
+        default_factory=list)
+
+
+@dataclasses.dataclass
+class Decision:
+    """RestartPolicy verdict after one failed incarnation."""
+
+    action: str  # "restart" | "stop"
+    delay_s: float = 0.0
+    why: str = ""
+
+
+class RestartPolicy:
+    """Restart governor for the elastic agent (torchrun's fixed
+    ``--max-restarts`` counter, hardened for pod reality):
+
+    - **budget window** — at most ``max_restarts`` budget-charged
+      restarts per ``window_s`` seconds (sliding; ``None`` = per job
+      lifetime). A job that crashes once a day for a month should keep
+      restarting; one that crashes 5x in a minute should not.
+    - **exponential backoff + jitter** — ``base * factor**(n-1)`` capped
+      at ``max_s``, ±``jitter`` fraction from a seeded RNG, so a gang of
+      agents doesn't stampede a recovering coordinator/filesystem.
+    - **fail-fast** — the same exit code ``failfast_repeats`` times in a
+      row *before any heartbeat* (import error, bad flag, missing
+      checkpoint dir) is a deterministic startup crash: restarting burns
+      budget without hope. With no heartbeat monitor, "pre-heartbeat"
+      falls back to ``duration < failfast_startup_s``.
+    - **graceful preemption** (exit ``failure.GRACEFUL_EXIT_CODE``) —
+      restarts immediately and charges nothing: a preempted worker did
+      nothing wrong.
+
+    ``clock`` is injectable for fake-clock tests.
+    """
+
+    def __init__(self, *, max_restarts: int,
+                 window_s: float | None = None,
+                 backoff_base_s: float = 1.0,
+                 backoff_max_s: float = 30.0,
+                 backoff_factor: float = 2.0,
+                 jitter_frac: float = 0.1,
+                 failfast_repeats: int = 2,
+                 failfast_startup_s: float = 5.0,
+                 seed: int = 0,
+                 clock=time.monotonic) -> None:
+        if max_restarts < 0:
+            raise ValueError(f"max_restarts must be >= 0, got "
+                             f"{max_restarts}")
+        if not 0.0 <= jitter_frac < 1.0:
+            raise ValueError(f"jitter_frac must be in [0, 1), got "
+                             f"{jitter_frac}")
+        self.max_restarts = max_restarts
+        self.window_s = window_s
+        self.backoff_base_s = backoff_base_s
+        self.backoff_max_s = backoff_max_s
+        self.backoff_factor = backoff_factor
+        self.jitter_frac = jitter_frac
+        self.failfast_repeats = failfast_repeats
+        self.failfast_startup_s = failfast_startup_s
+        self._clock = clock
+        self._rng = random.Random(seed)
+        self._grants: list[float] = []  # budget-charged restart times
+        self._failures = 0  # consecutive failed incarnations (backoff)
+        self._startup_streak = 0  # consecutive same-code startup crashes
+        self._startup_code: int | None = None
+        self.preempt_restarts = 0
+        self.backoff_total_s = 0.0
+
+    def backoff_bounds(self, failures: int) -> tuple[float, float]:
+        """[lo, hi] delay for the n-th consecutive failure — the
+        testable jitter envelope."""
+        raw = min(self.backoff_base_s
+                  * self.backoff_factor ** max(failures - 1, 0),
+                  self.backoff_max_s)
+        return raw * (1.0 - self.jitter_frac), raw * (1.0 + self.jitter_frac)
+
+    def on_exit(self, *, reason: str, code: int, duration_s: float,
+                beat_seen: bool | None = None) -> Decision:
+        """Classify one finished incarnation; call once per exit."""
+        if reason == "ok":
+            return Decision("stop", why="ok")
+        if reason == "preempt":
+            # graceful exit: not a failure — no budget charge, no
+            # backoff growth, restart at once
+            self._failures = 0
+            self._startup_streak = 0
+            self.preempt_restarts += 1
+            return Decision("restart", 0.0, "graceful preemption exit")
+        pre_beat = ((not beat_seen) if beat_seen is not None
+                    else duration_s < self.failfast_startup_s)
+        if reason == "crash" and pre_beat:
+            if self._startup_streak and code == self._startup_code:
+                self._startup_streak += 1
+            else:
+                self._startup_streak = 1
+                self._startup_code = code
+            if self._startup_streak >= self.failfast_repeats:
+                return Decision(
+                    "stop",
+                    why=(f"failfast: exit code {code} x"
+                         f"{self._startup_streak} before first "
+                         f"heartbeat (deterministic startup crash)"),
+                )
+        else:
+            self._startup_streak = 0
+        now = self._clock()
+        if self.window_s is not None:
+            self._grants = [t for t in self._grants
+                            if now - t < self.window_s]
+        if len(self._grants) >= self.max_restarts:
+            scope = (f"{self.max_restarts} per {self.window_s}s"
+                     if self.window_s is not None
+                     else f"{self.max_restarts} per job")
+            return Decision("stop",
+                            why=f"restart budget exhausted ({scope})")
+        self._grants.append(now)
+        self._failures += 1
+        lo, hi = self.backoff_bounds(self._failures)
+        delay = lo + (hi - lo) * self._rng.random()
+        self.backoff_total_s += delay
+        return Decision("restart", delay,
+                        f"backoff {delay:.2f}s (consecutive failure "
+                        f"{self._failures})")
+
+    @property
+    def budget_restarts(self) -> int:
+        return len(self._grants)
 
 
 def _clamp_code(code: int) -> int:
@@ -181,7 +337,8 @@ class ElasticAgent:
                ) -> tuple[str, int]:
         """Poll until the gang succeeds, a worker fails, or a worker
         hangs. Success requires *every* worker to exit 0. Returns
-        (reason, exit_code) with reason in {"ok", "crash", "hang"}."""
+        (reason, exit_code) with reason in {"ok", "crash", "hang",
+        "preempt"}."""
         cfg = self.cfg
         base = cfg.nprocs * cfg.node_rank
         while True:
@@ -189,6 +346,12 @@ class ElasticAgent:
             bad = [(i, c) for i, c in enumerate(codes) if c not in (None, 0)]
             if bad:
                 rank, code = bad[0]
+                if code == failure.GRACEFUL_EXIT_CODE:
+                    # graceful preemption exit (SIGTERM → final save →
+                    # distinct code): not charged to the restart budget
+                    log.warning("worker local_rank=%d exited gracefully "
+                                "on preemption", rank)
+                    return "preempt", _clamp_code(code)
                 log.warning("worker local_rank=%d exited %d", rank, code)
                 return "crash", _clamp_code(code)
             if all(c == 0 for c in codes):
@@ -214,12 +377,31 @@ class ElasticAgent:
                     return "hang", 1
             time.sleep(cfg.poll_interval_s)
 
+    def _policy(self) -> RestartPolicy:
+        cfg = self.cfg
+        return RestartPolicy(
+            max_restarts=cfg.max_restarts,
+            window_s=cfg.restart_window_s,
+            backoff_base_s=cfg.backoff_base_s,
+            backoff_max_s=cfg.backoff_max_s,
+            backoff_factor=cfg.backoff_factor,
+            jitter_frac=cfg.backoff_jitter,
+            failfast_repeats=cfg.failfast_repeats,
+            failfast_startup_s=cfg.failfast_startup_s,
+            seed=cfg.restart_seed,
+        )
+
     def run(self) -> LaunchResult:
         cfg = self.cfg
-        for incarnation in range(cfg.max_restarts + 1):
+        policy = self._policy()
+        history: list[IncarnationRecord] = []
+        incarnation = 0
+        while True:
             server = None
             monitor = None
             detector = None
+            beat_seen: bool | None = None
+            t0 = time.monotonic()
             try:
                 if cfg.heartbeat_timeout_s is not None:
                     # The store (and the workers' heartbeat threads) only
@@ -242,35 +424,74 @@ class ElasticAgent:
                 self._spawn(incarnation,
                             server.port if server is not None else None)
                 reason, code = self._watch(detector)
+                if detector is not None:
+                    # the fail-fast discriminator, read BEFORE the store
+                    # goes down with the gang
+                    beat_seen = detector.any_beats()
             finally:
                 self._kill_gang()
                 if monitor is not None:
                     monitor.close()
                 if server is not None:
                     server.stop()
+            history.append(IncarnationRecord(
+                reason=reason, code=code,
+                duration_s=time.monotonic() - t0))
+            decision = (Decision("stop", why="ok") if reason == "ok"
+                        else policy.on_exit(
+                            reason=reason, code=code,
+                            duration_s=history[-1].duration_s,
+                            beat_seen=beat_seen))
+            runtime_gauges.export_restart_gauges(
+                incarnations=len(history),
+                restarts=policy.budget_restarts,
+                preempt_restarts=policy.preempt_restarts,
+                backoff_seconds_total=policy.backoff_total_s,
+                last_exit_code=code,
+            )
             if reason == "ok":
-                return LaunchResult(exit_code=0, restarts=incarnation)
-            if incarnation < cfg.max_restarts:
-                log.warning("restarting gang (incarnation %d → %d)",
-                            incarnation, incarnation + 1)
-        return LaunchResult(exit_code=code, restarts=cfg.max_restarts,
-                            reason=reason)
+                return LaunchResult(exit_code=0, restarts=incarnation,
+                                    reason="ok", stop_reason="ok",
+                                    incarnations=history)
+            if decision.action == "stop":
+                log.warning("not restarting: %s", decision.why)
+                return LaunchResult(exit_code=code, restarts=incarnation,
+                                    reason=reason,
+                                    stop_reason=decision.why,
+                                    incarnations=history)
+            log.warning("restarting gang (incarnation %d → %d): %s",
+                        incarnation, incarnation + 1, decision.why)
+            if decision.delay_s > 0:
+                time.sleep(decision.delay_s)
+            incarnation += 1
+
+
+# signals that must tear the gang down with the agent: SIGTERM (cluster
+# kill / preemption), SIGINT (interactive Ctrl-C), SIGHUP (lost
+# terminal) — any of them hitting only the agent would orphan workers
+_PROPAGATED_SIGNALS = (signal.SIGTERM, signal.SIGINT, signal.SIGHUP)
 
 
 def launch(argv: list[str], cfg: LaunchConfig) -> LaunchResult:
     """Run ``argv`` (a python script + args) as an ``nprocs`` gang."""
     agent = ElasticAgent(argv, cfg)
 
-    def _sigterm(signum, frame):  # propagate an agent kill to the gang
+    def _propagate(signum, frame):  # propagate an agent kill to the gang
         agent._kill_gang()
         signal.signal(signum, signal.SIG_DFL)
         os.kill(os.getpid(), signum)
 
-    old = signal.signal(signal.SIGTERM, _sigterm)
+    old: dict[int, object] = {}
+    for signum in _PROPAGATED_SIGNALS:
+        try:
+            old[signum] = signal.signal(signum, _propagate)
+        except (ValueError, OSError):  # non-main thread / exotic platform
+            pass
     try:
         return agent.run()
     finally:
-        signal.signal(signal.SIGTERM, old)
+        for signum, prev in old.items():
+            signal.signal(signum, prev)
 
 
 def main(args: list[str] | None = None) -> int:
@@ -282,6 +503,15 @@ def main(args: list[str] | None = None) -> int:
                     help="worker processes on this host "
                          "(torchrun --nproc-per-node)")
     ap.add_argument("--max-restarts", type=int, default=0)
+    ap.add_argument("--restart-window", type=float, default=None,
+                    help="budget window in seconds: at most "
+                         "--max-restarts budget-charged restarts per "
+                         "this many seconds (default: per job lifetime)")
+    ap.add_argument("--backoff-base", type=float, default=1.0,
+                    help="first-restart backoff seconds (doubles per "
+                         "consecutive failure, jittered)")
+    ap.add_argument("--backoff-max", type=float, default=30.0,
+                    help="backoff ceiling in seconds")
     ap.add_argument("--heartbeat-timeout", type=float, default=None,
                     help="seconds without a heartbeat before a worker "
                          "counts as hung (default: exit-code watch only)")
@@ -311,6 +541,9 @@ def main(args: list[str] | None = None) -> int:
     result = launch(script, LaunchConfig(
         nprocs=ns.nprocs,
         max_restarts=ns.max_restarts,
+        restart_window_s=ns.restart_window,
+        backoff_base_s=ns.backoff_base,
+        backoff_max_s=ns.backoff_max,
         heartbeat_timeout_s=ns.heartbeat_timeout,
         progress_timeout_s=ns.progress_timeout,
         flight_dir=ns.flight_dir,
@@ -320,7 +553,12 @@ def main(args: list[str] | None = None) -> int:
         master_port=ns.master_port,
     ))
     if result.restarts:
-        log.info("job finished after %d restart(s)", result.restarts)
+        log.info("job finished after %d restart(s): %s", result.restarts,
+                 "; ".join(f"[{i}] {r.reason} code={r.code} "
+                           f"{r.duration_s:.1f}s"
+                           for i, r in enumerate(result.incarnations)))
+    if result.stop_reason and result.stop_reason != "ok":
+        log.warning("agent stopped: %s", result.stop_reason)
     return result.exit_code
 
 

@@ -36,7 +36,10 @@ Event kinds:
 - ``dispatch`` — one fused step program handed to the device (Trainer);
 - ``step`` — step-boundary marker (Trainer); per-rank step timestamps
   drive the doctor's straggler percentiles;
-- ``checkpoint`` / ``data`` — save/restore and loader hand-off events.
+- ``checkpoint`` / ``data`` — save/restore and loader hand-off events;
+- ``chaos`` — an injected fault (runtime/chaos.py): every TPUNN_CHAOS
+  injection lands here so forensics can't misattribute it;
+- ``preempt`` — preemption-notice markers (SIGTERM → graceful exit).
 
 Stdlib-only on purpose: dump paths run inside signal handlers and
 heartbeat daemon threads of processes whose main thread is wedged
@@ -92,6 +95,7 @@ class FlightEvent:
 
     seq: int
     kind: str  # collective | dispatch | step | checkpoint | data
+    #          # | chaos | preempt
     op: str
     step: int
     t0: float

@@ -31,9 +31,12 @@ import os
 import re
 
 _COLLECTIVE_KINDS = ("collective",)
-_CRASH_REASON = re.compile(r"^(exception:|signal:SIGABRT)")
+_CRASH_REASON = re.compile(r"^(exception:|signal:SIGABRT|chaos:crash)")
 _HANG_REASON = re.compile(
     r"^(progress_watchdog|flight_watchdog|supervisor:)")
+# graceful preemption (runtime.failure SIGTERM handler / Trainer's
+# graceful-exit dump): a verdict of its own — NOT a crash, NOT a hang
+_PREEMPT_REASON = re.compile(r"^preempt:")
 
 # a rank whose median step time exceeds the cross-rank median by this
 # factor is flagged a straggler
@@ -58,6 +61,13 @@ class RankDump:
     @property
     def steps(self) -> list[dict]:
         return [e for e in self.events if e.get("kind") == "step"]
+
+    @property
+    def chaos_events(self) -> list[dict]:
+        """Injected faults (runtime/chaos.py) recorded in this rank's
+        ring — surfaced so a post-mortem never misattributes a test
+        fault to a production failure."""
+        return [e for e in self.events if e.get("kind") == "chaos"]
 
     def last_event(self) -> dict | None:
         return self.events[-1] if self.events else None
@@ -232,54 +242,94 @@ def straggler_report(dumps: dict[int, RankDump]) -> list[StragglerRow]:
 
 @dataclasses.dataclass
 class Classification:
-    kind: str  # "hang" | "crash" | "straggler" | "healthy"
+    kind: str  # "hang" | "crash" | "preempt" | "straggler" | "healthy"
     stalled_ranks: list[int]
     crashed_ranks: list[int]
     missing_dumps: list[int]
     divergence: Divergence | None
     detail: str
+    # rank -> injected-chaos event count (runtime/chaos.py faults found
+    # in the rings; nonzero means the failure was at least partly
+    # synthetic)
+    chaos_injected: dict[int, int] = dataclasses.field(
+        default_factory=dict)
+
+
+def _chaos_counts(dumps: dict[int, RankDump]) -> dict[int, int]:
+    return {r: len(d.chaos_events) for r, d in dumps.items()
+            if d.chaos_events}
+
+
+def _chaos_note(chaos: dict[int, int]) -> str:
+    if not chaos:
+        return ""
+    total = sum(chaos.values())
+    return (f" [{total} injected chaos fault(s) in the ring(s) — "
+            f"TPUNN_CHAOS run, not an organic failure]")
 
 
 def classify(dumps: dict[int, RankDump],
              expected_ranks: list[int] | None = None) -> Classification:
     crashed = sorted(r for r, d in dumps.items()
                      if any(_CRASH_REASON.match(x) for x in d.reasons))
+    preempted = sorted(r for r, d in dumps.items()
+                       if any(_PREEMPT_REASON.match(x)
+                              for x in d.reasons))
     hang_evidence = sorted(r for r, d in dumps.items()
                            if any(_HANG_REASON.match(x)
                                   for x in d.reasons))
     missing = sorted(set(expected_ranks or []) - set(dumps))
     div = find_divergence(dumps)
+    chaos = _chaos_counts(dumps)
 
     if crashed:
         return Classification(
             kind="crash", stalled_ranks=[], crashed_ranks=crashed,
-            missing_dumps=missing, divergence=div,
+            missing_dumps=missing, divergence=div, chaos_injected=chaos,
             detail=f"rank(s) {crashed} dumped on a crash reason "
-                   f"({', '.join(dumps[crashed[0]].reasons)})",
+                   f"({', '.join(dumps[crashed[0]].reasons)})"
+                   + _chaos_note(chaos),
+        )
+    if preempted:
+        # graceful preemption: ranks dumped on the SIGTERM-notice path
+        # and (if the loop was healthy) saved a final checkpoint. A
+        # divergence here is expected — ranks stop at whatever step the
+        # notice caught them on — so it must NOT read as a hang.
+        return Classification(
+            kind="preempt", stalled_ranks=[], crashed_ranks=[],
+            missing_dumps=missing, divergence=div, chaos_injected=chaos,
+            detail=(f"rank(s) {preempted} exited on a preemption notice "
+                    f"(SIGTERM → final checkpoint → graceful exit); "
+                    f"restart resumes from the final save")
+                   + _chaos_note(chaos),
         )
     if div is not None and div.missing_ranks:
         ref = div.reference()
         return Classification(
             kind="hang", stalled_ranks=div.missing_ranks,
             crashed_ranks=[], missing_dumps=missing, divergence=div,
+            chaos_injected=chaos,
             detail=(f"rank(s) {div.missing_ranks} never reached "
                     f"collective #{div.index} "
                     f"(op={ref.get('op')} step={ref.get('step')}) that "
-                    f"other ranks enqueued"),
+                    f"other ranks enqueued") + _chaos_note(chaos),
         )
     if div is not None:
         return Classification(
             kind="hang", stalled_ranks=[], crashed_ranks=[],
-            missing_dumps=missing, divergence=div,
+            missing_dumps=missing, divergence=div, chaos_injected=chaos,
             detail=(f"desync at collective #{div.index}: ranks recorded "
-                    f"different ops/bytes at the same program point"),
+                    f"different ops/bytes at the same program point")
+                   + _chaos_note(chaos),
         )
     if missing and dumps:
         return Classification(
             kind="crash", stalled_ranks=[], crashed_ranks=missing,
             missing_dumps=missing, divergence=None,
-            detail=f"rank(s) {missing} left no dump at all (died before "
-                   f"any trigger could fire)",
+            chaos_injected=chaos,
+            detail=(f"rank(s) {missing} left no dump at all (died "
+                    f"before any trigger could fire)")
+                   + _chaos_note(chaos),
         )
     rows = straggler_report(dumps)
     flagged = [r.rank for r in rows if r.flagged]
@@ -287,8 +337,10 @@ def classify(dumps: dict[int, RankDump],
         return Classification(
             kind="straggler", stalled_ranks=flagged, crashed_ranks=[],
             missing_dumps=missing, divergence=None,
-            detail=f"rank(s) {flagged} run ≥{STRAGGLER_FACTOR}x slower "
-                   f"than the median rank (see step percentiles)",
+            chaos_injected=chaos,
+            detail=(f"rank(s) {flagged} run ≥{STRAGGLER_FACTOR}x slower "
+                    f"than the median rank (see step percentiles)")
+                   + _chaos_note(chaos),
         )
     if hang_evidence:
         # everyone stalled at the same program point: the rank whose
@@ -302,14 +354,16 @@ def classify(dumps: dict[int, RankDump],
             stalled_ranks=[first_quiet] if first_quiet is not None
             else [],
             crashed_ranks=[], missing_dumps=missing, divergence=None,
-            detail="all ranks stalled at the same collective position; "
-                   f"rank {first_quiet} went quiet first",
+            chaos_injected=chaos,
+            detail=("all ranks stalled at the same collective position; "
+                    f"rank {first_quiet} went quiet first")
+                   + _chaos_note(chaos),
         )
     return Classification(
         kind="healthy", stalled_ranks=[], crashed_ranks=[],
-        missing_dumps=missing, divergence=None,
+        missing_dumps=missing, divergence=None, chaos_injected=chaos,
         detail="collective streams agree and no crash/hang trigger "
-               "fired",
+               "fired" + _chaos_note(chaos),
     )
 
 
@@ -365,6 +419,16 @@ def render_report(dumps: dict[int, RankDump],
             tail = d.collectives[-1] if d.collectives else None
             out(f"  rank {r}: MISSING — last collective "
                 f"{_fmt_event(tail) if tail else '(none recorded)'}")
+
+    chaos = {r: d.chaos_events for r, d in dumps.items()
+             if d.chaos_events}
+    if chaos:
+        out("")
+        out("injected chaos events (TPUNN_CHAOS — synthetic faults, "
+            "not organic):")
+        for r in sorted(chaos):
+            for ev in chaos[r]:
+                out(f"  rank {r}: {_fmt_event(ev)}")
 
     hung = {r: d.incomplete() for r, d in dumps.items()
             if d.incomplete()}

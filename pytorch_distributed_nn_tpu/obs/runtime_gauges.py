@@ -61,6 +61,32 @@ def update_heartbeat_gauges(registry: MetricRegistry | None = None) -> None:
         stats["suppressed"])
 
 
+def export_restart_gauges(*, incarnations: int, restarts: int,
+                          preempt_restarts: int,
+                          backoff_seconds_total: float,
+                          last_exit_code: int,
+                          registry: MetricRegistry | None = None) -> None:
+    """Agent-side restart-policy state (launch.ElasticAgent.run): how
+    many incarnations ran, how many restarts were charged to the
+    budget, how many were free preemption restarts, and the backoff
+    time spent — the 'lost time' side of the goodput ledger."""
+    reg = registry or get_registry()
+    reg.gauge("agent_incarnations_total",
+              "gang incarnations launched by this agent").set(
+        incarnations)
+    reg.gauge("agent_restarts_total",
+              "restarts charged against the budget").set(restarts)
+    reg.gauge("agent_preempt_restarts_total",
+              "free restarts after graceful preemption exits").set(
+        preempt_restarts)
+    reg.gauge("agent_backoff_seconds_total",
+              "seconds spent backing off between incarnations").set(
+        backoff_seconds_total)
+    reg.gauge("agent_last_exit_code",
+              "exit code of the last finished incarnation").set(
+        last_exit_code)
+
+
 def export_detector_gauges(detector,
                            registry: MetricRegistry | None = None) -> None:
     """Supervisor-side per-rank staleness gauges from a

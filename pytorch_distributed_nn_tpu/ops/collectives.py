@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from pytorch_distributed_nn_tpu.obs import flight as _flight
+from pytorch_distributed_nn_tpu.runtime import chaos as _chaos
 
 AxisName = str | tuple[str, ...]
 
@@ -139,6 +140,9 @@ def _record(op: str, x, axis: AxisName) -> None:
     # op/axis/bytes/shape in the flight recorder (obs/flight.py)
     _flight.on_collective(op, axis=str(axis), nbytes=payload,
                           shape=tuple(x.shape), dtype=str(x.dtype))
+    # chaos hook (runtime/chaos.py): an injected hang blocks HERE, the
+    # same program point a real deadlocked collective wedges
+    _chaos.on_collective(op)
 
 
 # ---------------------------------------------------------------------------

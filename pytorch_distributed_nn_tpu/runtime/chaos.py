@@ -1,0 +1,378 @@
+"""Chaos engine: seeded, deterministic fault injection (ISSUE 3).
+
+The detection half of the failure story (heartbeats, flight recorder,
+cross-rank doctor — PR 1/2) is only as trustworthy as the faults it has
+been shown. This module is the production-style fault *injector*: a
+``TPUNN_CHAOS=<spec>`` env contract parsed once per process into a
+:class:`ChaosEngine`, with hook points wired into the Trainer step loop
+(:func:`on_step`), the collective wrappers
+(``ops.collectives._record`` → :func:`on_collective`), the checkpoint
+writer (``train.checkpoint`` → :func:`on_checkpoint_saved`), and the
+native-store client (``runtime.native.StoreClient`` →
+:func:`on_store_op`).
+
+Spec grammar (faults joined by ``;``)::
+
+    spec  := fault (";" fault)*
+    fault := kind ["@" key "=" value (":" key "=" value)*]
+
+    crash@step=7[:rank=1][:inc=0]        os._exit(CRASH_EXIT_CODE) at the
+                                         start of step 7
+    hang@collective=all_reduce[:step=5][:rank=0][:ms=...]
+                                         sleep inside the collective
+                                         wrapper (default: effectively
+                                         forever) — the deadlocked-psum
+                                         stand-in
+    slow@rank=2:ms=200[:step=...]        sleep ms per step — straggler
+    preempt@step=9[:rank=...][:inc=...]  SIGTERM to self — preemption
+                                         notice (graceful-save path)
+    corrupt_ckpt@step=6[:rank=...]       garble the just-saved step's
+                                         array files — torn checkpoint
+    store_flaky@p=0.1[:rank=...]         each store op raises OSError
+                                         with probability p (seeded)
+
+``rank`` / ``inc`` (incarnation, from ``TPUNN_RESTART``) are optional
+filters; a fault without them fires in every process / incarnation.
+Collective names are the wrapper verbs (``all_reduce``, ``all_gather``,
+``reduce_scatter``, ``broadcast``, ``ppermute``, ``all_to_all``).
+
+Design contract (lint-enforced by tests/test_quality.py):
+
+- **inert when unset**: every ``on_*`` hook's first statement is the
+  ``_engine is None`` fast path — no parsing, no allocation, no env
+  read on the hot path when chaos is off;
+- **forensically visible**: every injected fault goes through
+  :meth:`ChaosEngine._emit`, which lands a ``chaos`` event in the
+  flight ring and bumps ``chaos_injected_total`` — post-mortems can
+  never misattribute an injected fault to a real one;
+- **deterministic**: ``store_flaky`` draws from a ``random.Random``
+  seeded by ``(TPUNN_CHAOS_SEED, rank)``, so a rerun injects the same
+  fault sequence.
+
+Stdlib + obs-only on purpose (no jax): faults fire from signal-adjacent
+paths and worker subprocesses that must not touch the backend.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import random
+import signal
+import time
+
+from pytorch_distributed_nn_tpu.obs import flight
+from pytorch_distributed_nn_tpu.obs.registry import get_registry
+
+log = logging.getLogger(__name__)
+
+ENV_CHAOS = "TPUNN_CHAOS"
+ENV_CHAOS_SEED = "TPUNN_CHAOS_SEED"
+
+# distinct from shell/signal conventions and the graceful-preempt code
+# (runtime.failure.GRACEFUL_EXIT_CODE): a chaos crash must read as a
+# plain worker crash to the agent
+CRASH_EXIT_CODE = 43
+
+# "forever" for an injected hang, far past any watchdog window
+DEFAULT_HANG_MS = 3_600_000.0
+
+FAULT_KINDS = ("crash", "hang", "slow", "preempt", "corrupt_ckpt",
+               "store_flaky")
+
+_INT_KEYS = ("step", "rank", "inc")
+_FLOAT_KEYS = ("ms", "p")
+_STR_KEYS = ("collective",)
+
+
+@dataclasses.dataclass
+class Fault:
+    kind: str
+    spec: str  # the fault's own slice of the spec string (diagnostics)
+    step: int | None = None
+    rank: int | None = None
+    inc: int | None = None
+    collective: str = ""
+    ms: float = 0.0
+    p: float = 0.0
+
+
+def parse_spec(spec: str) -> list[Fault]:
+    """Parse a ``TPUNN_CHAOS`` spec; raises ``ValueError`` with the
+    offending token on any grammar violation (a typo'd chaos spec must
+    fail loudly, not silently inject nothing)."""
+    faults: list[Fault] = []
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        kind, _, rest = part.partition("@")
+        kind = kind.strip()
+        if kind not in FAULT_KINDS:
+            raise ValueError(
+                f"unknown chaos fault {kind!r} in {part!r}; "
+                f"have {FAULT_KINDS}"
+            )
+        fault = Fault(kind=kind, spec=part)
+        for field in filter(None, rest.split(":")):
+            key, eq, value = field.partition("=")
+            if not eq:
+                raise ValueError(f"chaos field {field!r} in {part!r} "
+                                 f"is not key=value")
+            key = key.strip()
+            value = value.strip()
+            if key not in _INT_KEYS + _FLOAT_KEYS + _STR_KEYS:
+                raise ValueError(f"unknown chaos key {key!r} in {part!r}")
+            try:
+                if key in _INT_KEYS:
+                    setattr(fault, key, int(value))
+                elif key in _FLOAT_KEYS:
+                    setattr(fault, key, float(value))
+                else:
+                    setattr(fault, key, value)
+            except ValueError:
+                raise ValueError(
+                    f"bad value for chaos key {key!r} in {part!r}: "
+                    f"{value!r}"
+                ) from None
+        _validate(fault)
+        faults.append(fault)
+    if not faults:
+        raise ValueError(f"empty chaos spec {spec!r}")
+    return faults
+
+
+def _validate(fault: Fault) -> None:
+    need = {
+        "crash": ("step",), "preempt": ("step",),
+        "corrupt_ckpt": ("step",), "hang": ("collective",),
+        "slow": ("ms",), "store_flaky": ("p",),
+    }[fault.kind]
+    for key in need:
+        missing = (getattr(fault, key) in (None, "", 0.0)
+                   if key in ("collective", "ms", "p")
+                   else getattr(fault, key) is None)
+        if missing:
+            raise ValueError(
+                f"chaos fault {fault.spec!r} needs {key}= "
+                f"(e.g. {fault.kind}@{key}=...)"
+            )
+    if fault.kind == "store_flaky" and not 0.0 < fault.p <= 1.0:
+        raise ValueError(f"store_flaky p must be in (0, 1], got {fault.p}")
+
+
+class ChaosEngine:
+    """One process's parsed fault set + fire-once bookkeeping.
+
+    Hook methods are called through the module-level ``on_*`` wrappers
+    (never directly from library code) so the disabled fast path stays
+    a single attribute check.
+    """
+
+    def __init__(self, faults: list[Fault], *, rank: int,
+                 incarnation: int = 0, seed: int = 0) -> None:
+        self.faults = list(faults)
+        self.rank = rank
+        self.incarnation = incarnation
+        self.seed = seed
+        # deterministic per-(seed, rank) stream: reruns inject the same
+        # store_flaky sequence on every rank
+        self._rng = random.Random((seed << 8) ^ rank)
+        self._fired: set[int] = set()  # fault ids that fire once
+        self._step = 0  # last step seen via on_step
+
+    def _matches(self, fault: Fault, *, step: int | None = None) -> bool:
+        if fault.rank is not None and fault.rank != self.rank:
+            return False
+        if fault.inc is not None and fault.inc != self.incarnation:
+            return False
+        if fault.step is not None and step is not None \
+                and fault.step != step:
+            return False
+        return True
+
+    def _emit(self, fault: Fault, *, step: int | None = None,
+              note: str = "") -> None:
+        """Every injected fault is observable: a ``chaos`` event in the
+        flight ring (post-mortems see it) + a labelled counter."""
+        flight.record("chaos", fault.kind,
+                      step=self._step if step is None else step,
+                      note=note or fault.spec)
+        get_registry().counter(
+            "chaos_injected_total", "chaos faults injected",
+            labels=("kind",)).inc(kind=fault.kind)
+        log.warning("chaos: injecting %s (rank %d, step %d)",
+                    fault.spec, self.rank, step if step is not None
+                    else self._step)
+
+    # -- hook bodies -----------------------------------------------------
+
+    def step(self, step: int) -> None:
+        self._step = int(step)
+        for i, fault in enumerate(self.faults):
+            if not self._matches(fault, step=step):
+                continue
+            if fault.kind == "slow":
+                self._inject_slow(fault)
+            elif i in self._fired:
+                continue
+            elif fault.kind == "crash":
+                self._fired.add(i)
+                self._inject_crash(fault)
+            elif fault.kind == "preempt":
+                self._fired.add(i)
+                self._inject_preempt(fault)
+
+    def collective(self, op: str) -> None:
+        for i, fault in enumerate(self.faults):
+            if (fault.kind != "hang" or i in self._fired
+                    or fault.collective != op
+                    or not self._matches(fault, step=self._step)):
+                continue
+            self._fired.add(i)
+            self._inject_hang(fault)
+
+    def checkpoint_saved(self, manager, step: int) -> None:
+        for i, fault in enumerate(self.faults):
+            if (fault.kind != "corrupt_ckpt" or i in self._fired
+                    or not self._matches(fault, step=step)):
+                continue
+            self._fired.add(i)
+            self._inject_corrupt_ckpt(fault, manager, step)
+
+    def store_op(self, op: str, key: str = "") -> None:
+        for fault in self.faults:
+            if fault.kind != "store_flaky" or not self._matches(fault):
+                continue
+            if self._rng.random() < fault.p:
+                self._inject_store_flaky(fault, op, key)
+
+    # -- injections (each one _emits first: lint-enforced) ---------------
+
+    def _inject_crash(self, fault: Fault) -> None:
+        self._emit(fault)
+        # the ring must reach disk: os._exit skips excepthooks/atexit
+        flight.dump_now(f"chaos:{fault.spec}", force=True)
+        os._exit(CRASH_EXIT_CODE)
+
+    def _inject_hang(self, fault: Fault) -> None:
+        self._emit(fault)
+        time.sleep((fault.ms or DEFAULT_HANG_MS) / 1000.0)
+
+    def _inject_slow(self, fault: Fault) -> None:
+        self._emit(fault)
+        time.sleep(fault.ms / 1000.0)
+
+    def _inject_preempt(self, fault: Fault) -> None:
+        self._emit(fault)
+        # the real preemption notice: the worker's SIGTERM handler
+        # (runtime.failure) finishes the step, saves, exits graceful
+        os.kill(os.getpid(), signal.SIGTERM)
+
+    def _inject_corrupt_ckpt(self, fault: Fault, manager,
+                             step: int) -> None:
+        self._emit(fault, step=step)
+        manager.wait()  # the torn step must be fully on disk first
+        corrupt_step_dir(os.path.join(str(manager.directory), str(step)))
+
+    def _inject_store_flaky(self, fault: Fault, op: str,
+                            key: str) -> None:
+        self._emit(fault, note=f"{fault.spec} [{op} {key}]")
+        raise OSError(f"chaos: injected store fault on {op}({key!r})")
+
+
+def corrupt_step_dir(step_dir: str) -> int:
+    """Garble every array payload under one checkpoint step directory
+    (same length, garbage bytes), leaving commit metadata intact so the
+    step still *looks* valid — the torn-write failure mode
+    ``CheckpointManager.restore`` must survive. Returns files touched."""
+    touched = 0
+    for dirpath, _dirnames, filenames in os.walk(step_dir):
+        for name in filenames:
+            if name.startswith(("_", ".")) or "METADATA" in name.upper():
+                continue  # keep the step listed; tear only the payload
+            path = os.path.join(dirpath, name)
+            try:
+                size = max(os.path.getsize(path), 4)
+                garbage = (b"\xde\xc0\xad\xde" * (size // 4 + 1))[:size]
+                with open(path, "r+b") as f:
+                    f.write(garbage)
+                touched += 1
+            except OSError:
+                continue
+    return touched
+
+
+# ---------------------------------------------------------------------------
+# Module singleton + the hot-path hooks
+# ---------------------------------------------------------------------------
+
+_engine: ChaosEngine | None = None
+
+
+def maybe_init(spec: str | None = None, *, rank: int | None = None,
+               incarnation: int | None = None,
+               seed: int | None = None) -> ChaosEngine | None:
+    """Build the process engine from ``TPUNN_CHAOS`` (or an explicit
+    ``spec``). No-op (and allocation-free beyond one env read) when the
+    env is unset; idempotent when set."""
+    global _engine
+    if _engine is not None:
+        return _engine
+    spec = os.environ.get(ENV_CHAOS) if spec is None else spec
+    if not spec:
+        return None
+    _engine = ChaosEngine(
+        parse_spec(spec),
+        rank=flight.default_rank() if rank is None else rank,
+        incarnation=int(os.environ.get("TPUNN_RESTART", "0"))
+        if incarnation is None else incarnation,
+        seed=int(os.environ.get(ENV_CHAOS_SEED, "0"))
+        if seed is None else seed,
+    )
+    log.warning("chaos engine armed: %s (rank %d, incarnation %d)",
+                spec, _engine.rank, _engine.incarnation)
+    return _engine
+
+
+def enabled() -> bool:
+    return _engine is not None
+
+
+def engine() -> ChaosEngine | None:
+    return _engine
+
+
+def reset() -> None:
+    """Disarm (test isolation)."""
+    global _engine
+    _engine = None
+
+
+def on_step(step: int) -> None:
+    """Trainer step-loop hook (crash / slow / preempt)."""
+    if _engine is None:
+        return
+    _engine.step(step)
+
+
+def on_collective(op: str) -> None:
+    """``ops.collectives._record`` hook (hang)."""
+    if _engine is None:
+        return
+    _engine.collective(op)
+
+
+def on_checkpoint_saved(manager, step: int) -> None:
+    """``train.checkpoint.CheckpointManager.save`` hook (corrupt_ckpt)."""
+    if _engine is None:
+        return
+    _engine.checkpoint_saved(manager, step)
+
+
+def on_store_op(op: str, key: str = "") -> None:
+    """``runtime.native.StoreClient`` hook (store_flaky)."""
+    if _engine is None:
+        return
+    _engine.store_op(op, key)

@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import logging
 import os
+import signal
 import threading
 import time
 
@@ -54,6 +55,14 @@ ENV_STORE_HOST = "TPUNN_STORE_HOST"
 ENV_RESTART = "TPUNN_RESTART"          # incarnation index (0 on first launch)
 ENV_HB_INTERVAL = "TPUNN_HEARTBEAT_INTERVAL"
 ENV_PROGRESS_WINDOW = "TPUNN_PROGRESS_WINDOW"
+ENV_PREEMPT = "TPUNN_PREEMPT"  # "1" forces preemption handling on
+
+# Worker exit code for a *graceful* preemption exit (SIGTERM → finish
+# the in-flight step → synchronous checkpoint save → exit). The elastic
+# agent restarts on it WITHOUT charging the restart budget — a
+# preempted worker did nothing wrong. Distinct from chaos.CRASH_EXIT_CODE
+# and outside the 128+N signal-kill convention.
+GRACEFUL_EXIT_CODE = 83
 
 
 def _hb_key(incarnation: int, rank: int) -> str:
@@ -256,6 +265,75 @@ def notify_done() -> None:
         _reporter.disarm()
 
 
+# ---------------------------------------------------------------------------
+# Worker-side preemption handling (SIGTERM → cooperative graceful exit)
+# ---------------------------------------------------------------------------
+
+_preempt_flag = threading.Event()
+_preempt_prev_handler = None
+_preempt_installed = False
+
+
+def install_preemption_handler(force: bool = False) -> bool:
+    """SIGTERM becomes a *preemption notice* instead of an immediate
+    kill: the handler only sets a flag (and snapshots the flight ring);
+    the training loop notices it at the next step boundary, forces a
+    synchronous checkpoint save, and exits ``GRACEFUL_EXIT_CODE``.
+
+    Installed only when it can matter: under the elastic agent
+    (``TPUNN_STORE_PORT`` set — the agent classifies the graceful code)
+    or when ``TPUNN_PREEMPT=1`` / ``force`` asks for it (bare runs on
+    preemptible VMs). Main-thread only (signal API constraint);
+    idempotent. Returns True when the handler is active."""
+    global _preempt_installed, _preempt_prev_handler
+    if _preempt_installed:
+        return True
+    if not force and not os.environ.get(ENV_STORE_PORT) \
+            and os.environ.get(ENV_PREEMPT, "0") != "1":
+        return False
+
+    def _handler(signum, frame):
+        # flag-only + ring snapshot: no locks we might already hold
+        # beyond what the flight dump path has always taken
+        _preempt_flag.set()
+        try:
+            flight.dump_now("preempt:SIGTERM", force=True)
+        except Exception:
+            pass
+
+    try:
+        _preempt_prev_handler = signal.signal(signal.SIGTERM, _handler)
+    except ValueError:  # not the main thread
+        return False
+    _preempt_installed = True
+    return True
+
+
+def uninstall_preemption_handler() -> None:
+    """Restore the previous SIGTERM disposition (Trainer.close)."""
+    global _preempt_installed, _preempt_prev_handler
+    if not _preempt_installed:
+        return
+    try:
+        signal.signal(signal.SIGTERM, _preempt_prev_handler)
+    except (ValueError, TypeError):
+        pass
+    _preempt_installed = False
+    _preempt_prev_handler = None
+    _preempt_flag.clear()
+
+
+def preempt_requested() -> bool:
+    """True once a preemption notice (SIGTERM) has arrived."""
+    return _preempt_flag.is_set()
+
+
+def request_preemption() -> None:
+    """Programmatic preemption notice (tests / cluster integrations that
+    learn about preemption out-of-band rather than via SIGTERM)."""
+    _preempt_flag.set()
+
+
 class FailureDetector:
     """Supervisor-side staleness check over the workers' heartbeat keys.
 
@@ -276,6 +354,17 @@ class FailureDetector:
         # rank -> number of times it has been reported stale (the
         # supervisor-side missed-beat gauge, obs/runtime_gauges.py)
         self.missed_counts: dict[int, int] = {r: 0 for r in self._ranks}
+
+    def any_beats(self) -> bool:
+        """Whether ANY watched rank has ever heartbeaten this
+        incarnation — the restart policy's fail-fast discriminator
+        (a gang that died before its first beat is a startup crash,
+        not a mid-training fault)."""
+        try:
+            return any(a is not None
+                       for a in self.last_beat_ages().values())
+        except OSError:
+            return False
 
     def last_beat_ages(self) -> dict[int, float | None]:
         """Per-rank seconds since the last beat (None = never beaten) —

@@ -23,6 +23,8 @@ from pathlib import Path
 
 import numpy as np
 
+from pytorch_distributed_nn_tpu.runtime import chaos
+
 _NATIVE_DIR = Path(__file__).resolve().parents[2] / "native"
 _LIB_PATH = _NATIVE_DIR / "libtpunative.so"
 _lock = threading.Lock()
@@ -139,6 +141,7 @@ class StoreClient:
         self._barrier_round: dict[str, int] = {}
 
     def set(self, key: str, value: bytes) -> None:
+        chaos.on_store_op("set", key)  # store_flaky injection point
         buf = (ctypes.c_uint8 * len(value)).from_buffer_copy(value or b"\0")
         rc = self._lib.tpustore_set(self._h, key.encode(), buf, len(value))
         if rc != 0:
@@ -147,6 +150,7 @@ class StoreClient:
     def get(self, key: str, *, timeout_ms: int = -1,
             max_bytes: int = 1 << 20) -> bytes:
         """Blocking wait for ``key`` (timeout_ms < 0 waits forever)."""
+        chaos.on_store_op("get", key)  # store_flaky injection point
         cap = max_bytes
         while True:
             buf = (ctypes.c_uint8 * cap)()
@@ -162,12 +166,14 @@ class StoreClient:
             return bytes(buf[:rc])
 
     def add(self, key: str, delta: int = 1) -> int:
+        chaos.on_store_op("add", key)  # store_flaky injection point
         out = self._lib.tpustore_add(self._h, key.encode(), delta)
         if out == -(2 ** 63):
             raise OSError(f"store add({key!r}) failed")
         return out
 
     def check(self, key: str) -> bool:
+        chaos.on_store_op("check", key)  # store_flaky injection point
         rc = self._lib.tpustore_check(self._h, key.encode())
         if rc < 0:
             raise OSError(f"store check({key!r}) failed")

@@ -34,6 +34,7 @@ import orbax.checkpoint as ocp
 
 from pytorch_distributed_nn_tpu import obs
 from pytorch_distributed_nn_tpu.obs import flight
+from pytorch_distributed_nn_tpu.runtime import chaos
 from pytorch_distributed_nn_tpu.train.state import TrainState
 
 log = logging.getLogger(__name__)
@@ -88,6 +89,10 @@ class CheckpointManager:
                 "checkpoint_saves_total", "checkpoint saves queued").inc()
             log.info("queued checkpoint save at step %d -> %s", step,
                      self.directory)
+            # chaos hook (runtime/chaos.py corrupt_ckpt): tears THIS
+            # step's files after the write lands — the torn-latest
+            # failure mode restore's fallback path covers
+            chaos.on_checkpoint_saved(self, step)
         return saved
 
     # -- restore ---------------------------------------------------------
@@ -99,12 +104,45 @@ class CheckpointManager:
                 step: int | None = None) -> tuple[TrainState, dict]:
         """Restore into the layout of ``template`` (its shardings define
         the target placement — resume works across topology changes).
-        Returns ``(state, meta)``."""
-        step = self.latest_step() if step is None else step
-        if step is None:
+        Returns ``(state, meta)``.
+
+        Integrity fallback: with no explicit ``step``, a torn/corrupt
+        latest step (killed mid-write, bit rot, injected chaos) falls
+        back to the next-newest kept step instead of raising — losing a
+        checkpoint interval beats losing the job. Each skip increments
+        ``checkpoint_restore_fallbacks_total`` and lands a flight event.
+        An explicitly requested step still raises: the caller asked for
+        exactly that state."""
+        if step is not None:
+            return self._restore_step(template, step)
+        steps = sorted(self.all_steps(), reverse=True)
+        if not steps:
             raise FileNotFoundError(
                 f"no checkpoint found under {self.directory}"
             )
+        last_err: Exception | None = None
+        for i, s in enumerate(steps):
+            try:
+                return self._restore_step(template, s)
+            except Exception as e:  # noqa: BLE001 — orbax raises many
+                last_err = e
+                obs.get_registry().counter(
+                    "checkpoint_restore_fallbacks_total",
+                    "restores that skipped a torn/corrupt step").inc()
+                flight.record("checkpoint", "restore_fallback", step=s,
+                              note=f"{type(e).__name__}")
+                log.warning(
+                    "checkpoint step %d is torn/corrupt (%s: %s); "
+                    "falling back to %s", s, type(e).__name__, e,
+                    steps[i + 1] if i + 1 < len(steps) else "nothing",
+                )
+        raise RuntimeError(
+            f"every kept checkpoint step {steps} under {self.directory} "
+            f"failed to restore"
+        ) from last_err
+
+    def _restore_step(self, template: TrainState,
+                      step: int) -> tuple[TrainState, dict]:
         abstract = jax.tree.map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
             if isinstance(x, jax.Array) else x,

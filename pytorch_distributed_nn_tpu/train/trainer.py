@@ -24,6 +24,7 @@ from pytorch_distributed_nn_tpu.obs import aggregate as obs_aggregate
 from pytorch_distributed_nn_tpu.obs import flight
 from pytorch_distributed_nn_tpu.obs import runtime_gauges
 from pytorch_distributed_nn_tpu.ops import collectives as cc
+from pytorch_distributed_nn_tpu.runtime import chaos
 from pytorch_distributed_nn_tpu.runtime import failure
 from pytorch_distributed_nn_tpu.parallel import make_train_step
 from pytorch_distributed_nn_tpu.runtime.mesh import make_mesh
@@ -63,6 +64,10 @@ from pytorch_distributed_nn_tpu.data.datasets import (
 class Trainer:
     def __init__(self, cfg: TrainConfig, mesh=None) -> None:
         self.cfg = cfg
+        # chaos engine (TPUNN_CHAOS): armed once per process, inert and
+        # allocation-free on the step path when the env is unset
+        chaos.maybe_init()
+        self._preemptible = False
         self.mesh = mesh if mesh is not None else make_mesh(
             cfg.mesh.resolve(len(jax.devices()))
         )
@@ -147,6 +152,10 @@ class Trainer:
             if self.metrics is not None:
                 self.metrics.close()
             raise
+        # preemption notice handling (SIGTERM → finish step → sync save
+        # → GRACEFUL_EXIT_CODE); no-op outside the agent/TPUNN_PREEMPT.
+        # Installed last so a failed constructor can't leak the handler.
+        self._preemptible = failure.install_preemption_handler()
 
     # context manager: `with Trainer(cfg) as t:` closes the metrics
     # JSONL handle and drains async checkpoint writes on ANY exit path
@@ -230,6 +239,7 @@ class Trainer:
             # collective records inherit this step, and per-rank step
             # timestamps drive obs_doctor's straggler percentiles
             flight.mark_step(g)
+            chaos.on_step(g)  # fault injection point (crash/slow/preempt)
             if i == 0 and gp.wire_bytes_per_step is None:
                 # trace-time collective accounting rides the first
                 # dispatch (the call that traces step_fn): recorded
@@ -293,12 +303,39 @@ class Trainer:
             self._h_step.observe(bd.wall_s)
             if logged:
                 self._flush_telemetry(step=g - 1)
+            if failure.preempt_requested():
+                self._graceful_preempt(g)
         # sync before returning so wall-clock timings are honest
         jax.block_until_ready(self.state.params)
         # Post-loop work (checkpoint drain, eval) is unbounded: back to
         # liveness-only heartbeats so it can't read as a hang.
         failure.notify_done()
         return self.history
+
+    def _graceful_preempt(self, step: int) -> None:
+        """Preemption notice arrived (SIGTERM → runtime.failure flag):
+        the in-flight step has completed, so force a SYNCHRONOUS
+        checkpoint save and exit with the graceful code the elastic
+        agent does not charge against the restart budget. Raises
+        ``SystemExit`` — the ``with Trainer(...)`` context and the
+        worker script's normal exit path still run."""
+        log.warning("preemption notice at step %d: saving final "
+                    "checkpoint and exiting gracefully", step)
+        flight.record("preempt", "graceful_exit", step=step)
+        if self.ckpt is not None:
+            with self.goodput.phase("checkpoint"):
+                self.ckpt.save(self.state, data_step=self.data_step,
+                               force=True)
+                self.ckpt.wait()  # synchronous: the process is dying
+        obs.get_registry().counter(
+            "preempt_exits_total", "graceful preemption exits").inc()
+        if self.metrics is not None:
+            self.metrics.emit("preempt", step=step - 1,
+                              data_step=self.data_step,
+                              saved=self.ckpt is not None)
+        failure.notify_done()
+        flight.dump_now("preempt:graceful_exit", force=True)
+        raise SystemExit(failure.GRACEFUL_EXIT_CODE)
 
     def _flush_telemetry(self, step: int) -> None:
         """Log-cadence telemetry fanout: goodput window -> JSONL,
@@ -390,6 +427,7 @@ class Trainer:
                 else:
                     xs, ys = next(batches)
             flight.mark_step(self.data_step + 1, note=f"k={k_eff}")
+            chaos.on_step(self.data_step + 1)  # fault injection point
             with gp.phase("compute"):
                 with flight.dispatch("multistep", step=self.data_step + 1,
                                      note=f"k={k_eff}"):
@@ -452,6 +490,8 @@ class Trainer:
             self._h_step.observe(bd.wall_s)
             if logged:
                 self._flush_telemetry(step=g - 1)
+            if failure.preempt_requested():
+                self._graceful_preempt(g)
         # execution fence: ONE scalar device_get of the final fused
         # loss (which depends on every prior step). block_until_ready
         # here would issue one sync RPC per param leaf — measured
@@ -563,6 +603,8 @@ class Trainer:
                               force=force)
 
     def close(self) -> None:
+        if self._preemptible:
+            failure.uninstall_preemption_handler()
         if self.ckpt is not None:
             self.ckpt.close()
         if self.metrics is not None:

@@ -7,8 +7,10 @@ docs/observability.md "Flight recorder") left ``flight_rank<k>.json``
 files next to the run's JSONL. This tool merges them, aligns the
 per-rank collective streams, names the **first divergent collective**
 (op + seq + step) and the **stalled rank**, classifies the failure
-(hang vs crash vs straggler), and prints per-rank step-time
-percentiles so a slow rank stands out even when nothing diverged.
+(hang vs crash vs graceful preemption vs straggler), surfaces any
+injected TPUNN_CHAOS faults so synthetic failures can't be
+misattributed, and prints per-rank step-time percentiles so a slow
+rank stands out even when nothing diverged.
 
 Usage:
     python scripts/obs_doctor.py RUNDIR              # globs flight_rank*.json
@@ -47,6 +49,11 @@ def _analyze(paths_or_dir, expect_ranks: int | None, last: int,
             "crashed_ranks": cls.crashed_ranks,
             "missing_dumps": cls.missing_dumps,
             "detail": cls.detail,
+            # injected-fault accounting (runtime/chaos.py): a nonzero
+            # count flags the run as a TPUNN_CHAOS test, so automated
+            # post-mortems don't page anyone over a synthetic failure
+            "chaos_injected": {str(r): n
+                               for r, n in cls.chaos_injected.items()},
             "divergence": None if div is None else {
                 "index": div.index,
                 "kind": div.kind,

@@ -250,6 +250,91 @@ def test_forensics_classifies_crash(tmp_path):
     assert cls.kind == "crash" and cls.crashed_ranks == [0]
 
 
+def test_forensics_classifies_graceful_preempt(tmp_path):
+    """ISSUE 3 satellite: a graceful preemption (SIGTERM → final save →
+    exit) gets its own verdict — neither crash nor hang, even though
+    the ranks' streams diverge (they stop wherever the notice caught
+    them)."""
+    for rank in range(2):
+        rec = flight.FlightRecorder(capacity=64, enabled=True)
+        # ranks stop at different steps: divergence is EXPECTED
+        for step in range(5 + rank):
+            rec.mark_step(step)
+            with rec.collective("all_reduce", axis="data", nbytes=64,
+                                step=step):
+                pass
+        rec.record("preempt", "graceful_exit", step=5 + rank)
+        rec.dump("preempt:SIGTERM", directory=tmp_path, rank=rank)
+    dumps = forensics.load_dumps(tmp_path)
+    cls = forensics.classify(dumps, expected_ranks=[0, 1])
+    assert cls.kind == "preempt", cls
+    assert cls.stalled_ranks == [] and cls.crashed_ranks == []
+    assert "preemption" in cls.detail
+    report = forensics.render_report(dumps, [0, 1])
+    assert "PREEMPT" in report
+
+
+def test_forensics_crash_beats_preempt(tmp_path):
+    """One rank crashed, the other exited on the preemption notice: the
+    crash is the story."""
+    dumps = _synth_dumps(tmp_path, reason_for={
+        0: "exception:ValueError", 1: "preempt:SIGTERM",
+        2: "supervisor:stale"})
+    cls = forensics.classify(dumps)
+    assert cls.kind == "crash" and cls.crashed_ranks == [0]
+
+
+def test_forensics_surfaces_injected_chaos(tmp_path):
+    """ISSUE 3 satellite: injected chaos events in the rings are
+    surfaced in the classification and the report, so a post-mortem of
+    a TPUNN_CHAOS run can't be mistaken for an organic failure."""
+    for rank in range(3):
+        rec = flight.FlightRecorder(capacity=256, enabled=True)
+        for step in range(6):
+            rec.mark_step(step)
+            if step == 5:
+                if rank == 1:
+                    rec.record("chaos", "hang", step=step,
+                               note="hang@collective=all_reduce:step=5")
+                    break
+                rec.record("collective", "all_reduce", axis="data",
+                           nbytes=64, step=step, complete=False)
+                break
+            with rec.collective("all_reduce", axis="data", nbytes=64,
+                                step=step):
+                pass
+        rec.dump("progress_watchdog" if rank == 1 else
+                 "supervisor:stale", directory=tmp_path, rank=rank)
+    dumps = forensics.load_dumps(tmp_path)
+    assert dumps[1].chaos_events and not dumps[0].chaos_events
+    cls = forensics.classify(dumps, expected_ranks=[0, 1, 2])
+    assert cls.kind == "hang" and cls.stalled_ranks == [1]
+    assert cls.chaos_injected == {1: 1}
+    assert "chaos" in cls.detail
+    report = forensics.render_report(dumps, [0, 1, 2])
+    assert "injected chaos events" in report
+    assert "chaos/hang" in report
+
+    # and the doctor's --json carries the attribution
+    import io
+    import contextlib
+    import importlib.util
+    import pathlib
+
+    repo = pathlib.Path(__file__).parent.parent
+    spec = importlib.util.spec_from_file_location(
+        "obs_doctor", repo / "scripts" / "obs_doctor.py")
+    doctor = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(doctor)
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = doctor.main([str(tmp_path), "--json"])
+    assert rc == 0
+    payload = json.loads(buf.getvalue())
+    assert payload["classification"] == "hang"
+    assert payload["chaos_injected"] == {"1": 1}
+
+
 def test_forensics_missing_dump_is_reported(tmp_path):
     dumps = _synth_dumps(tmp_path, world=2, hang_rank=99)  # no hang
     cls = forensics.classify(dumps, expected_ranks=[0, 1, 2])

@@ -8,14 +8,17 @@ subprocess-cheap (numpy-only workers; no jax import on the hot paths).
 """
 
 import os
+import signal
+import subprocess
 import sys
 import textwrap
+import time
 
 import numpy as np
 import pytest
 
 from pytorch_distributed_nn_tpu.launch import LaunchConfig, launch
-from pytorch_distributed_nn_tpu.runtime import native
+from pytorch_distributed_nn_tpu.runtime import failure, native
 
 pytestmark = pytest.mark.skipif(
     not native.available(), reason="native store not built"
@@ -79,7 +82,8 @@ def test_crash_restart_resumes_from_checkpoint(tmp_path):
             f.write(str(first_step))
     """)
     result = launch([script, str(tmp_path)],
-                    LaunchConfig(nprocs=2, max_restarts=2))
+                    LaunchConfig(nprocs=2, max_restarts=2,
+                                 backoff_base_s=0.05))
     assert result.exit_code == 0
     assert result.restarts == 1
     assert int(np.load(tmp_path / "state.npy")) == 10
@@ -91,9 +95,98 @@ def test_crash_restart_resumes_from_checkpoint(tmp_path):
 
 def test_restart_budget_exhausted(tmp_path):
     script = _write(tmp_path, "worker.py", "import os; os._exit(3)")
-    result = launch([script], LaunchConfig(nprocs=2, max_restarts=1))
+    result = launch([script], LaunchConfig(nprocs=2, max_restarts=1,
+                                           backoff_base_s=0.05))
     assert result.exit_code == 3
     assert result.restarts == 1
+    # per-incarnation history rides the result
+    assert [r.reason for r in result.incarnations] == ["crash", "crash"]
+    assert [r.code for r in result.incarnations] == [3, 3]
+    assert all(r.duration_s > 0 for r in result.incarnations)
+
+
+def test_failfast_on_repeated_startup_crash(tmp_path):
+    """The same exit code twice before any heartbeat (here: instantly,
+    under the duration heuristic) is a deterministic startup crash —
+    the agent must stop burning its budget on it."""
+    script = _write(tmp_path, "worker.py", "import os; os._exit(7)")
+    result = launch([script], LaunchConfig(nprocs=2, max_restarts=10,
+                                           backoff_base_s=0.05))
+    assert result.exit_code == 7
+    assert result.restarts == 1  # one restart granted, then failfast
+    assert "failfast" in result.stop_reason
+    assert len(result.incarnations) == 2
+
+
+def test_graceful_preempt_exit_restart_is_free(tmp_path):
+    """A worker exiting GRACEFUL_EXIT_CODE (SIGTERM → final save path)
+    is restarted WITHOUT charging the restart budget: max_restarts=0
+    still allows the preemption restart, and the resumed gang finishes."""
+    script = _write(tmp_path, "worker.py", f"""
+        import os, sys
+        incarnation = int(os.environ["TPUNN_RESTART"])
+        rank = os.environ["RANK"]
+        with open(f"{{sys.argv[1]}}/ran{{rank}}_{{incarnation}}", "w"):
+            pass
+        if incarnation == 0:
+            sys.exit({failure.GRACEFUL_EXIT_CODE})  # "preempted"
+    """)
+    result = launch([script, str(tmp_path)],
+                    LaunchConfig(nprocs=2, max_restarts=0))
+    assert result.exit_code == 0
+    assert result.restarts == 1
+    assert result.incarnations[0].reason == "preempt"
+    assert result.incarnations[0].code == failure.GRACEFUL_EXIT_CODE
+    assert result.incarnations[1].reason == "ok"
+    assert (tmp_path / "ran0_1").exists()
+
+
+@pytest.mark.parametrize("signum", [signal.SIGINT, signal.SIGHUP])
+def test_agent_signal_propagates_to_gang(tmp_path, signum):
+    """ISSUE 3 satellite: Ctrl-C (SIGINT) or a lost terminal (SIGHUP)
+    hitting the AGENT must tear the workers down too — an interactive
+    interrupt can't orphan the gang."""
+    worker = _write(tmp_path, "worker.py", """
+        import os, sys, time
+        with open(f"{sys.argv[1]}/pid{os.environ['RANK']}", "w") as f:
+            f.write(str(os.getpid()))
+        time.sleep(600)
+    """)
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.Popen(
+        [sys.executable, "-m", "pytorch_distributed_nn_tpu.launch",
+         "--nprocs", "2", "--", worker, str(tmp_path)],
+        cwd=repo, stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL,
+    )
+    try:
+        deadline = time.time() + 60
+        while time.time() < deadline:
+            if all((tmp_path / f"pid{r}").exists() for r in range(2)):
+                break
+            time.sleep(0.05)
+        pids = [int((tmp_path / f"pid{r}").read_text()) for r in range(2)]
+        proc.send_signal(signum)
+        rc = proc.wait(timeout=30)
+        # the agent re-raised the signal after killing the gang
+        assert rc == -signum, rc
+        deadline = time.time() + 15
+        while time.time() < deadline:
+            alive = []
+            for pid in pids:
+                try:
+                    os.kill(pid, 0)
+                    alive.append(pid)
+                except ProcessLookupError:
+                    pass
+            if not alive:
+                break
+            time.sleep(0.1)
+        assert not alive, f"workers {alive} orphaned after "\
+                          f"{signal.Signals(signum).name}"
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait()
 
 
 def test_hang_detected_by_heartbeat(tmp_path):
@@ -116,7 +209,7 @@ def test_hang_detected_by_heartbeat(tmp_path):
     result = launch(
         [script, str(tmp_path)],
         LaunchConfig(nprocs=2, max_restarts=1, heartbeat_timeout_s=20.0,
-                     heartbeat_interval_s=0.2,
+                     heartbeat_interval_s=0.2, backoff_base_s=0.05,
                      env={"PYTHONPATH": os.path.dirname(os.path.dirname(
                          os.path.abspath(__file__)))}),
     )
@@ -151,6 +244,7 @@ def test_progress_watchdog_catches_live_but_stuck_worker(tmp_path):
         [script, str(tmp_path)],
         LaunchConfig(nprocs=2, max_restarts=1, heartbeat_timeout_s=15.0,
                      heartbeat_interval_s=0.2, progress_timeout_s=1.0,
+                     backoff_base_s=0.05,
                      env={"PYTHONPATH": os.path.dirname(os.path.dirname(
                          os.path.abspath(__file__)))}),
     )

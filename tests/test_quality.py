@@ -133,6 +133,72 @@ def test_every_collective_wrapper_goes_through_record_hook():
     )
 
 
+_CHAOS = (Path(__file__).parent.parent / "pytorch_distributed_nn_tpu"
+          / "runtime" / "chaos.py")
+
+
+def test_chaos_hooks_are_provably_inert_when_unset():
+    """ISSUE 3 lint: every public ``on_*`` hook in runtime/chaos.py must
+    open with the literal ``if _engine is None: return`` fast path — no
+    parsing, no allocation, no env read can precede it, so an unset
+    ``TPUNN_CHAOS`` costs one global load + one comparison per hook."""
+    tree = ast.parse(_CHAOS.read_text())
+    hooks = [n for n in tree.body if isinstance(n, ast.FunctionDef)
+             and n.name.startswith("on_")]
+    assert len(hooks) >= 4, "expected on_step/on_collective/" \
+                            "on_checkpoint_saved/on_store_op hooks"
+    for fn in hooks:
+        first = fn.body[0]
+        if isinstance(first, ast.Expr) and isinstance(
+                first.value, ast.Constant):  # docstring
+            first = fn.body[1]
+        ok = (isinstance(first, ast.If)
+              and isinstance(first.test, ast.Compare)
+              and isinstance(first.test.left, ast.Name)
+              and first.test.left.id == "_engine"
+              and len(first.test.ops) == 1
+              and isinstance(first.test.ops[0], ast.Is)
+              and isinstance(first.test.comparators[0], ast.Constant)
+              and first.test.comparators[0].value is None
+              and len(first.body) == 1
+              and isinstance(first.body[0], ast.Return))
+        assert ok, (f"chaos.{fn.name} must start with "
+                    f"'if _engine is None: return' (the disabled "
+                    f"fast path)")
+
+
+def test_every_chaos_fault_kind_emits_a_flight_event():
+    """ISSUE 3 lint: every fault kind in FAULT_KINDS must have an
+    ``_inject_<kind>`` method on ChaosEngine whose FIRST action is
+    ``self._emit(...)`` (the flight-ring + counter fanout) — a fault
+    type must not be able to fire invisibly to post-mortems."""
+    tree = ast.parse(_CHAOS.read_text())
+    kinds_node = next(
+        n.value for n in tree.body if isinstance(n, ast.Assign)
+        and any(getattr(t, "id", "") == "FAULT_KINDS" for t in n.targets)
+    )
+    kinds = ast.literal_eval(kinds_node)
+    assert set(kinds) >= {"crash", "hang", "slow", "preempt",
+                          "corrupt_ckpt", "store_flaky"}
+    engine = next(n for n in tree.body if isinstance(n, ast.ClassDef)
+                  and n.name == "ChaosEngine")
+    injectors = {n.name: n for n in engine.body
+                 if isinstance(n, ast.FunctionDef)
+                 and n.name.startswith("_inject_")}
+    missing = [k for k in kinds if f"_inject_{k}" not in injectors]
+    assert not missing, f"fault kinds without injector methods: {missing}"
+    for kind in kinds:
+        fn = injectors[f"_inject_{kind}"]
+        first = fn.body[0]
+        is_emit = (isinstance(first, ast.Expr)
+                   and isinstance(first.value, ast.Call)
+                   and isinstance(first.value.func, ast.Attribute)
+                   and first.value.func.attr == "_emit")
+        assert is_emit, (f"_inject_{kind} must call self._emit FIRST so "
+                         f"the flight ring records the fault before it "
+                         f"takes effect")
+
+
 def test_obs_doctor_selftest_smoke():
     """The doctor's built-in synthetic-hang check, run exactly as an
     operator would (fresh interpreter, repo root)."""

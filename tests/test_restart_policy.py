@@ -1,0 +1,182 @@
+"""RestartPolicy unit tests (fake clock): backoff + jitter bounds,
+budget-window semantics, fail-fast on repeated pre-heartbeat crashes,
+graceful preemption exits not charged to the budget."""
+
+import pytest
+
+from pytorch_distributed_nn_tpu.launch import Decision, RestartPolicy
+
+
+class FakeClock:
+    def __init__(self, t: float = 0.0) -> None:
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def _policy(**kw) -> tuple[RestartPolicy, FakeClock]:
+    clock = FakeClock()
+    defaults = dict(max_restarts=3, backoff_base_s=1.0,
+                    backoff_max_s=30.0, backoff_factor=2.0,
+                    jitter_frac=0.1, failfast_repeats=2,
+                    failfast_startup_s=5.0, seed=7, clock=clock)
+    defaults.update(kw)
+    return RestartPolicy(**defaults), clock
+
+
+def _crash(policy, *, code=1, duration=60.0, beat_seen=True) -> Decision:
+    return policy.on_exit(reason="crash", code=code, duration_s=duration,
+                          beat_seen=beat_seen)
+
+
+def test_ok_stops():
+    policy, _ = _policy()
+    d = policy.on_exit(reason="ok", code=0, duration_s=10.0)
+    assert d.action == "stop" and d.why == "ok"
+
+
+def test_exponential_backoff_with_jitter_bounds():
+    policy, _ = _policy(max_restarts=10)
+    delays = [_crash(policy).delay_s for _ in range(5)]
+    for n, delay in enumerate(delays, start=1):
+        lo, hi = policy.backoff_bounds(n)
+        assert lo <= delay <= hi, (n, delay, lo, hi)
+    # the raw (unjittered) schedule doubles: 1, 2, 4, 8, 16
+    assert policy.backoff_bounds(1) == (0.9, 1.1)
+    assert policy.backoff_bounds(2) == (pytest.approx(1.8),
+                                        pytest.approx(2.2))
+    assert policy.backoff_bounds(4) == (pytest.approx(7.2),
+                                        pytest.approx(8.8))
+    # and caps at backoff_max_s
+    lo6, hi6 = policy.backoff_bounds(6)  # 32 raw -> capped to 30
+    assert lo6 == pytest.approx(27.0) and hi6 == pytest.approx(33.0)
+    # jitter actually varies (not a constant multiplier)
+    assert len({round(d / policy.backoff_bounds(n)[0], 6)
+                for n, d in enumerate(delays, start=1)}) > 1
+
+
+def test_backoff_deterministic_per_seed():
+    p1, _ = _policy(max_restarts=10, seed=3)
+    p2, _ = _policy(max_restarts=10, seed=3)
+    p3, _ = _policy(max_restarts=10, seed=4)
+    d1 = [_crash(p1).delay_s for _ in range(4)]
+    d2 = [_crash(p2).delay_s for _ in range(4)]
+    d3 = [_crash(p3).delay_s for _ in range(4)]
+    assert d1 == d2
+    assert d1 != d3
+
+
+def test_lifetime_budget_exhaustion():
+    policy, _ = _policy(max_restarts=2, window_s=None)
+    assert _crash(policy).action == "restart"
+    assert _crash(policy).action == "restart"
+    d = _crash(policy)
+    assert d.action == "stop"
+    assert "budget exhausted" in d.why
+    assert policy.budget_restarts == 2
+
+
+def test_budget_window_slides():
+    """max 2 restarts per 100 s — old restarts age out of the window,
+    so a once-a-day crasher keeps restarting forever."""
+    policy, clock = _policy(max_restarts=2, window_s=100.0)
+    assert _crash(policy).action == "restart"
+    clock.advance(30.0)
+    assert _crash(policy).action == "restart"
+    clock.advance(30.0)  # window holds 2 grants (t=0, t=30)
+    assert _crash(policy).action == "stop"
+    clock.advance(45.0)  # t=105: the t=0 grant has aged out
+    assert _crash(policy).action == "restart"
+    clock.advance(200.0)  # everything aged out
+    assert _crash(policy).action == "restart"
+
+
+def test_failfast_same_code_before_first_heartbeat():
+    policy, _ = _policy(max_restarts=10)
+    d1 = _crash(policy, code=2, beat_seen=False)
+    assert d1.action == "restart"
+    d2 = _crash(policy, code=2, beat_seen=False)
+    assert d2.action == "stop"
+    assert "failfast" in d2.why
+
+
+def test_failfast_needs_same_code():
+    policy, _ = _policy(max_restarts=10)
+    assert _crash(policy, code=2, beat_seen=False).action == "restart"
+    assert _crash(policy, code=3, beat_seen=False).action == "restart"
+    assert _crash(policy, code=3, beat_seen=False).action == "stop"
+
+
+def test_heartbeat_resets_failfast_streak():
+    """A crash AFTER beating is a mid-training fault, not a startup
+    crash — it must clear the streak."""
+    policy, _ = _policy(max_restarts=10)
+    assert _crash(policy, code=2, beat_seen=False).action == "restart"
+    assert _crash(policy, code=2, beat_seen=True).action == "restart"
+    assert _crash(policy, code=2, beat_seen=False).action == "restart"
+    assert _crash(policy, code=2, beat_seen=False).action == "stop"
+
+
+def test_failfast_duration_heuristic_without_heartbeats():
+    """No heartbeat monitor (beat_seen=None): sub-startup-window
+    crashes count toward fail-fast, longer ones don't."""
+    policy, _ = _policy(max_restarts=10, failfast_startup_s=5.0)
+    assert _crash(policy, code=9, duration=1.0,
+                  beat_seen=None).action == "restart"
+    d = _crash(policy, code=9, duration=1.0, beat_seen=None)
+    assert d.action == "stop" and "failfast" in d.why
+
+    policy, _ = _policy(max_restarts=10, failfast_startup_s=5.0)
+    for _ in range(4):  # long-lived crashes never fail-fast
+        assert _crash(policy, code=9, duration=60.0,
+                      beat_seen=None).action == "restart"
+
+
+def test_hang_never_failfasts():
+    policy, _ = _policy(max_restarts=10)
+    for _ in range(4):
+        d = policy.on_exit(reason="hang", code=1, duration_s=1.0,
+                           beat_seen=False)
+        assert d.action == "restart"
+
+
+def test_preempt_restarts_free_and_immediately():
+    policy, _ = _policy(max_restarts=1)
+    for _ in range(5):  # far past the budget: never charged
+        d = policy.on_exit(reason="preempt", code=83, duration_s=30.0)
+        assert d.action == "restart"
+        assert d.delay_s == 0.0
+    assert policy.budget_restarts == 0
+    assert policy.preempt_restarts == 5
+    # budget still intact for a real crash afterwards
+    assert _crash(policy).action == "restart"
+    assert policy.budget_restarts == 1
+
+
+def test_preempt_resets_backoff_and_failfast():
+    policy, _ = _policy(max_restarts=10)
+    _crash(policy, code=2, beat_seen=False)  # streak 1, failures 1
+    policy.on_exit(reason="preempt", code=83, duration_s=1.0)
+    # streak cleared: same code again restarts instead of fail-fasting
+    d = _crash(policy, code=2, beat_seen=False)
+    assert d.action == "restart"
+    # backoff restarted from the base tier
+    lo, hi = policy.backoff_bounds(1)
+    assert lo <= d.delay_s <= hi
+
+
+def test_backoff_total_accounting():
+    policy, _ = _policy(max_restarts=10)
+    total = sum(_crash(policy).delay_s for _ in range(3))
+    assert policy.backoff_total_s == pytest.approx(total)
+
+
+def test_invalid_construction():
+    with pytest.raises(ValueError):
+        RestartPolicy(max_restarts=-1)
+    with pytest.raises(ValueError):
+        RestartPolicy(max_restarts=1, jitter_frac=1.0)
