@@ -139,6 +139,7 @@ _METRIC_NAMES = {
     "decode": "decode tokens/sec (llama3_8b_zero)",
     "loader": "input-pipeline samples/sec ({preset})",
     "quality": "held-out NLL (llama3_8b_zero)",
+    "serve": "serving tokens/sec (llama3_8b_zero)",
 }
 
 # Nominal GPU-class MFU for the BASELINE configs whose absolute rate
@@ -662,17 +663,176 @@ def bench_decode(args) -> int:
     return 0
 
 
+def bench_serve(args) -> int:
+    """Continuous-batching serving throughput (serve/): an open-loop
+    ragged workload (mixed prompt lengths AND mixed generation budgets)
+    through the ServingEngine, against a naive static-batch baseline
+    over the SAME requests — groups of ``slots`` submitted together,
+    every row stepped until the group's longest budget finishes (the
+    no-mid-batch-retirement server). Continuous batching's win is
+    exactly the retired-slot rounds the static baseline wastes, so
+    ``vs_baseline`` (engine tokens/s over static tokens/s) must be > 1
+    under a ragged workload. Also reports TTFT and p50/p95/p99
+    per-token latency plus batch occupancy (the SLO surface)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorch_distributed_nn_tpu.config import get_config
+    from pytorch_distributed_nn_tpu.inference.generate import generate
+    from pytorch_distributed_nn_tpu.models import get_model
+    from pytorch_distributed_nn_tpu.serve import (
+        InferenceServer,
+        ServingEngine,
+        ragged_prompt_sampler,
+    )
+
+    cfg = get_config("llama3_8b_zero")
+    if args.serve_tiny:
+        # CI-scale dims, but NOT degenerate: per-step compute must
+        # dominate Python dispatch or the comparison measures the
+        # harness, not the batching policy
+        cfg.model.extra = dict(num_layers=4, d_model=256, num_heads=8,
+                               num_kv_heads=4, mlp_dim=1024,
+                               vocab_size=1024)
+        cfg.model.compute_dtype = "float32"
+    else:
+        # same scaled stand-in as --metric decode
+        cfg.model.extra = dict(num_layers=8, d_model=1024, num_heads=8,
+                               num_kv_heads=4, mlp_dim=3584,
+                               vocab_size=32000)
+    cfg.model.remat = False
+    model = get_model(cfg.model)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32),
+                        train=False)["params"]
+
+    slots = args.per_chip_batch or 4
+    n_req = max(args.serve_requests, slots)
+    max_seq = 64 if args.serve_tiny else 256
+    budget_cycle = (2, 8, 32)  # highly ragged: static's waste surface
+    budgets = [budget_cycle[i % len(budget_cycle)] for i in range(n_req)]
+    sampler = ragged_prompt_sampler(
+        model.vocab_size, min_len=4,
+        max_len=max_seq - max(budget_cycle) - 1, seed=0)
+    prompts = [sampler() for _ in range(n_req)]
+    p_max = max(len(p) for p in prompts)
+
+    def static_pass(idx: list[int], timed: bool) -> tuple[int, float]:
+        """Groups of ``slots``, left-padded to the global max prompt,
+        stepped to the group's longest budget — generate()'s ragged
+        path, so the math matches the engine exactly."""
+        toks = 0
+        t0 = time.perf_counter()
+        for i in range(0, len(idx), slots):
+            group = idx[i:i + slots]
+            real = len(group)
+            while len(group) < slots:  # tail fill: runs, not counted
+                group.append(group[-1])
+            batch = np.zeros((slots, p_max), np.int32)
+            lengths = np.array([len(prompts[j]) for j in group])
+            for row, j in enumerate(group):
+                batch[row, p_max - len(prompts[j]):] = prompts[j]
+            out = generate(model, params, batch,
+                           max(budgets[j] for j in group),
+                           prompt_lengths=lengths)
+            _ = np.asarray(out)  # fence
+            toks += sum(budgets[j] for j in group[:real])
+        return toks, time.perf_counter() - t0
+
+    # -- warmup: compile both paths outside the timed windows ----------
+    static_pass(list(range(min(len(budget_cycle) * slots, n_req))),
+                timed=False)
+    warm_engine = ServingEngine(model, params, max_slots=slots,
+                                max_seq_len=max_seq, max_queue=n_req)
+    warm_srv = InferenceServer(warm_engine).start()
+    from pytorch_distributed_nn_tpu.serve.engine import _bucket_len
+    buckets = {}  # one prompt per prefill pad bucket in the workload
+    for p in prompts:
+        buckets.setdefault(min(_bucket_len(len(p)), max_seq), p)
+    for p in buckets.values():
+        warm_srv.generate(p, 2)
+    warm_srv.stop()
+
+    # -- static-batch baseline (timed) ---------------------------------
+    static_toks, static_dt = static_pass(list(range(n_req)), timed=True)
+    static_tps = static_toks / static_dt
+
+    # -- continuous engine under open-loop load (timed) ----------------
+    engine = ServingEngine(model, params, max_slots=slots,
+                           max_seq_len=max_seq, max_queue=n_req)
+    server = InferenceServer(engine).start()
+    period = 1.0 / args.serve_rate if args.serve_rate > 0 else 0.0
+    t0 = time.perf_counter()
+    t_next = t0
+    reqs = []
+    for p, n in zip(prompts, budgets):
+        wait = t_next - time.perf_counter()
+        if wait > 0:
+            time.sleep(wait)
+        t_next += period
+        reqs.append(server.submit(p, n))
+    for r in reqs:
+        r.done.wait()
+    wall = time.perf_counter() - t0
+    server.stop()
+    done = [r for r in reqs if r.ok]
+    toks = sum(c["new_tokens"] for c in engine.completed)
+    tps = toks / wall
+
+    ttfts = np.array([c["ttft_s"] for c in engine.completed])
+    lat = np.array(engine.round_seconds)
+    summ = engine.summary()
+    backend = jax.default_backend()
+    sink = sys.stdout
+    from pytorch_distributed_nn_tpu.utils.metrics import MetricsLogger
+
+    MetricsLogger(stream=sink).emit_benchmark(
+        metric=_METRIC_NAMES["serve"],
+        value=round(tps, 1), unit="tokens/sec",
+        vs_baseline=round(tps / static_tps, 3),
+        vs_baseline_kind="continuous_over_static_batch",
+        backend=backend,
+        completed=len(done), requests=n_req,
+        static_tokens_per_s=round(static_tps, 1),
+        ttft_p50_ms=round(float(np.percentile(ttfts, 50)) * 1e3, 2),
+        ttft_p95_ms=round(float(np.percentile(ttfts, 95)) * 1e3, 2),
+        token_lat_p50_ms=round(float(np.percentile(lat, 50)) * 1e3, 3),
+        token_lat_p95_ms=round(float(np.percentile(lat, 95)) * 1e3, 3),
+        token_lat_p99_ms=round(float(np.percentile(lat, 99)) * 1e3, 3),
+        batch_occupancy=round(summ["occupancy"], 3),
+        detail=f"open-loop {args.serve_rate:g} req/s, {n_req} ragged "
+               f"requests (prompts 4..{p_max}, budgets "
+               f"{'/'.join(map(str, budget_cycle))}), {slots} slots, "
+               f"vs static batches of {slots}"
+               + (" [tiny dims]" if args.serve_tiny else ""),
+    )
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--preset", default="resnet50_dp",
                     choices=sorted(PER_CHIP_BATCH))
     ap.add_argument("--metric", default="throughput",
                     choices=("throughput", "bus_bw", "decode", "loader",
-                             "quality"),
+                             "quality", "serve"),
                     help="bus_bw: BASELINE's grad-allreduce bus-bandwidth "
                          "metric (use with --preset bert_base_buckets); "
                          "decode: KV-cache generation tokens/s; loader: "
-                         "input-pipeline samples/s vs chip consumption")
+                         "input-pipeline samples/s vs chip consumption; "
+                         "serve: continuous-batching engine tokens/s vs "
+                         "a static-batch baseline under ragged load")
+    ap.add_argument("--serve", action="store_true",
+                    help="shorthand for --metric serve")
+    ap.add_argument("--serve-requests", type=int, default=24,
+                    help="serve metric: synthetic requests in the timed "
+                         "open-loop run")
+    ap.add_argument("--serve-rate", type=float, default=50.0,
+                    help="serve metric: open-loop arrival rate, req/s")
+    ap.add_argument("--serve-tiny", action="store_true",
+                    help="serve metric: CI-scale model dims (CPU-fast) "
+                         "instead of the scaled llama stand-in")
     ap.add_argument("--loader-dataset", default="",
                     help="loader metric: swap the preset's dataset "
                          "(e.g. image_folder, cifar10_bin, mnist_idx)")
@@ -735,6 +895,8 @@ def main(argv=None) -> int:
                          "preset (repeatable), e.g. --set model.remat="
                          "false — for on-chip A/B experiments")
     args = ap.parse_args(argv)
+    if args.serve:
+        args.metric = "serve"
 
     from pytorch_distributed_nn_tpu.runtime.platform import (
         apply_platform_overrides,
@@ -754,6 +916,8 @@ def main(argv=None) -> int:
         return bench_loader(args)
     if args.metric == "quality":
         return bench_quality(args)
+    if args.metric == "serve":
+        return bench_serve(args)
 
     import jax
 

@@ -27,6 +27,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from pytorch_distributed_nn_tpu import obs
@@ -128,6 +129,52 @@ _decode_step = functools.partial(jax.jit, static_argnums=(0,),
                                  donate_argnums=(2,))(_apply_decode)
 
 
+def _apply_prefill_ragged(model, params, cache, tokens, lengths):
+    """Ragged prefill: ``tokens`` (B, P) LEFT-ALIGNED rows (row i's real
+    prompt in columns [0, lengths[i]); columns beyond are don't-care).
+    Every row writes its KV from cache slot 0 (``cache_positions`` = 0),
+    and the per-position causal mask keeps slots >= lengths[i] out of
+    every consumed attention row, so each row computes exactly its
+    sequential prefill. Returns ((B, V) logits at each row's LAST real
+    position, cache). Full logits are materialized (not ``last_only``)
+    because "last" differs per row — fine at serving batch sizes; the
+    (P-1) extra head rows are the price of one fused prefill."""
+    zeros = jnp.zeros((tokens.shape[0],), jnp.int32)
+    logits, mutated = model.apply(
+        {"params": params, "cache": cache}, tokens,
+        train=False, decode=True, mutable=["cache"],
+        cache_positions=zeros,
+    )
+    last = (lengths.astype(jnp.int32) - 1)[:, None, None]
+    next_logits = jnp.take_along_axis(logits, last, axis=1)[:, 0, :]
+    return next_logits, mutated["cache"]
+
+
+prefill_ragged = functools.partial(
+    jax.jit, static_argnums=(0,), donate_argnums=(2,)
+)(_apply_prefill_ragged)
+
+
+def _apply_decode_ragged(model, params, cache, tokens, positions):
+    """One per-row decode step: ``tokens`` (B,) int32 next tokens,
+    ``positions`` (B,) int32 per-row cache depths (row i's token lands
+    in cache slot positions[i] and attends slots [0, positions[i]]).
+    The shared scalar cache_index is untouched — rows at different
+    depths share one batch, which is what continuous batching needs.
+    Returns ((B, V) next-token logits, cache)."""
+    logits, mutated = model.apply(
+        {"params": params, "cache": cache}, tokens[:, None],
+        train=False, decode=True, last_only=True, mutable=["cache"],
+        cache_positions=positions.astype(jnp.int32),
+    )
+    return logits[:, -1, :], mutated["cache"]
+
+
+decode_step_ragged = functools.partial(
+    jax.jit, static_argnums=(0,), donate_argnums=(2,)
+)(_apply_decode_ragged)
+
+
 @functools.partial(jax.jit, static_argnums=(0, 5, 7, 8, 9),
                    donate_argnums=(2,))
 def _decode_loop(model, params, cache, next_logits, rng, n_steps,
@@ -168,6 +215,37 @@ def _decode_loop(model, params, cache, next_logits, rng, n_steps,
     return toks, final_cache
 
 
+@functools.partial(jax.jit, static_argnums=(0, 5, 7, 8, 9),
+                   donate_argnums=(2,))
+def _decode_loop_ragged(model, params, cache, next_logits, rng, n_steps,
+                        temperature, top_k, eos_token, top_p, lengths):
+    """Ragged twin of :func:`_decode_loop`: the scan carry additionally
+    holds per-row cache depths (starting at the prompt lengths), and
+    each step feeds through the per-row decode apply. Same fused
+    one-dispatch property; ``lengths`` is traced so different ragged
+    batches share one compile."""
+
+    def step(carry, _):
+        next_logits, cache, rng, done, pos = carry
+        rng, step_rng = jax.random.split(rng)
+        tok = _sample(next_logits, temperature=temperature, top_k=top_k,
+                      rng=step_rng, top_p=top_p)
+        if eos_token is not None:
+            tok = jnp.where(done, eos_token, tok)
+            done = done | (tok == eos_token)
+        tok = tok.astype(jnp.int32)
+        next_logits, cache = _apply_decode_ragged(model, params, cache,
+                                                  tok, pos)
+        return (next_logits, cache, rng, done, pos + 1), tok
+
+    done0 = jnp.zeros((next_logits.shape[0],), bool)
+    (_, final_cache, _, _, _), toks = jax.lax.scan(
+        step, (next_logits, cache, rng, done0,
+               lengths.astype(jnp.int32)), None, length=n_steps
+    )
+    return toks, final_cache
+
+
 def _sample(logits, *, temperature, top_k: int, rng, top_p: float = 0.0):
     """logits (B, V) -> tokens (B,). ``temperature`` may be a traced
     scalar (0 selects greedy via jnp.where — top-k/top-p membership is
@@ -203,12 +281,21 @@ def generate(model, params, prompt, max_new_tokens: int, *,
              temperature: float = 0.0, top_k: int = 0,
              top_p: float = 0.0, rng=None,
              eos_token: int | None = None, mesh=None,
-             prefill_chunk: int = 0):
+             prefill_chunk: int = 0, prompt_lengths=None):
     """Generate continuations for ``prompt`` (B, P) int32.
 
     Returns (B, P + max_new_tokens) tokens (prompt included). With
     ``eos_token`` set, sequences that emit it keep it and then pad with
     it (the batch still runs max_new_tokens steps).
+
+    ``prompt_lengths``: ragged batches. (B,) ints — row i's real prompt
+    is the LAST prompt_lengths[i] columns (left-padding convention, pad
+    values are don't-care). Rows are realigned internally and decoded
+    via per-row cache positions; greedy output for each row is
+    bit-identical to running that row alone through generate()
+    (tests/test_generate.py golden test). The returned array keeps the
+    padded prompt prefix as passed: generated tokens for every row live
+    in columns [P, P + max_new_tokens).
 
     ``mesh``: distributed decoding — params are laid out tensor/expert-
     parallel (:func:`shard_params_for_inference`), the KV cache shards
@@ -239,9 +326,37 @@ def generate(model, params, prompt, max_new_tokens: int, *,
         )
     if temperature > 0.0 and rng is None:
         raise ValueError("sampling (temperature > 0) needs an rng key")
+    B, P_len = prompt.shape
+    if prompt_lengths is not None:
+        lens_host = np.asarray(prompt_lengths, dtype=np.int64)
+        if lens_host.shape != (B,):
+            raise ValueError(
+                f"prompt_lengths must be ({B},), got {lens_host.shape}"
+            )
+        if lens_host.min() < 1 or lens_host.max() > P_len:
+            raise ValueError(
+                f"prompt_lengths must be in [1, {P_len}], got "
+                f"[{lens_host.min()}, {lens_host.max()}]"
+            )
+        if mesh is not None:
+            raise ValueError(
+                "ragged prompts (prompt_lengths) are not supported with "
+                "mesh sharding yet — shard the params and run the "
+                "uniform path, or batch equal-length rows"
+            )
+        if prefill_chunk:
+            raise ValueError(
+                "prompt_lengths and prefill_chunk are mutually "
+                "exclusive (ragged prefill is one fused apply)"
+            )
     if max_new_tokens == 0:
         return prompt
-    B, P_len = prompt.shape
+    if prompt_lengths is not None:
+        return _generate_ragged(
+            model, params, prompt, max_new_tokens, lens_host,
+            temperature=temperature, top_k=top_k, top_p=top_p, rng=rng,
+            eos_token=eos_token,
+        )
     total = P_len + max_new_tokens
     cache = init_cache(model, B, total)
     if mesh is not None:
@@ -277,6 +392,43 @@ def generate(model, params, prompt, max_new_tokens: int, *,
         toks, _ = _decode_loop(model, params, cache, next_logits, rng0,
                                max_new_tokens, jnp.float32(temperature),
                                int(top_k), eos_token, float(top_p))
+    obs.get_registry().counter(
+        "inference_tokens_total", "tokens generated (dispatched)").inc(
+        B * max_new_tokens)
+    return jnp.concatenate([prompt, toks.T.astype(jnp.int32)], axis=1)
+
+
+def _generate_ragged(model, params, prompt, max_new_tokens, lens_host,
+                     *, temperature, top_k, top_p, rng, eos_token):
+    """The ragged-batch body of :func:`generate` (validated inputs).
+
+    Left-padded rows are realigned to left-ALIGNED internally (row i's
+    prompt occupies cache slots [0, L_i)), prefilled in one per-row
+    apply, then decoded by the ragged scan with per-row cache depths.
+    The causal-by-slot mask zeroes every don't-care slot exactly
+    (softmax weight exp(-1e30 - max) underflows to 0.0), so each row's
+    float math is the sequential row's float math — bit-identical
+    greedy decoding, not just approximately equal."""
+    B, P_len = prompt.shape
+    lengths = jnp.asarray(lens_host, jnp.int32)
+    # realign: aligned[i, j] = prompt[i, (j + P - L_i) % P] puts row
+    # i's first real token at column 0 and wraps its padding to the
+    # tail (which the mask then excludes from all consumed rows)
+    shift = (jnp.arange(P_len)[None, :]
+             + (P_len - lengths)[:, None]) % P_len
+    aligned = jnp.take_along_axis(prompt, shift, axis=1)
+    cache = init_cache(model, B, P_len + max_new_tokens)
+    with obs.span("inference/prefill", batch=B, prompt_len=P_len,
+                  ragged=True):
+        next_logits, cache = prefill_ragged(model, params, cache,
+                                            aligned, lengths)
+    rng0 = rng if rng is not None else jax.random.key(0)
+    with obs.span("inference/decode_loop", batch=B,
+                  new_tokens=max_new_tokens):
+        toks, _ = _decode_loop_ragged(
+            model, params, cache, next_logits, rng0, max_new_tokens,
+            jnp.float32(temperature), int(top_k), eos_token,
+            float(top_p), lengths)
     obs.get_registry().counter(
         "inference_tokens_total", "tokens generated (dispatched)").inc(
         B * max_new_tokens)

@@ -52,7 +52,8 @@ class LlamaBlock(nn.Module):
     fused_proj: bool = False
 
     @nn.compact
-    def __call__(self, x, train: bool = False, decode: bool = False):
+    def __call__(self, x, train: bool = False, decode: bool = False,
+                 cache_positions=None):
         # inert tag unless the enclosing remat uses a name-aware policy
         # (remat_offload): then this marks the block boundary as
         # offloadable to pinned host memory instead of living in HBM
@@ -70,7 +71,7 @@ class LlamaBlock(nn.Module):
             cache_dtype=self.cache_dtype,
             fused_qkv=self.quantized and self.fused_proj,
             name="attn",
-        )(y, decode=decode)
+        )(y, decode=decode, cache_positions=cache_positions)
         x = x + y
         y = RMSNorm(eps=self.norm_eps, dtype=self.dtype,
                     param_dtype=self.param_dtype, name="mlp_norm")(x)
@@ -133,14 +134,16 @@ class Llama(nn.Module):
     @nn.compact
     def __call__(self, tokens, *, train: bool = False,
                  decode: bool = False, last_only: bool = False,
-                 return_hidden: bool = False):
+                 return_hidden: bool = False, cache_positions=None):
         """``last_only`` returns logits for the final position only
         (B, 1, V) — decode prefill needs just the next-token row, and
         at real vocab sizes the (P-1) unused head projections dominate
         prefill cost. ``return_hidden`` skips the lm_head and returns
         the final-norm'd (B, T, D) trunk output — the chunked-xent path
         (train/losses.py) applies the head blockwise so full logits
-        never materialize."""
+        never materialize. ``cache_positions`` (B,) int32: per-row KV
+        cache indices for continuous batching — see
+        nn.attention.MultiHeadAttention."""
         if self.quantized:
             x = Int8Embed(self.vocab_size, self.d_model,
                           dtype=self.dtype, name="tok_embed")(tokens)
@@ -184,7 +187,7 @@ class Llama(nn.Module):
                 cache_dtype=self.cache_dtype,
                 fused_proj=self.fused_proj,
                 name=f"layer{i}",
-            )(x, train, decode)
+            )(x, train, decode, cache_positions)
         if last_only:
             x = x[:, -1:]
         x = RMSNorm(eps=self.norm_eps, dtype=self.dtype,

@@ -35,7 +35,8 @@ class DecoderBlock(nn.Module):
     param_dtype: jnp.dtype = jnp.float32
 
     @nn.compact
-    def __call__(self, x, train: bool = False, decode: bool = False):
+    def __call__(self, x, train: bool = False, decode: bool = False,
+                 cache_positions=None):
         d = x.shape[-1]
         y = nn.LayerNorm(epsilon=self.ln_eps, dtype=self.dtype,
                          param_dtype=self.param_dtype, name="ln1")(x)
@@ -43,7 +44,7 @@ class DecoderBlock(nn.Module):
             num_heads=self.num_heads, head_dim=d // self.num_heads,
             causal=True, impl=self.attn_impl, dtype=self.dtype,
             param_dtype=self.param_dtype, name="attn",
-        )(y, decode=decode)
+        )(y, decode=decode, cache_positions=cache_positions)
         if self.dropout:
             y = nn.Dropout(self.dropout, deterministic=not train)(y)
         x = x + y
@@ -100,7 +101,7 @@ class TransformerLM(nn.Module):
     def __call__(self, tokens, *, train: bool = False,
                  positions: Optional[jnp.ndarray] = None,
                  decode: bool = False, last_only: bool = False,
-                 return_hidden: bool = False):
+                 return_hidden: bool = False, cache_positions=None):
         T = tokens.shape[1]
         if T > self.max_len:
             raise ValueError(
@@ -124,13 +125,20 @@ class TransformerLM(nn.Module):
         if decode:
             # the learned positional table needs absolute positions, so
             # the model keeps its own running index next to the
-            # attention layers' KV cache_index vars
+            # attention layers' KV cache_index vars. In per-row mode
+            # (cache_positions given) each row's position comes from its
+            # own cache depth instead, and the shared counter is left
+            # untouched — rows at different depths share one batch.
             pos_index = self.variable(
                 "cache", "pos_index", lambda: jnp.zeros((), jnp.int32)
             )
             if not self.is_initializing():
-                positions = pos_index.value + jnp.arange(T)[None]
-                pos_index.value = pos_index.value + T
+                if cache_positions is not None:
+                    positions = (cache_positions.astype(jnp.int32)[:, None]
+                                 + jnp.arange(T)[None])
+                else:
+                    positions = pos_index.value + jnp.arange(T)[None]
+                    pos_index.value = pos_index.value + T
         if positions is None:
             positions = jnp.arange(T)[None]
         pos = nn.Embed(self.max_len, self.d_model,
@@ -144,7 +152,8 @@ class TransformerLM(nn.Module):
             block_cls = nn.remat(DecoderBlock, static_argnums=(2, 3))
         for i in range(self.num_layers):
             x = block_cls(**self.block_kwargs(), ffn=self.layer_ffn(i),
-                          name=f"block{i}")(x, train, decode)
+                          name=f"block{i}")(x, train, decode,
+                                            cache_positions)
         if last_only:
             x = x[:, -1:]
         x = nn.LayerNorm(epsilon=self.ln_eps, dtype=self.dtype,

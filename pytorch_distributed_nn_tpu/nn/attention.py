@@ -129,6 +129,18 @@ def dot_product_attention(
                       preferred_element_type=jnp.float32).astype(q.dtype)
 
 
+def _row_update(buf, new, starts):
+    """Per-row cache write: row ``i`` of ``new`` (T leading tokens)
+    lands at ``buf[i, starts[i]:starts[i]+T]``. The continuous-batching
+    primitive — each sequence in the batch advances at its own index
+    instead of the shared scalar ``cache_index``. vmap over the batch
+    dim keeps it one fused scatter, no host loop."""
+    return jax.vmap(
+        lambda b, n, s: jax.lax.dynamic_update_slice(
+            b, n, (s,) + (0,) * (b.ndim - 1))
+    )(buf, new, starts)
+
+
 def _quantize_kv(x):
     """(B, T, H, D) → int8 values + (B, T, H) f32 scales: symmetric
     per-(token, head) absmax over the head dim. Zero rows (e.g. a
@@ -214,14 +226,28 @@ class MultiHeadAttention(nn.Module):
 
     @nn.compact
     def __call__(self, x, mask: Optional[jax.Array] = None,
-                 decode: bool = False):
+                 decode: bool = False,
+                 cache_positions: Optional[jax.Array] = None):
         """``decode=True`` enables the autoregressive KV cache (flax
         "cache" collection): initialize by calling ``model.init`` with a
         (B, max_len) input and ``decode=True`` — that sizes the cache —
         then apply with ``mutable=["cache"]`` feeding (B, 1) (or a
         (B, P) prefill chunk); keys/values land at ``cache_index``,
         rotary positions are absolute, and attention masks to the
-        filled prefix. Causal-only (the cache is a running prefix)."""
+        filled prefix. Causal-only (the cache is a running prefix).
+
+        ``cache_positions`` (B,) int32 switches decode to *per-row*
+        cache indexing: row ``i``'s fed tokens write at slot
+        ``cache_positions[i]`` (its own filled length), rotary positions
+        and the causal-by-index mask follow per row, and the shared
+        scalar ``cache_index`` is neither read nor advanced. This is
+        what lets a continuous-batching engine hold sequences at
+        different decode depths in ONE batched cache (serve/engine.py)
+        and what batched ragged-prompt generation reduces to
+        (inference/generate.py ``prompt_lengths``). Each row's
+        computation is exactly the shared-index computation for that
+        row, so greedy decode stays token-identical to the sequential
+        path."""
         kv_heads = self.num_kv_heads or self.num_heads
         if self.quantized:
             if self.use_bias:
@@ -252,6 +278,11 @@ class MultiHeadAttention(nn.Module):
             raise ValueError(
                 "decode mode ignores padding masks; strip padding (or "
                 "left-trim) before prefill"
+            )
+        if cache_positions is not None and not decode:
+            raise ValueError(
+                "cache_positions is a decode-cache feature (per-row "
+                "cache indices); it needs decode=True"
             )
         if self.impl in ("ring", "ulysses"):
             # Sequence/context parallelism at the model level: the
@@ -349,40 +380,47 @@ class MultiHeadAttention(nn.Module):
                 out = jnp.zeros_like(q)
             else:
                 S = cached_k.value.shape[1]
-                idx = cache_index.value
-                positions = idx + jnp.arange(T)[None]  # absolute
+                if cache_positions is None:
+                    idx = cache_index.value
+                    positions = idx + jnp.arange(T)[None]  # absolute
+                    cache_index.value = idx + T
+
+                    def write(buf, new):
+                        return jax.lax.dynamic_update_slice(
+                            buf, new, (0, idx) + (0,) * (buf.ndim - 2))
+                else:
+                    # per-row mode: each sequence advances at its own
+                    # index; the shared counter stays untouched (it is
+                    # meaningless across rows at different depths)
+                    starts = cache_positions.astype(jnp.int32)
+                    positions = starts[:, None] + jnp.arange(T)[None]
+
+                    def write(buf, new):
+                        return _row_update(buf, new, starts)
                 if self.rotary:
                     q, k = rotary_embedding(q, k, theta=self.rope_theta,
                                             positions=positions)
                     q, k = q.astype(self.dtype), k.astype(self.dtype)
-                cache_index.value = idx + T
                 # attend to the filled prefix: k_pos <= this row's q_pos
+                # (per-row rows are left-aligned, so slot == position)
                 k_pos = jnp.arange(S)[None, None, :]
                 q_pos = positions[:, :, None]
-                pos_mask = k_pos <= q_pos  # (1, T, S)
+                pos_mask = k_pos <= q_pos  # (B|1, T, S)
                 if int8_cache:
                     kq_new, ks_new = _quantize_kv(k)
                     vq_new, vs_new = _quantize_kv(v)
-                    cached_k.value = jax.lax.dynamic_update_slice(
-                        cached_k.value, kq_new, (0, idx, 0, 0))
-                    cached_v.value = jax.lax.dynamic_update_slice(
-                        cached_v.value, vq_new, (0, idx, 0, 0))
-                    k_scale.value = jax.lax.dynamic_update_slice(
-                        k_scale.value, ks_new, (0, idx, 0))
-                    v_scale.value = jax.lax.dynamic_update_slice(
-                        v_scale.value, vs_new, (0, idx, 0))
+                    cached_k.value = write(cached_k.value, kq_new)
+                    cached_v.value = write(cached_v.value, vq_new)
+                    k_scale.value = write(k_scale.value, ks_new)
+                    v_scale.value = write(v_scale.value, vs_new)
                     out = _cache_attention(
                         q, cached_k.value, cached_v.value, pos_mask,
                         self.dtype, kscale=k_scale.value,
                         vscale=v_scale.value,
                     )
                 else:
-                    cached_k.value = jax.lax.dynamic_update_slice(
-                        cached_k.value, k, (0, idx, 0, 0)
-                    )
-                    cached_v.value = jax.lax.dynamic_update_slice(
-                        cached_v.value, v, (0, idx, 0, 0)
-                    )
+                    cached_k.value = write(cached_k.value, k)
+                    cached_v.value = write(cached_v.value, v)
                     out = _cache_attention(
                         q, cached_k.value, cached_v.value, pos_mask,
                         self.dtype,

@@ -39,6 +39,7 @@ from pytorch_distributed_nn_tpu.obs.registry import (  # noqa: F401
     reset_registry,
 )
 from pytorch_distributed_nn_tpu.obs.span import (  # noqa: F401
+    current_recorder,
     disable_tracing,
     enable_tracing,
     merge_chrome_traces,

@@ -39,7 +39,10 @@ Event kinds:
 - ``checkpoint`` / ``data`` — save/restore and loader hand-off events;
 - ``chaos`` — an injected fault (runtime/chaos.py): every TPUNN_CHAOS
   injection lands here so forensics can't misattribute it;
-- ``preempt`` — preemption-notice markers (SIGTERM → graceful exit).
+- ``preempt`` — preemption-notice markers (SIGTERM → graceful exit);
+- ``serve`` — serving-engine lifecycle (serve/): one event per decode
+  round plus admit/reject/retire/drain markers, so the doctor can see
+  a wedged decode loop or shed traffic post-mortem.
 
 Stdlib-only on purpose: dump paths run inside signal handlers and
 heartbeat daemon threads of processes whose main thread is wedged
@@ -95,7 +98,7 @@ class FlightEvent:
 
     seq: int
     kind: str  # collective | dispatch | step | checkpoint | data
-    #          # | chaos | preempt
+    #          # | chaos | preempt | serve
     op: str
     step: int
     t0: float

@@ -127,6 +127,14 @@ def tracing_enabled() -> bool:
     return _recorder is not None
 
 
+def current_recorder() -> TraceRecorder | None:
+    """The live recorder, or None when tracing is off — for callers
+    that add retroactive events (e.g. serve's per-request spans, whose
+    duration is only known at retirement) without forcing tracing on
+    the way ``enable_tracing`` would."""
+    return _recorder
+
+
 def enable_tracing(*, process_index: int | None = None) -> TraceRecorder:
     """Start recording spans (idempotent: returns the live recorder).
 

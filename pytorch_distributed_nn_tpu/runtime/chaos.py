@@ -30,6 +30,11 @@ Spec grammar (faults joined by ``;``)::
                                          array files — torn checkpoint
     store_flaky@p=0.1[:rank=...]         each store op raises OSError
                                          with probability p (seeded)
+    serve_reject@p=0.3[:rank=...]        serving admission control sheds
+                                         each arriving request with
+                                         probability p (seeded) — the
+                                         overload/load-shed drill for
+                                         serve/scheduler.py
 
 ``rank`` / ``inc`` (incarnation, from ``TPUNN_RESTART``) are optional
 filters; a fault without them fires in every process / incarnation.
@@ -79,7 +84,7 @@ CRASH_EXIT_CODE = 43
 DEFAULT_HANG_MS = 3_600_000.0
 
 FAULT_KINDS = ("crash", "hang", "slow", "preempt", "corrupt_ckpt",
-               "store_flaky")
+               "store_flaky", "serve_reject")
 
 _INT_KEYS = ("step", "rank", "inc")
 _FLOAT_KEYS = ("ms", "p")
@@ -148,6 +153,7 @@ def _validate(fault: Fault) -> None:
         "crash": ("step",), "preempt": ("step",),
         "corrupt_ckpt": ("step",), "hang": ("collective",),
         "slow": ("ms",), "store_flaky": ("p",),
+        "serve_reject": ("p",),
     }[fault.kind]
     for key in need:
         missing = (getattr(fault, key) in (None, "", 0.0)
@@ -158,8 +164,10 @@ def _validate(fault: Fault) -> None:
                 f"chaos fault {fault.spec!r} needs {key}= "
                 f"(e.g. {fault.kind}@{key}=...)"
             )
-    if fault.kind == "store_flaky" and not 0.0 < fault.p <= 1.0:
-        raise ValueError(f"store_flaky p must be in (0, 1], got {fault.p}")
+    if fault.kind in ("store_flaky", "serve_reject") \
+            and not 0.0 < fault.p <= 1.0:
+        raise ValueError(
+            f"{fault.kind} p must be in (0, 1], got {fault.p}")
 
 
 class ChaosEngine:
@@ -248,6 +256,16 @@ class ChaosEngine:
             if self._rng.random() < fault.p:
                 self._inject_store_flaky(fault, op, key)
 
+    def admit(self, request_id: str = "") -> bool:
+        """Serving admission hook: True = shed this request."""
+        for fault in self.faults:
+            if fault.kind != "serve_reject" or not self._matches(fault):
+                continue
+            if self._rng.random() < fault.p:
+                self._inject_serve_reject(fault, request_id)
+                return True
+        return False
+
     # -- injections (each one _emits first: lint-enforced) ---------------
 
     def _inject_crash(self, fault: Fault) -> None:
@@ -280,6 +298,13 @@ class ChaosEngine:
                             key: str) -> None:
         self._emit(fault, note=f"{fault.spec} [{op} {key}]")
         raise OSError(f"chaos: injected store fault on {op}({key!r})")
+
+    def _inject_serve_reject(self, fault: Fault,
+                             request_id: str) -> None:
+        # emit-first (lint): the shed itself happens in the scheduler,
+        # which turns this hook's True into a counted rejection — the
+        # flight ring must already hold the injection when it does
+        self._emit(fault, note=f"{fault.spec} [{request_id}]")
 
 
 def corrupt_step_dir(step_dir: str) -> int:
@@ -376,3 +401,13 @@ def on_store_op(op: str, key: str = "") -> None:
     if _engine is None:
         return
     _engine.store_op(op, key)
+
+
+def on_admit(request_id: str = "") -> bool:
+    """``serve.scheduler`` admission hook (serve_reject).
+
+    Returns True when chaos says to shed this request; the scheduler
+    owns the actual rejection (counted + flight-visible there too)."""
+    if _engine is None:
+        return False
+    return _engine.admit(request_id)

@@ -1,0 +1,33 @@
+"""Continuous-batching serving subsystem (ISSUE 5).
+
+Layering (each module's docstring carries its own contract):
+
+- :mod:`serve.kv_pool` — paged KV-cache accounting: block allocator +
+  per-sequence block tables, reservation-at-admission;
+- :mod:`serve.scheduler` — bounded admission queue, strict-FIFO
+  anti-starvation policy, deadlines, chaos load-shedding;
+- :mod:`serve.engine` — the batched decode loop: per-row cache
+  positions over one dense KV cache, mid-batch retirement, greedy
+  decode bit-identical to sequential ``inference.generate``;
+- :mod:`serve.server` — thread loopback front-end, SIGTERM drain,
+  open/closed-loop synthetic clients.
+
+CLI: ``scripts/serve.py``; load test: ``bench.py --serve``; docs:
+``docs/serving.md``.
+"""
+
+from pytorch_distributed_nn_tpu.serve.engine import (  # noqa: F401
+    ServingEngine,
+)
+from pytorch_distributed_nn_tpu.serve.kv_pool import KVPool  # noqa: F401
+from pytorch_distributed_nn_tpu.serve.scheduler import (  # noqa: F401
+    Request,
+    Scheduler,
+)
+from pytorch_distributed_nn_tpu.serve.server import (  # noqa: F401
+    InferenceServer,
+    closed_loop_client,
+    install_sigterm_drain,
+    open_loop_client,
+    ragged_prompt_sampler,
+)

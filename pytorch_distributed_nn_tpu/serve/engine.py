@@ -1,0 +1,389 @@
+"""Continuous-batching decode engine.
+
+The data plane of the serving stack: a dense batched KV cache of
+``max_slots`` rows, stepped one token per round for every active row,
+with finished rows retired *mid-batch* and newly admitted requests
+prefilled into the freed rows — the batch never drains to admit work.
+Policy (who gets in, who waits) is the scheduler's
+(:mod:`serve.scheduler`); this module only executes its decisions.
+
+Correctness contract: greedy decode through the engine is
+**bit-identical** to sequential ``inference.generate.generate`` for the
+same prompt (tests/test_serve.py golden test). Both paths run the same
+per-row math — prefill via :func:`inference.generate.prefill_ragged`
+(batch of one) and per-round steps via the same per-row decode apply,
+where every row's attention is masked to exactly its own filled cache
+prefix; masked slots contribute exact 0.0 after softmax, so sharing a
+batch with strangers cannot perturb a row's floats.
+
+Hot-loop discipline (lint-enforced): :meth:`ServingEngine._decode_round`
+contains the per-round device work and performs NO host->device
+transfers and no jnp/jax array construction — slot state (last token,
+per-row cache depth, active mask) lives on device across rounds, and
+the one device->host fetch per round (the sampled tokens the scheduler
+must see to detect eos/budget) is a single ``np.asarray`` of a (slots,)
+array. Slot mutations (admission, retirement) happen outside the hot
+method and push the refreshed slot arrays once.
+
+Observability: TTFT + per-token latency histograms, batch-occupancy /
+queue-depth / KV-utilization gauges, one flight-ring ``serve`` event
+per decode round (a wedged loop is visible to the doctor as a stalled
+round counter), per-request retroactive spans when tracing is on, and
+per-request ``serve_request`` JSONL records through MetricsLogger.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from pytorch_distributed_nn_tpu import obs
+from pytorch_distributed_nn_tpu.inference.generate import (
+    _apply_decode_ragged,
+    _apply_prefill_ragged,
+    init_cache,
+)
+from pytorch_distributed_nn_tpu.obs import flight
+from pytorch_distributed_nn_tpu.runtime import chaos
+from pytorch_distributed_nn_tpu.serve.kv_pool import KVPool
+from pytorch_distributed_nn_tpu.serve.scheduler import Request, Scheduler
+
+# TTFT spans queueing (ms..s under load); per-token latency is ms-scale
+_TTFT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
+                 2.5, 5.0, 10.0, 30.0)
+_TOKEN_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+                  0.25, 0.5, 1.0)
+
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+def _serve_prefill(model, params, cache, tokens, lengths):
+    """Batch-of-one prefill + greedy first token: (1,) int32 token,
+    filled (1, P_pad, ...) row cache. The argmax runs on device so the
+    only host transfer is the token itself."""
+    next_logits, cache = _apply_prefill_ragged(model, params, cache,
+                                               tokens, lengths)
+    return jnp.argmax(next_logits, axis=-1).astype(jnp.int32), cache
+
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+def _serve_step(model, params, cache, last_tok, lengths, active):
+    """One decode round over all slots: feed every row its last token
+    at its own cache depth, take greedy argmax. Inactive rows still
+    flow through the batched apply (a dynamic batch size would
+    recompile); their tokens/depths are frozen by the ``active`` mask
+    and their cache writes land in retired rows that the next
+    occupant's prefill overwrites (and masks until it grows there)."""
+    logits, cache = _apply_decode_ragged(model, params, cache, last_tok,
+                                         lengths)
+    nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    nxt = jnp.where(active, nxt, last_tok)
+    lengths = jnp.where(active, lengths + 1, lengths)
+    return nxt, lengths, cache
+
+
+@functools.partial(jax.jit, donate_argnums=(0,))
+def _insert_row(batch_cache, row_cache, slot):
+    """Copy a prefilled batch-of-one cache into batch row ``slot``.
+    Scalar leaves (the shared cache_index / pos_index counters) are
+    untouched — per-row mode never reads them."""
+    def ins(b, r):
+        if b.ndim == 0:
+            return b
+        return jax.lax.dynamic_update_slice(
+            b, r.astype(b.dtype), (slot,) + (0,) * (b.ndim - 1))
+    return jax.tree.map(ins, batch_cache, row_cache)
+
+
+# init_cache retraces model.init (pure Python, ~100ms even for tiny
+# models) on every call; per-admission that would dominate TTFT. The
+# shape template depends only on (model, batch, max_len), so memoize it
+# and mint fresh zeros per prefill (the previous buffer is donated to
+# the prefill jit, so it cannot be reused). The value pins the model so
+# a dead id() can never alias a different live model.
+_CACHE_TMPL: dict = {}
+
+
+def _fresh_cache(model, batch: int, max_len: int):
+    key = (id(model), batch, max_len)
+    hit = _CACHE_TMPL.get(key)
+    if hit is None:
+        tmpl = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+            init_cache(model, batch, max_len))
+        _CACHE_TMPL[key] = hit = (model, tmpl)
+    return jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), hit[1])
+
+
+def _bucket_len(n: int, floor: int = 16) -> int:
+    """Round a prompt length up to a power of two (>= ``floor``): the
+    prefill/insert jit cache then holds O(log max_seq_len) programs
+    instead of one per distinct prompt length."""
+    b = floor
+    while b < n:
+        b *= 2
+    return b
+
+
+class _Slot:
+    """Host-side mirror of one batch row."""
+
+    __slots__ = ("req", "emitted", "tokens", "depth")
+
+    def __init__(self, req: Request, first_token: int, depth: int):
+        self.req = req
+        self.tokens = [int(first_token)]
+        self.emitted = 1
+        self.depth = depth  # cache rows filled (prompt + emitted - 1)
+
+
+class ServingEngine:
+    """Continuous-batching engine over one model + params."""
+
+    def __init__(self, model, params, *, max_slots: int = 4,
+                 max_seq_len: int = 256, block_size: int = 16,
+                 max_queue: int = 64, max_prefills_per_round: int = 2,
+                 eos_token: Optional[int] = None, metrics=None) -> None:
+        if max_slots < 1:
+            raise ValueError(f"max_slots must be >= 1, got {max_slots}")
+        self.model = model
+        self.params = params
+        self.max_slots = max_slots
+        self.max_seq_len = int(max_seq_len)
+        self.eos_token = eos_token
+        self.metrics = metrics  # MetricsLogger or None
+        pool = KVPool(
+            num_blocks=max_slots * (-(-self.max_seq_len // block_size)),
+            block_size=block_size,
+        )
+        self.scheduler = Scheduler(
+            pool, max_queue=max_queue, max_seq_len=self.max_seq_len,
+            max_prefills_per_round=max_prefills_per_round,
+        )
+        self._cache = _fresh_cache(model, max_slots, self.max_seq_len)
+        self._slots: list[Optional[_Slot]] = [None] * max_slots
+        self._h_last = np.zeros((max_slots,), np.int32)
+        self._h_depth = np.zeros((max_slots,), np.int32)
+        self._h_active = np.zeros((max_slots,), bool)
+        self._d_last = jnp.asarray(self._h_last)
+        self._d_depth = jnp.asarray(self._h_depth)
+        self._d_active = jnp.asarray(self._h_active)
+        # bench/report feed: per-round wall seconds + finished requests
+        self.round_seconds: list[float] = []
+        self.completed: list[dict] = []
+        self._occ_sum = 0  # sum of per-round active-slot counts
+        reg = obs.get_registry()
+        self._h_ttft = reg.histogram(
+            "serve_ttft_seconds", "submit -> first token",
+            buckets=_TTFT_BUCKETS)
+        self._h_tok = reg.histogram(
+            "serve_token_latency_seconds", "decode round wall time "
+            "(= per-token latency of every active stream)",
+            buckets=_TOKEN_BUCKETS)
+        self._g_occ = reg.gauge(
+            "serve_batch_occupancy", "active decode slots")
+        self._c_tokens = reg.counter(
+            "serve_tokens_total", "tokens emitted by the engine")
+
+    # -- client surface ----------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int, **kw) -> Request:
+        return self.scheduler.submit(prompt, max_new_tokens, **kw)
+
+    @property
+    def active_slots(self) -> int:
+        return sum(1 for s in self._slots if s is not None)
+
+    @property
+    def has_work(self) -> bool:
+        return self.active_slots > 0 or self.scheduler.queue_depth > 0
+
+    # -- engine loop pieces (one driving thread) ---------------------------
+
+    def step(self) -> bool:
+        """One scheduler round: admit + prefill into free slots, one
+        batched decode round, retire finished rows. Returns False when
+        there was nothing to do (caller may sleep/park)."""
+        sched = self.scheduler
+        sched.round += 1
+        changed = self._admit()
+        if self.active_slots == 0:
+            self._g_occ.set(0)
+            if changed:
+                self._sync_slots()
+            return changed
+        host_tok, dt = self._decode_round()
+        self.round_seconds.append(dt)
+        self._h_tok.observe(dt)
+        occ = self.active_slots
+        self._g_occ.set(occ)
+        self._c_tokens.inc(occ)
+        self._occ_sum += occ
+        flight.record("serve", "decode_round", step=sched.round,
+                      note=f"occ={occ}/{self.max_slots}")
+        retired = self._collect(host_tok)
+        if retired:
+            self._sync_slots()
+        return True
+
+    def run_until_idle(self) -> None:
+        """Drive rounds until queue and batch are both empty."""
+        while self.has_work:
+            self.step()
+
+    def drain(self) -> int:
+        """Graceful shutdown: reject everything queued, finish every
+        in-flight sequence, leave the batch empty. Returns the number
+        of requests that were still queued (now rejected)."""
+        rejected = self.scheduler.drain()
+        while self.active_slots > 0:
+            self.step()
+        flight.record("serve", "drained",
+                      note=f"rejected_queued={rejected}")
+        return rejected
+
+    # -- internals ---------------------------------------------------------
+
+    def _admit(self) -> bool:
+        """Pull scheduler admissions into free slots and prefill them."""
+        free = [i for i, s in enumerate(self._slots) if s is None]
+        if not free:
+            return False
+        admitted = self.scheduler.next_admissions(len(free))
+        if not admitted:
+            return False
+        for req in admitted:
+            slot = free.pop(0)
+            self._prefill_into(slot, req)
+        # a budget-1 (or instant-eos) request retires in the same pass
+        self._retire_finished()
+        self._sync_slots()
+        return True
+
+    def _prefill_into(self, slot: int, req: Request) -> None:
+        L = len(req.prompt)
+        pad = min(_bucket_len(L), self.max_seq_len)
+        tokens = np.zeros((1, pad), np.int32)
+        tokens[0, :L] = req.prompt  # left-ALIGNED (pad tail is masked)
+        row_cache = _fresh_cache(self.model, 1, pad)
+        with obs.span("serve/prefill", request=req.request_id,
+                      prompt_len=L):
+            tok0, row_cache = _serve_prefill(
+                self.model, self.params, row_cache,
+                jnp.asarray(tokens), jnp.asarray([L], jnp.int32))
+            first = int(np.asarray(tok0)[0])
+        now = time.monotonic()
+        req.t_first_token = now
+        self._h_ttft.observe(now - req.t_submit)
+        self._cache = _insert_row(self._cache, row_cache, slot)
+        self._slots[slot] = _Slot(req, first, depth=L)
+        self._h_last[slot] = first
+        self._h_depth[slot] = L
+        self._h_active[slot] = True
+        self._c_tokens.inc()  # the prefill-produced first token
+        flight.record("serve", "admit", step=self.scheduler.round,
+                      note=f"{req.request_id} slot={slot} L={L}")
+
+    def _decode_round(self):
+        """THE hot loop body (see module docstring for the lint
+        contract: no host->device transfers, no jnp/jax array
+        construction — device state stays resident; one (slots,)
+        device->host fetch)."""
+        t0 = time.monotonic()
+        # chaos slow@/crash@/preempt@ key on the decode round the way
+        # they key on the training step; inside the timed window so an
+        # injected slow round shows up in the latency histograms
+        # exactly like a real one
+        chaos.on_step(self.scheduler.round)
+        nxt, depth, self._cache = _serve_step(
+            self.model, self.params, self._cache, self._d_last,
+            self._d_depth, self._d_active)
+        self._d_last, self._d_depth = nxt, depth
+        host_tok = np.asarray(nxt)
+        return host_tok, time.monotonic() - t0
+
+    def _collect(self, host_tok: np.ndarray) -> int:
+        """Fold one round's tokens into the host slot mirrors and
+        retire rows that hit eos or budget. Returns retired count."""
+        for i, s in enumerate(self._slots):
+            if s is None:
+                continue
+            tok = int(host_tok[i])
+            s.tokens.append(tok)
+            s.emitted += 1
+            s.depth += 1
+            self._h_last[i] = tok
+            self._h_depth[i] = s.depth
+            self.scheduler.pool.extend(s.req.request_id, s.depth)
+        return self._retire_finished()
+
+    def _done(self, s: _Slot) -> bool:
+        if s.emitted >= s.req.max_new_tokens:
+            return True
+        return self.eos_token is not None and \
+            s.tokens[-1] == self.eos_token
+
+    def _retire_finished(self) -> int:
+        retired = 0
+        for i, s in enumerate(self._slots):
+            if s is None or not self._done(s):
+                continue
+            self._slots[i] = None
+            self._h_active[i] = False
+            retired += 1
+            req = s.req
+            self.scheduler.retire(req, np.asarray(s.tokens, np.int32))
+            flight.record("serve", "retire", step=self.scheduler.round,
+                          note=f"{req.request_id} tokens={s.emitted}")
+            self._finish_record(req, s)
+        return retired
+
+    def _finish_record(self, req: Request, s: _Slot) -> None:
+        ttft = req.t_first_token - req.t_submit
+        total = req.t_done - req.t_submit
+        decode = req.t_done - req.t_first_token
+        per_tok = decode / max(s.emitted - 1, 1)
+        rec = dict(
+            request_id=req.request_id, prompt_len=len(req.prompt),
+            new_tokens=s.emitted, ttft_s=ttft, total_s=total,
+            per_token_s=per_tok,
+            rounds_waited=req.round_admitted - req.round_submitted,
+            kv_util=self.scheduler.pool.utilization(),
+        )
+        self.completed.append(rec)
+        if self.metrics is not None:
+            self.metrics.emit("serve_request", **rec)
+        tracer = obs.current_recorder()
+        if tracer is not None:
+            # retroactive per-request span: duration is only known now
+            end_us = tracer._now_us()
+            tracer.add_event(f"serve/{req.request_id}",
+                             end_us - total * 1e6, total * 1e6,
+                             cat="serve", args=dict(
+                                 prompt_len=len(req.prompt),
+                                 new_tokens=s.emitted,
+                                 ttft_ms=ttft * 1e3))
+
+    def _sync_slots(self) -> None:
+        """Push the host slot mirrors to device (admission/retirement
+        path only — never per round)."""
+        self._d_last = jnp.asarray(self._h_last)
+        self._d_depth = jnp.asarray(self._h_depth)
+        self._d_active = jnp.asarray(self._h_active)
+
+    def summary(self) -> dict:
+        """Engine-lifetime aggregates (bench + serve_summary JSONL)."""
+        rounds = len(self.round_seconds)
+        occ = self._occ_sum / max(rounds * self.max_slots, 1)
+        return dict(
+            rounds=rounds,
+            requests_done=len(self.completed),
+            tokens_out=int(sum(r["new_tokens"] for r in self.completed)),
+            occupancy=occ,
+            kv_util=self.scheduler.pool.utilization(),
+            queue_depth=self.scheduler.queue_depth,
+        )

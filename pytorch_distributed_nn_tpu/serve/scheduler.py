@@ -1,0 +1,248 @@
+"""Request scheduler: bounded admission queue + prefill/decode policy.
+
+The serving control plane. Clients call :meth:`Scheduler.submit` from
+any thread; the engine loop (one thread, :mod:`serve.engine`) calls
+:meth:`next_admissions` once per decode round to pull newly admitted
+requests into free batch slots, and :meth:`retire` / :meth:`fail` to
+release them. Policy decisions live here so the engine stays a dumb
+batch-stepper:
+
+- **backpressure**: the waiting queue is bounded (``max_queue``); a
+  submit that finds it full is rejected immediately with reason
+  ``backpressure`` instead of growing an unbounded buffer the server
+  then OOMs on. Chaos load-shedding (``serve_reject@p=``) and oversize
+  prompts (``too_large``) reject at the same choke point;
+- **anti-starvation**: admission is STRICT FIFO with no bypass. If the
+  queue head does not fit (batch slot or KV-pool reservation), nothing
+  behind it is admitted this round — smaller requests cannot
+  leapfrog a big one forever. With reservation-at-admission
+  (:mod:`serve.kv_pool`) every running sequence finishes within its
+  token budget, so the head waits at most the longest remaining budget
+  before capacity frees: every admitted request finishes within a
+  bounded number of scheduler rounds (tested under sustained overload
+  in tests/test_serve.py);
+- **interleave**: at most ``max_prefills_per_round`` queued requests
+  are admitted per round. Prefill is O(prompt) compute injected into
+  the decode cadence — unbounded admission would stall every running
+  stream's next token behind a burst of prefills (TTFT for the new
+  requests at the cost of inter-token latency for everyone else);
+- **deadlines**: a request whose deadline passes while still queued is
+  rejected (``deadline``) at the next round rather than prefillled into
+  a batch slot it can no longer use.
+
+Every request state change goes through :meth:`Scheduler._transition`,
+which increments the ``serve_requests_total{state=}`` counter — the
+test_quality.py lint enforces that no admit/reject/retire path can
+bypass the accounting. Rejections additionally bump
+``serve_rejects_total{reason=}`` and land a ``serve`` event in the
+flight ring, so an overloaded server's shed traffic is visible in
+post-mortems, not just in client-side errors.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import itertools
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from pytorch_distributed_nn_tpu.obs import flight
+from pytorch_distributed_nn_tpu.obs.registry import get_registry
+from pytorch_distributed_nn_tpu.runtime import chaos
+from pytorch_distributed_nn_tpu.serve.kv_pool import KVPool
+
+# request lifecycle (terminal states: REJECTED, DONE, FAILED)
+QUEUED = "queued"
+RUNNING = "running"
+DONE = "done"
+REJECTED = "rejected"
+FAILED = "failed"
+
+_ids = itertools.count()
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request and its lifecycle record. ``done`` is set
+    exactly once, on any terminal transition — clients block on it."""
+
+    prompt: np.ndarray  # (L,) int32
+    max_new_tokens: int
+    request_id: str
+    deadline_s: Optional[float] = None  # absolute time.monotonic()
+    state: str = QUEUED
+    reject_reason: str = ""
+    tokens: Optional[np.ndarray] = None  # generated tokens, (<=n,) int32
+    done: threading.Event = dataclasses.field(
+        default_factory=threading.Event)
+    # timing (time.monotonic()) — TTFT/latency histograms feed on these
+    t_submit: float = 0.0
+    t_admit: float = 0.0
+    t_first_token: float = 0.0
+    t_done: float = 0.0
+    # scheduler-round bookkeeping (the anti-starvation test's evidence)
+    round_submitted: int = -1
+    round_admitted: int = -1
+    round_done: int = -1
+
+    @property
+    def total_tokens(self) -> int:
+        return len(self.prompt) + self.max_new_tokens
+
+    @property
+    def ok(self) -> bool:
+        return self.state == DONE
+
+
+class Scheduler:
+    """Admission queue + policy over a shared :class:`KVPool`."""
+
+    def __init__(self, pool: KVPool, *, max_queue: int = 64,
+                 max_seq_len: int = 0,
+                 max_prefills_per_round: int = 2) -> None:
+        if max_queue < 1:
+            raise ValueError(f"max_queue must be >= 1, got {max_queue}")
+        if max_prefills_per_round < 1:
+            raise ValueError("max_prefills_per_round must be >= 1, got "
+                             f"{max_prefills_per_round}")
+        self.pool = pool
+        self.max_queue = max_queue
+        self.max_seq_len = int(max_seq_len)
+        self.max_prefills_per_round = max_prefills_per_round
+        self._lock = threading.Lock()
+        self._queue: collections.deque[Request] = collections.deque()
+        self.round = 0  # advanced by the engine, one per decode round
+        self.draining = False
+        reg = get_registry()
+        self._c_requests = reg.counter(
+            "serve_requests_total", "request state transitions",
+            labels=("state",))
+        self._c_rejects = reg.counter(
+            "serve_rejects_total", "requests rejected at admission",
+            labels=("reason",))
+        self._g_queue = reg.gauge(
+            "serve_queue_depth", "requests waiting for a batch slot")
+
+    # -- the single state-change choke point -------------------------------
+
+    def _transition(self, req: Request, state: str,
+                    reason: str = "") -> None:
+        """EVERY request state change funnels through here (lint-
+        enforced): the counter can't drift from reality, and terminal
+        states release the waiting client exactly once."""
+        req.state = state
+        self._c_requests.inc(state=state)
+        if state == REJECTED:
+            req.reject_reason = reason
+            self._c_rejects.inc(reason=reason)
+            flight.record("serve", f"reject:{reason}", note=req.request_id)
+        if state in (DONE, REJECTED, FAILED):
+            req.t_done = time.monotonic()
+            req.round_done = self.round
+            req.done.set()
+
+    # -- client side -------------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int, *,
+               deadline_s: Optional[float] = None,
+               request_id: Optional[str] = None) -> Request:
+        """Thread-safe admission attempt. Always returns a Request; a
+        rejected one is already terminal (``done`` set, ``state ==
+        REJECTED``, ``reject_reason`` says why)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if prompt.size < 1:
+            raise ValueError("empty prompt")
+        if max_new_tokens < 1:
+            raise ValueError(
+                f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        req = Request(
+            prompt=prompt, max_new_tokens=int(max_new_tokens),
+            request_id=request_id or f"req-{next(_ids)}",
+            deadline_s=deadline_s, t_submit=time.monotonic(),
+        )
+        with self._lock:
+            req.round_submitted = self.round
+            if self.draining:
+                self._transition(req, REJECTED, reason="draining")
+            elif self.max_seq_len and req.total_tokens > self.max_seq_len:
+                self._transition(req, REJECTED, reason="too_large")
+            elif chaos.on_admit(req.request_id):
+                # chaos already emitted its own flight event (emit-first
+                # lint); this transition adds the scheduler's view
+                self._transition(req, REJECTED, reason="chaos")
+            elif len(self._queue) >= self.max_queue:
+                self._transition(req, REJECTED, reason="backpressure")
+            else:
+                self._queue.append(req)
+                self._transition(req, QUEUED)
+            self._g_queue.set(len(self._queue))
+        return req
+
+    # -- engine side (one thread) ------------------------------------------
+
+    def next_admissions(self, free_slots: int) -> list[Request]:
+        """Pop FIFO-eligible requests for this round: each must fit a
+        free batch slot AND reserve its worst-case KV blocks. Strict
+        FIFO — a head that doesn't fit blocks everything behind it
+        (that's the anti-starvation invariant, not an inefficiency to
+        optimize away without replacing the fairness proof)."""
+        admitted: list[Request] = []
+        now = time.monotonic()
+        with self._lock:
+            while (self._queue and free_slots > 0
+                   and len(admitted) < self.max_prefills_per_round):
+                head = self._queue[0]
+                if head.deadline_s is not None and now > head.deadline_s:
+                    self._queue.popleft()
+                    self._transition(head, REJECTED, reason="deadline")
+                    continue
+                if not self.pool.reserve(head.request_id,
+                                         head.total_tokens):
+                    break  # no bypass: wait for blocks to free
+                self._queue.popleft()
+                head.t_admit = now
+                head.round_admitted = self.round
+                self._transition(head, RUNNING)
+                admitted.append(head)
+                free_slots -= 1
+            self._g_queue.set(len(self._queue))
+        return admitted
+
+    def retire(self, req: Request, tokens: np.ndarray) -> None:
+        """A sequence finished (eos or budget): release its blocks and
+        hand the tokens to the waiting client."""
+        req.tokens = np.asarray(tokens, np.int32)
+        self.pool.free(req.request_id)
+        with self._lock:
+            self._transition(req, DONE)
+
+    def fail(self, req: Request, reason: str) -> None:
+        """Evict a running sequence (engine error path). Blocks are
+        freed; the client sees FAILED, not a hang."""
+        self.pool.free(req.request_id)
+        with self._lock:
+            req.reject_reason = reason
+            self._transition(req, FAILED)
+        flight.record("serve", f"evict:{reason}", note=req.request_id)
+
+    def drain(self) -> int:
+        """Enter drain mode: stop admitting, reject everything still
+        queued (reason ``draining``) so clients unblock; running
+        sequences are the engine's to finish. Returns rejected count."""
+        with self._lock:
+            self.draining = True
+            n = len(self._queue)
+            while self._queue:
+                self._transition(self._queue.popleft(), REJECTED,
+                                 reason="draining")
+            self._g_queue.set(0)
+        return n
+
+    @property
+    def queue_depth(self) -> int:
+        with self._lock:
+            return len(self._queue)

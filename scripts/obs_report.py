@@ -51,12 +51,15 @@ def _fmt_pct(v: float) -> str:
     return f"{100.0 * v:6.1f}%"
 
 
-def print_goodput_table(events: list[dict], last: int) -> bool:
+def print_goodput_table(events: list[dict], last: int,
+                        quiet: bool = False) -> bool:
     windows = [e for e in events if e.get("event") == "goodput"]
     summary = next((e for e in events
                     if e.get("event") == "goodput_summary"), None)
     if not windows and summary is None:
-        print("no goodput events found (run with cfg.metrics_path set)")
+        if not quiet:  # a serving-only file is not a broken train run
+            print("no goodput events found (run with cfg.metrics_path "
+                  "set)")
         return False
     header = (f"{'window@step':>12} {'steps':>5} {'wall_s':>10} "
               + " ".join(f"{p:>10}" for p in PHASES)
@@ -161,6 +164,63 @@ def print_metric_tail(events: list[dict], last: int) -> None:
                   f"acc {_num(e, 'accuracy'):.4f}")
 
 
+def print_serving_table(events: list[dict], last: int) -> bool:
+    """Serving SLO section: per-request TTFT / per-token latency
+    percentiles from ``serve_request`` events (scripts/serve.py
+    --metrics-out) plus the run-level ``serve_summary`` line. Silently
+    skipped when the file has no serving events (training-only runs)."""
+    reqs = [e for e in events if e.get("event") == "serve_request"]
+    summary = next((e for e in reversed(events)
+                    if e.get("event") == "serve_summary"), None)
+    if not reqs and summary is None:
+        return False
+
+    def _pct(xs: list[float], q: float) -> float:
+        if not xs:
+            return 0.0
+        xs = sorted(xs)
+        i = min(int(len(xs) * q / 100.0), len(xs) - 1)
+        return xs[i]
+
+    print("\n== serving ==")
+    if reqs:
+        ttft = [_num(e, "ttft_s") for e in reqs]
+        ptok = [_num(e, "per_token_s") for e in reqs]
+        total = [_num(e, "total_s") for e in reqs]
+        toks = sum(int(_num(e, "new_tokens")) for e in reqs)
+        print(f"completed requests: {len(reqs)}  tokens out: {toks}")
+        print(f"{'':>14} {'p50':>10} {'p95':>10} {'p99':>10}")
+        for name, xs in (("ttft_s", ttft), ("per_token_s", ptok),
+                         ("total_s", total)):
+            print(f"{name:>14} {_fmt_s(_pct(xs, 50))} "
+                  f"{_fmt_s(_pct(xs, 95))} {_fmt_s(_pct(xs, 99))}")
+        kv = [_num(e, "kv_util") for e in reqs if "kv_util" in e]
+        if kv:
+            print(f"KV-pool utilization at retire: mean "
+                  f"{_fmt_pct(sum(kv) / len(kv)).strip()}, peak "
+                  f"{_fmt_pct(max(kv)).strip()}")
+        print("-- request tail --")
+        for e in reqs[-last:]:
+            print(f"  {e.get('request_id', '?'):>8}  "
+                  f"prompt {int(_num(e, 'prompt_len')):>4}  "
+                  f"+{int(_num(e, 'new_tokens')):>3} tok  "
+                  f"ttft {_num(e, 'ttft_s') * 1e3:8.2f}ms  "
+                  f"tok {_num(e, 'per_token_s') * 1e3:8.3f}ms")
+    if summary is not None:
+        print("-- run summary --")
+        print(f"  requests {int(_num(summary, 'requests'))} "
+              f"(completed {int(_num(summary, 'completed'))}, "
+              f"rejected {int(_num(summary, 'rejected'))})  "
+              f"{_num(summary, 'tokens_per_s'):.1f} tokens/s  "
+              f"occupancy {_fmt_pct(_num(summary, 'occupancy')).strip()}  "
+              f"kv_util {_fmt_pct(_num(summary, 'kv_util')).strip()}")
+        reasons = summary.get("reject_reasons") or {}
+        if isinstance(reasons, dict) and reasons:
+            why = ", ".join(f"{k}={v}" for k, v in sorted(reasons.items()))
+            print(f"  reject reasons: {why}")
+    return True
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("jsonl", help="metrics JSONL path "
@@ -175,10 +235,13 @@ def main(argv=None) -> int:
     if not events:
         print(f"no events in {args.jsonl}")
         return 1
-    ok = print_goodput_table(events, args.last)
+    has_serve = any(e.get("event") in ("serve_request", "serve_summary")
+                    for e in events)
+    ok = print_goodput_table(events, args.last, quiet=has_serve)
     print_comms_table(events, args.trace or None)
+    serve_ok = print_serving_table(events, args.last)
     print_metric_tail(events, args.last)
-    return 0 if ok else 1
+    return 0 if (ok or serve_ok) else 1
 
 
 if __name__ == "__main__":

@@ -41,16 +41,18 @@ def test_parse_full_grammar():
         "slow@rank=2:ms=200;"
         "preempt@step=9;"
         "corrupt_ckpt@step=6;"
-        "store_flaky@p=0.1"
+        "store_flaky@p=0.1;"
+        "serve_reject@p=0.3"
     )
     kinds = [f.kind for f in faults]
     assert kinds == ["crash", "hang", "slow", "preempt", "corrupt_ckpt",
-                     "store_flaky"]
+                     "store_flaky", "serve_reject"]
     assert faults[0].step == 7 and faults[0].rank == 1
     assert faults[0].inc == 0
     assert faults[1].collective == "all_reduce" and faults[1].ms == 50.0
     assert faults[2].ms == 200.0 and faults[2].rank == 2
     assert faults[5].p == 0.1
+    assert faults[6].p == 0.3
 
 
 @pytest.mark.parametrize("bad", [
@@ -63,6 +65,9 @@ def test_parse_full_grammar():
     "crash@foo=1",          # unknown key
     "crash@step",           # not key=value
     "store_flaky@p=1.5",    # p out of range
+    "serve_reject",         # missing required p=
+    "serve_reject@p=2",     # p out of range
+    "serve_reject@step=1",  # step alone doesn't satisfy required p=
     "",                     # empty
 ])
 def test_parse_rejects_bad_specs(bad):

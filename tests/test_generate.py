@@ -220,3 +220,86 @@ def test_decode_rejects_explicit_positions(tiny_transformer_lm):
                     jnp.zeros((1, 2), jnp.int32), decode=True,
                     positions=jnp.zeros((1, 2), jnp.int32),
                     mutable=["cache"])
+
+
+# ---------------------------------------------------------------------------
+# Ragged (left-padded) batched generation — ISSUE 5 golden satellite
+# ---------------------------------------------------------------------------
+
+def _assert_ragged_matches_per_sequence(model, params, lengths, n_new=6):
+    """The serving-stack oracle: a left-padded ragged batch decoded via
+    per-row cache positions must produce, for every row, exactly the
+    tokens of that prompt run alone through generate()."""
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, 97, size=(n,)).astype(np.int32)
+               for n in lengths]
+    P = max(lengths)
+    batch = np.zeros((len(lengths), P), np.int32)
+    for i, p in enumerate(prompts):
+        batch[i, P - len(p):] = p  # left-padding convention
+    out = np.asarray(generate(model, params, batch, n_new,
+                              prompt_lengths=np.asarray(lengths)))
+    assert out.shape == (len(lengths), P + n_new)
+    for i, p in enumerate(prompts):
+        ref = np.asarray(generate(model, params, p[None], n_new))
+        np.testing.assert_array_equal(
+            out[i, P:], ref[0, len(p):],
+            err_msg=f"row {i} (len {len(p)}) diverged from its solo run")
+
+
+def test_ragged_batch_bit_identical_llama(tiny_llama):
+    model, params = tiny_llama
+    _assert_ragged_matches_per_sequence(model, params, [5, 1, 8, 3])
+
+
+def test_ragged_batch_bit_identical_transformer_lm(tiny_transformer_lm):
+    model, params = tiny_transformer_lm
+    _assert_ragged_matches_per_sequence(model, params, [5, 1, 8, 3])
+
+
+def test_ragged_uniform_lengths_match_dense_path(tiny_llama):
+    """prompt_lengths == full width must reproduce the uniform path."""
+    model, params = tiny_llama
+    prompt = jnp.asarray([[5, 17, 42], [96, 1, 3]], jnp.int32)
+    want = generate(model, params, prompt, 5)
+    got = generate(model, params, prompt, 5,
+                   prompt_lengths=np.array([3, 3]))
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(want))
+
+
+def test_ragged_pad_values_are_dont_care(tiny_llama):
+    """Garbage in the pad columns must not leak into any row's output
+    (the masked slots contribute exact 0.0 after softmax)."""
+    model, params = tiny_llama
+    lengths = np.array([2, 4])
+    a = np.array([[0, 0, 7, 9], [1, 2, 3, 4]], np.int32)
+    b = np.array([[55, 88, 7, 9], [1, 2, 3, 4]], np.int32)
+    out_a = np.asarray(generate(model, params, a, 4,
+                                prompt_lengths=lengths))
+    out_b = np.asarray(generate(model, params, b, 4,
+                                prompt_lengths=lengths))
+    np.testing.assert_array_equal(out_a[:, 4:], out_b[:, 4:])
+
+
+def test_ragged_validation_errors(tiny_llama):
+    model, params = tiny_llama
+    prompt = jnp.ones((2, 4), jnp.int32)
+    with pytest.raises(ValueError, match="prompt_lengths must be"):
+        generate(model, params, prompt, 2, prompt_lengths=[4])  # shape
+    with pytest.raises(ValueError, match="in \\[1, 4\\]"):
+        generate(model, params, prompt, 2, prompt_lengths=[0, 4])
+    with pytest.raises(ValueError, match="in \\[1, 4\\]"):
+        generate(model, params, prompt, 2, prompt_lengths=[2, 5])
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        generate(model, params, prompt, 2, prompt_lengths=[2, 4],
+                 prefill_chunk=2)
+
+
+def test_ragged_rejects_mesh(tiny_llama):
+    from pytorch_distributed_nn_tpu.runtime.mesh import MeshSpec, make_mesh
+
+    model, params = tiny_llama
+    mesh = make_mesh(MeshSpec(tensor=2, data=4).resolve(8))
+    with pytest.raises(ValueError, match="mesh"):
+        generate(model, params, jnp.ones((2, 4), jnp.int32), 2,
+                 prompt_lengths=[2, 4], mesh=mesh)
