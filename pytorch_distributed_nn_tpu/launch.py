@@ -51,7 +51,7 @@ import subprocess
 import sys
 import time
 
-from .obs import runtime_gauges
+from .obs import aggregate, runtime_gauges, watchtower
 from .runtime import failure, native
 
 log = logging.getLogger(__name__)
@@ -333,8 +333,38 @@ class ElasticAgent:
 
     # -- one incarnation ---------------------------------------------------
 
-    def _watch(self, detector: failure.FailureDetector | None
-               ) -> tuple[str, int]:
+    def _feed_rank_progress(self, monitor,
+                            incarnation: int,
+                            detector: failure.FailureDetector) -> None:
+        """Supervisor-side straggler feed for the watchtower: per-rank
+        cumulative step counts come from the aggregate snapshots each
+        worker publishes at log cadence (obs/aggregate.py), so no new
+        transport is needed. The drift detector compares every rank's
+        step rate against the peer median and pages with the lagging
+        rank *named*; on a fresh page the agent also asks every worker
+        for a flight dump so obs_doctor has rings to attribute
+        against."""
+        cfg = self.cfg
+        base = cfg.nprocs * cfg.node_rank
+        try:
+            snaps = aggregate.collect_snapshots(
+                monitor, list(range(base, base + cfg.nprocs)),
+                incarnation=incarnation)
+        except OSError:
+            return
+        steps = {r: s["train_steps_total"] for r, s in snaps.items()
+                 if "train_steps_total" in s}
+        if len(steps) < 2:
+            return
+        tower = watchtower.tower()
+        before = len(tower.alerts) if tower is not None else 0
+        watchtower.on_rank_progress(steps)
+        if tower is not None and any(
+                a.kind == "straggler_drift" for a in tower.alerts[before:]):
+            detector.request_flight_dump("watchtower straggler_drift")
+
+    def _watch(self, detector: failure.FailureDetector | None,
+               monitor=None, incarnation: int = 0) -> tuple[str, int]:
         """Poll until the gang succeeds, a worker fails, or a worker
         hangs. Success requires *every* worker to exit 0. Returns
         (reason, exit_code) with reason in {"ok", "crash", "hang",
@@ -363,6 +393,8 @@ class ElasticAgent:
                 # missed-beat gauges in the process registry (scraped /
                 # snapshotted like any worker metric)
                 runtime_gauges.export_detector_gauges(detector)
+                if watchtower.enabled() and monitor is not None:
+                    self._feed_rank_progress(monitor, incarnation, detector)
                 if stale:
                     log.warning("heartbeat lost from ranks %s", stale)
                     # Flight-recorder forensics: ask every worker's
@@ -393,6 +425,9 @@ class ElasticAgent:
 
     def run(self) -> LaunchResult:
         cfg = self.cfg
+        # supervisor-side watchtower (TPUNN_WATCH): the agent feeds it
+        # cross-rank step progress; workers arm their own instance
+        watchtower.maybe_init()
         policy = self._policy()
         history: list[IncarnationRecord] = []
         incarnation = 0
@@ -423,7 +458,7 @@ class ElasticAgent:
                     )
                 self._spawn(incarnation,
                             server.port if server is not None else None)
-                reason, code = self._watch(detector)
+                reason, code = self._watch(detector, monitor, incarnation)
                 if detector is not None:
                     # the fail-fast discriminator, read BEFORE the store
                     # goes down with the gang

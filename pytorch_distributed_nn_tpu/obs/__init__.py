@@ -17,14 +17,23 @@ heartbeats) with:
   bounded per-host ring of collective/step/checkpoint/data events,
   dumped to ``flight_rank<k>.json`` on hangs/crashes;
 - :mod:`obs.forensics` — cross-rank dump analysis (first divergent
-  collective, hang/crash/straggler classification).
+  collective, hang/crash/straggler classification);
+- :mod:`obs.stats` — shared stdlib-only percentile/median/MAD/EWMA
+  helpers the reporting and detection layers agree on;
+- :mod:`obs.watchtower` — online anomaly detection (ISSUE 7): streaming
+  detectors over the metric/flight streams raising structured alerts
+  (step-time outliers, loss spikes, straggler drift, queue/KV pressure,
+  multi-window SLO burn rate), inert unless ``TPUNN_WATCH`` is set.
 
 ``scripts/obs_report.py`` renders the JSONL/trace output;
 ``scripts/obs_doctor.py`` analyzes flight dumps;
+``scripts/obs_watch.py`` tails/replays alerts and burn rates;
 ``bench.py --goodput`` attaches the breakdown to benchmark records.
 """
 
 from pytorch_distributed_nn_tpu.obs import flight  # noqa: F401
+from pytorch_distributed_nn_tpu.obs import stats  # noqa: F401
+from pytorch_distributed_nn_tpu.obs import watchtower  # noqa: F401
 from pytorch_distributed_nn_tpu.obs.goodput import (  # noqa: F401
     PHASES,
     GoodputMeter,

@@ -42,7 +42,11 @@ Event kinds:
 - ``preempt`` — preemption-notice markers (SIGTERM → graceful exit);
 - ``serve`` — serving-engine lifecycle (serve/): one event per decode
   round plus admit/reject/retire/drain markers, so the doctor can see
-  a wedged decode loop or shed traffic post-mortem.
+  a wedged decode loop or shed traffic post-mortem;
+- ``alert`` — a watchtower alert (obs/watchtower.py): every online
+  detection lands here emit-first, and page-severity alerts trigger an
+  automatic :func:`dump_now` — the ring that reaches disk already
+  names what the run knew was wrong.
 
 Stdlib-only on purpose: dump paths run inside signal handlers and
 heartbeat daemon threads of processes whose main thread is wedged
@@ -98,7 +102,7 @@ class FlightEvent:
 
     seq: int
     kind: str  # collective | dispatch | step | checkpoint | data
-    #          # | chaos | preempt | serve
+    #          # | chaos | preempt | serve | alert
     op: str
     step: int
     t0: float

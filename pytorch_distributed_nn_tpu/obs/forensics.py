@@ -30,6 +30,8 @@ import json
 import os
 import re
 
+from pytorch_distributed_nn_tpu.obs.stats import percentile
+
 _COLLECTIVE_KINDS = ("collective",)
 _CRASH_REASON = re.compile(r"^(exception:|signal:SIGABRT|chaos:crash)")
 _HANG_REASON = re.compile(
@@ -68,6 +70,13 @@ class RankDump:
         ring — surfaced so a post-mortem never misattributes a test
         fault to a production failure."""
         return [e for e in self.events if e.get("kind") == "chaos"]
+
+    @property
+    def alert_events(self) -> list[dict]:
+        """Watchtower alerts (obs/watchtower.py) that fired before the
+        dump — the online detector's verdicts ride the ring so the
+        doctor sees what the run already knew about itself."""
+        return [e for e in self.events if e.get("kind") == "alert"]
 
     def last_event(self) -> dict | None:
         return self.events[-1] if self.events else None
@@ -180,15 +189,44 @@ def find_divergence(dumps: dict[int, RankDump]) -> Divergence | None:
 
 
 # ---------------------------------------------------------------------------
-# Straggler report: per-rank step-time percentiles
+# Single-ring attribution (the watchtower's page-alert classifier)
 # ---------------------------------------------------------------------------
 
-def _pct(sorted_vals: list[float], q: float) -> float:
-    if not sorted_vals:
-        return 0.0
-    idx = min(int(q * (len(sorted_vals) - 1) + 0.5),
-              len(sorted_vals) - 1)
-    return sorted_vals[idx]
+_RANK_IN_SPEC = re.compile(r"\brank=(\d+)\b")
+
+
+def attribute(events: list[dict]) -> dict:
+    """Name the suspect from ONE ring's events (no cross-rank dumps
+    yet): the last incomplete collective (a hang's smoking gun), the
+    last shed/evicted request, and any injected chaos faults — with the
+    chaos spec's ``rank=`` parsed out so a synthetic straggler points at
+    the injected rank. Timestamp-free on purpose: the watchtower embeds
+    this in alerts that must be byte-identical across replays."""
+    out: dict = {"suspect_rank": None, "suspect_collective": "",
+                 "suspect_request": "", "chaos_kinds": [],
+                 "incomplete_collectives": 0}
+    chaos = [e for e in events if e.get("kind") == "chaos"]
+    out["chaos_kinds"] = sorted({e.get("op", "") for e in chaos})
+    for e in chaos:
+        m = _RANK_IN_SPEC.search(e.get("note", ""))
+        if m:
+            out["suspect_rank"] = int(m.group(1))
+    incomplete = [e for e in events
+                  if e.get("kind") in _COLLECTIVE_KINDS
+                  and e.get("t1") is None]
+    out["incomplete_collectives"] = len(incomplete)
+    if incomplete:
+        out["suspect_collective"] = incomplete[-1].get("op", "")
+    for e in events:
+        if e.get("kind") == "serve" and \
+                str(e.get("op", "")).startswith(("reject:", "evict:")):
+            out["suspect_request"] = e.get("note", "")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Straggler report: per-rank step-time percentiles
+# ---------------------------------------------------------------------------
 
 
 @dataclasses.dataclass
@@ -217,8 +255,8 @@ def straggler_report(dumps: dict[int, RankDump]) -> list[StragglerRow]:
         rows.append(StragglerRow(
             rank=rank,
             steps=len(ts),
-            p50_s=_pct(deltas, 0.50),
-            p90_s=_pct(deltas, 0.90),
+            p50_s=percentile(deltas, 0.50),
+            p90_s=percentile(deltas, 0.90),
             max_s=deltas[-1] if deltas else 0.0,
             last_step=d.steps[-1]["step"] if d.steps else -1,
             last_event_age_s=(d.dumped_at - last_t
@@ -230,7 +268,7 @@ def straggler_report(dumps: dict[int, RankDump]) -> list[StragglerRow]:
     for r in rows:
         others = sorted(o.p50_s for o in rows
                         if o.rank != r.rank and o.steps > 1)
-        base = _pct(others, 0.5)
+        base = percentile(others, 0.5)
         r.flagged = (base > 0 and r.steps > 1
                      and r.p50_s > STRAGGLER_FACTOR * base)
     return rows
@@ -428,6 +466,16 @@ def render_report(dumps: dict[int, RankDump],
             "not organic):")
         for r in sorted(chaos):
             for ev in chaos[r]:
+                out(f"  rank {r}: {_fmt_event(ev)}")
+
+    alerts = {r: d.alert_events for r, d in dumps.items()
+              if d.alert_events}
+    if alerts:
+        out("")
+        out("watchtower alerts (obs/watchtower.py — fired online, "
+            "before the dump):")
+        for r in sorted(alerts):
+            for ev in alerts[r][-5:]:
                 out(f"  rank {r}: {_fmt_event(ev)}")
 
     hung = {r: d.incomplete() for r, d in dumps.items()

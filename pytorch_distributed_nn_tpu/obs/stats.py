@@ -1,0 +1,66 @@
+"""Shared streaming/summary statistics for the obs stack.
+
+Three copies of a ``_pct`` percentile helper grew independently in
+``obs/forensics.py``, ``scripts/obs_report.py`` and ``scripts/serve.py``
+— with three subtly different index formulas. This module is the single
+implementation (nearest-rank, the forensics semantics: stable, exact on
+small samples, no interpolation inventing values that never occurred),
+plus the robust-location/scale helpers the watchtower's detectors run
+on (median, MAD, EWMA).
+
+Stdlib-only (like :mod:`obs.flight` / :mod:`obs.forensics`): these run
+inside the doctor on a dev box and inside detector hot paths — neither
+may import numpy/jax.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Optional, Sequence
+
+
+def percentile(xs: Iterable[float], q: float) -> float:
+    """Nearest-rank percentile of ``xs`` at quantile ``q`` in [0, 1]
+    (q=0 → min, q=1 → max). Sorts a copy; 0.0 on an empty input (the
+    report-table convention: an empty column renders as zero, it does
+    not throw mid-table)."""
+    vals = sorted(float(x) for x in xs)
+    if not vals:
+        return 0.0
+    q = min(max(q, 0.0), 1.0)
+    idx = min(int(q * (len(vals) - 1) + 0.5), len(vals) - 1)
+    return vals[idx]
+
+
+def median(xs: Iterable[float]) -> float:
+    return percentile(xs, 0.5)
+
+
+def mad(xs: Sequence[float], center: Optional[float] = None) -> float:
+    """Median absolute deviation — the robust scale estimate the
+    step-time outlier detector thresholds on (a stddev would be dragged
+    by the very outliers being hunted)."""
+    vals = [float(x) for x in xs]
+    if not vals:
+        return 0.0
+    c = median(vals) if center is None else float(center)
+    return median(abs(x - c) for x in vals)
+
+
+class Ewma:
+    """Exponentially weighted moving average (robust location for the
+    online detectors). ``value`` is None until the first update."""
+
+    def __init__(self, alpha: float = 0.1) -> None:
+        if not 0.0 < alpha <= 1.0:
+            raise ValueError(f"alpha must be in (0, 1], got {alpha}")
+        self.alpha = alpha
+        self.value: Optional[float] = None
+        self.count = 0
+
+    def update(self, x: float) -> float:
+        x = float(x)
+        self.value = (x if self.value is None
+                      else (1.0 - self.alpha) * self.value
+                      + self.alpha * x)
+        self.count += 1
+        return self.value

@@ -1,0 +1,688 @@
+"""Watchtower: online anomaly detection + SLO burn-rate alerting.
+
+PRs 1–2 built the *passive* observability floor (registry, spans,
+goodput, flight ring, forensics) and PR 5 the SLO-instrumented serving
+engine — but nothing watches those signals: a straggler drifting 20%
+slower, a loss spike, a TTFT SLO burning down or KV-pool pressure all
+sit silently in histograms until a human runs ``obs_report.py`` after
+the fact. This module is the detection layer: a streaming engine that
+subscribes to the stack's event feed (hooks in the Trainer step loop,
+the serving engine/scheduler/server, and the elastic agent's watch
+loop) and to the metric registry, and raises structured
+:class:`Alert`\\ s:
+
+- ``step_time_outlier`` — EWMA center + MAD scale over train-step wall
+  times (a stddev would be dragged by the very outliers being hunted);
+- ``loss_spike`` / ``loss_nonfinite`` — loss above its EWMA by a
+  factor (warn) or NaN/inf (page: the run is wasting accelerator time
+  from this step on);
+- ``straggler_drift`` — supervisor-side: per-rank step-progress rates
+  from the aggregate snapshots (``train_steps_total`` per rank over the
+  native store); a rank progressing slower than the leave-one-out
+  median of its peers by ``drift_factor`` pages *with the rank named*;
+- ``queue_pressure`` / ``kv_pressure`` — serving admission queue near
+  ``max_queue`` / KV-pool headroom below a floor (the early-warning
+  signals ahead of ``backpressure`` rejects);
+- ``slo_burn_rate`` — SRE-style multi-window burn rate (fast/slow
+  window pair, default 5m/1h) over the TTFT and per-token-latency SLOs
+  (``serve_ttft_seconds`` / ``serve_token_latency_seconds`` feeds; a
+  rejected request spends TTFT error budget too — load shedding IS an
+  SLO violation to the client) — pages only when BOTH windows burn,
+  so a blip can't page and a slow leak still does;
+- ``goodput_drop`` — goodput fraction under a floor at log cadence.
+
+Every alert is a first-class event (:meth:`Watchtower._emit`, lint:
+flight-ring record FIRST): ``watchtower_alerts_total{kind,severity}``
+in the registry, a ``watchtower_alert`` JSONL record, an ``alert``
+event in the flight ring, and — for page severity — an automatic
+flight dump plus an inline :func:`obs.forensics.attribute`
+classification so the alert names the suspect rank / collective /
+request, not just the symptom.
+
+Design contract (lint-enforced by tests/test_quality.py, mirroring
+:mod:`runtime.chaos`):
+
+- **inert when unset**: every module-level ``on_*`` hook opens with the
+  literal ``if _tower is None: return`` fast path — an unset
+  ``TPUNN_WATCH`` costs one global load + one comparison per hook, no
+  allocation, no env read;
+- **deterministic on replay**: detectors take time exclusively from
+  the event's ``t`` field (never a wall clock), so replaying the same
+  event stream twice yields byte-identical alert sequences
+  (tests/test_watchtower.py) — the live ``on_*`` adapters stamp
+  ``time.time()`` exactly once at the hook boundary;
+- **emit-first**: :meth:`Watchtower._emit`'s first statement is the
+  flight-ring record, so post-mortems can never miss an alert that
+  fired before a crash.
+
+Env contract: ``TPUNN_WATCH=1`` arms the defaults;
+``TPUNN_WATCH=ttft_slo_s=0.25:burn_threshold=4`` overrides
+:class:`WatchConfig` fields (``:``-separated ``key=value``; a typo'd
+key fails loudly). ``scripts/obs_watch.py`` tails a live JSONL (or
+replays one) and renders active alerts / burn rates.
+"""
+
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import logging
+import math
+import os
+import time
+from typing import Optional
+
+from pytorch_distributed_nn_tpu.obs import flight, forensics
+from pytorch_distributed_nn_tpu.obs.registry import get_registry
+from pytorch_distributed_nn_tpu.obs.stats import Ewma, mad, median
+
+log = logging.getLogger(__name__)
+
+ENV_WATCH = "TPUNN_WATCH"
+
+WARN = "warn"
+PAGE = "page"
+
+ALERT_KINDS = ("step_time_outlier", "loss_spike", "loss_nonfinite",
+               "straggler_drift", "queue_pressure", "kv_pressure",
+               "slo_burn_rate", "goodput_drop")
+
+
+@dataclasses.dataclass
+class WatchConfig:
+    """Detector thresholds; every field is overridable through the
+    ``TPUNN_WATCH`` spec (see :func:`parse_spec`)."""
+
+    # step-time outlier: EWMA center, MAD scale over a trailing window
+    step_warmup: int = 20          # samples before the detector arms
+    step_ewma_alpha: float = 0.1
+    step_mad_k: float = 6.0        # threshold in MADs above the EWMA
+    step_window: int = 64          # trailing samples feeding the MAD
+    # loss
+    loss_warmup: int = 5
+    loss_ewma_alpha: float = 0.2
+    loss_spike_factor: float = 2.0
+    # straggler drift (supervisor feed: per-rank step totals over time)
+    drift_factor: float = 1.5      # leave-one-out median rate ratio
+    drift_min_samples: int = 3     # snapshots per rank before judging
+    drift_history: int = 8         # retained snapshots per rank
+    # serving pressure
+    queue_frac: float = 0.9        # queue_depth / max_queue warn line
+    kv_free_frac: float = 0.1      # free/total KV blocks page-ahead line
+    # SLO burn rate (SRE multi-window: page when BOTH windows burn)
+    ttft_slo_s: float = 0.5
+    token_slo_s: float = 0.1
+    slo_objective: float = 0.9     # success objective (error budget 10%)
+    burn_fast_s: float = 300.0     # 5m fast window
+    burn_slow_s: float = 3600.0    # 1h slow window
+    burn_threshold: float = 2.0
+    burn_min_events: int = 10      # samples in the fast window to judge
+    # goodput
+    goodput_floor: float = 0.5
+    goodput_warmup: int = 2        # windows before the floor applies
+
+
+_FIELD_TYPES = {f.name: f.type for f in dataclasses.fields(WatchConfig)}
+
+
+def parse_spec(spec: str) -> WatchConfig:
+    """``TPUNN_WATCH`` spec → :class:`WatchConfig`. ``"1"`` / ``"on"``
+    mean defaults; otherwise ``:``-separated ``key=value`` overrides.
+    Unknown keys raise (a typo'd watch spec must fail loudly, not
+    silently watch nothing — the chaos-spec contract)."""
+    cfg = WatchConfig()
+    spec = (spec or "").strip()
+    if spec in ("", "1", "on", "true"):
+        return cfg
+    for field in filter(None, spec.split(":")):
+        key, eq, value = field.partition("=")
+        key = key.strip()
+        if not eq or key not in _FIELD_TYPES:
+            raise ValueError(
+                f"unknown watchtower key {key!r} in {spec!r}; have "
+                f"{sorted(_FIELD_TYPES)}")
+        try:
+            kind = _FIELD_TYPES[key]
+            setattr(cfg, key,
+                    int(value) if kind in (int, "int") else float(value))
+        except ValueError:
+            raise ValueError(f"bad value for watchtower key {key!r}: "
+                             f"{value!r}") from None
+    return cfg
+
+
+@dataclasses.dataclass
+class Alert:
+    """One structured alert. ``t`` / ``value`` / ``threshold`` derive
+    from the triggering event only (replay-deterministic); ``seq`` is
+    the position in this tower's alert stream."""
+
+    seq: int
+    kind: str
+    severity: str  # WARN | PAGE
+    t: float       # event time that triggered it
+    step: int
+    value: float
+    threshold: float
+    detail: str
+    attribution: dict
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def as_json(self) -> str:
+        """Canonical serialization — the byte-identical-replay unit."""
+        return json.dumps(self.as_dict(), sort_keys=True)
+
+
+class _BurnWindow:
+    """One SLO's good/bad sample stream, pruned to the slow window;
+    burn = error_fraction / error_budget over a trailing window, all in
+    event time."""
+
+    def __init__(self, objective: float, slow_s: float) -> None:
+        self.budget = max(1.0 - objective, 1e-6)
+        self.slow_s = slow_s
+        self.samples: collections.deque[tuple[float, bool]] = \
+            collections.deque()
+
+    def add(self, t: float, bad: bool) -> None:
+        self.samples.append((float(t), bool(bad)))
+        while self.samples and self.samples[0][0] < t - self.slow_s:
+            self.samples.popleft()
+
+    def burn(self, window_s: float, now: float,
+             min_events: int = 1) -> float:
+        xs = [bad for (t, bad) in self.samples if t >= now - window_s]
+        if len(xs) < min_events:
+            return 0.0
+        return (sum(xs) / len(xs)) / self.budget
+
+
+class Watchtower:
+    """The streaming detector engine. Feed it normalized events via
+    :meth:`observe` (the module ``on_*`` hooks do, stamping wall time;
+    replay feeds recorded times) — every detector is pure in the event
+    stream."""
+
+    def __init__(self, config: Optional[WatchConfig] = None, *,
+                 rank: int = 0, metrics=None,
+                 dump_on_page: bool = True) -> None:
+        self.cfg = config or WatchConfig()
+        self.rank = rank
+        self.metrics = metrics  # MetricsLogger or None
+        self.dump_on_page = dump_on_page
+        self.alerts: list[Alert] = []
+        reg = get_registry()
+        self._c_alerts = reg.counter(
+            "watchtower_alerts_total", "alerts raised",
+            labels=("kind", "severity"))
+        self._g_burn = reg.gauge(
+            "watchtower_burn_rate", "SLO error-budget burn rate",
+            labels=("slo", "window"))
+        # -- detector state (event-time only) --
+        cfg = self.cfg
+        self._step_ewma = Ewma(cfg.step_ewma_alpha)
+        self._step_window: collections.deque[float] = collections.deque(
+            maxlen=cfg.step_window)
+        self._loss_ewma = Ewma(cfg.loss_ewma_alpha)
+        self._loss_spiking = False
+        self._goodput_windows = 0
+        self._goodput_low = False
+        self._queue_high = False
+        self._kv_low = False
+        self._burn_active: set[str] = set()
+        self._burns = {
+            "ttft": _BurnWindow(cfg.slo_objective, cfg.burn_slow_s),
+            "token_latency": _BurnWindow(cfg.slo_objective,
+                                         cfg.burn_slow_s),
+        }
+        # rank -> trailing (t, steps_total) snapshots (supervisor feed)
+        self._rank_hist: dict[int, collections.deque] = {}
+        self._drifting: set[int] = set()
+        # recent finished requests, worst-TTFT-first attribution feed
+        self._recent_reqs: collections.deque[dict] = collections.deque(
+            maxlen=32)
+
+    # -- the alert choke point -------------------------------------------
+
+    def _emit(self, alert: Alert) -> None:
+        """Every alert lands in the flight ring FIRST (lint-enforced:
+        a crash right after an alert must still show it post-mortem),
+        then the registry counter, the JSONL stream, and — page
+        severity — the automatic flight dump."""
+        flight.record("alert", alert.kind, step=alert.step,
+                      note=f"{alert.severity} {alert.detail} "
+                           f"attribution={json.dumps(alert.attribution, sort_keys=True)}")
+        self._c_alerts.inc(kind=alert.kind, severity=alert.severity)
+        self.alerts.append(alert)
+        if self.metrics is not None:
+            self.metrics.emit("watchtower_alert", **alert.as_dict())
+        log.warning("watchtower %s alert: %s — %s", alert.severity,
+                    alert.kind, alert.detail)
+        if alert.severity == PAGE and self.dump_on_page:
+            flight.dump_now(f"alert:{alert.kind}", force=True)
+
+    def _raise(self, kind: str, severity: str, t: float, *,
+               step: int = -1, value: float = 0.0,
+               threshold: float = 0.0, detail: str = "",
+               attribution: Optional[dict] = None) -> Alert:
+        attribution = dict(attribution or {})
+        if severity == PAGE:
+            # inline forensics: the page names a suspect, not a symptom
+            attribution.setdefault("forensics", forensics.attribute(
+                flight.get_recorder().snapshot()))
+        alert = Alert(
+            seq=len(self.alerts), kind=kind, severity=severity,
+            t=round(float(t), 6), step=int(step),
+            value=round(float(value), 6),
+            threshold=round(float(threshold), 6),
+            detail=detail, attribution=attribution,
+        )
+        self._emit(alert)
+        return alert
+
+    # -- event intake ----------------------------------------------------
+
+    def observe(self, event: dict) -> None:
+        """Dispatch one normalized event (must carry ``ev`` and ``t``)
+        to its detector. Unknown kinds are ignored (a newer stream must
+        replay on an older tower)."""
+        handler = self._HANDLERS.get(event.get("ev", ""))
+        if handler is not None:
+            handler(self, event)
+
+    def _obs_train_step(self, ev: dict) -> None:
+        cfg, w, t = self.cfg, float(ev["wall_s"]), float(ev["t"])
+        step = int(ev.get("step", -1))
+        center = self._step_ewma.value
+        if (center is not None
+                and len(self._step_window) >= cfg.step_warmup):
+            scale = max(mad(self._step_window), 0.05 * center, 1e-6)
+            thr = center + cfg.step_mad_k * scale
+            if w > thr:
+                self._raise(
+                    "step_time_outlier", WARN, t, step=step, value=w,
+                    threshold=thr,
+                    detail=f"step {step} took {w:.4f}s vs EWMA "
+                           f"{center:.4f}s (> {cfg.step_mad_k:g} MADs)")
+        # update AFTER the check: an outlier must not mask itself
+        self._step_window.append(w)
+        self._step_ewma.update(w)
+
+    def _obs_loss(self, ev: dict) -> None:
+        cfg, t = self.cfg, float(ev["t"])
+        step = int(ev.get("step", -1))
+        loss = float(ev["loss"])
+        if not math.isfinite(loss):
+            self._raise(
+                "loss_nonfinite", PAGE, t, step=step, value=loss,
+                detail=f"loss is {loss!r} at step {step}: every step "
+                       f"from here is wasted accelerator time")
+            return
+        center = self._loss_ewma.value
+        if (center is not None and center > 0
+                and self._loss_ewma.count >= cfg.loss_warmup):
+            thr = cfg.loss_spike_factor * center
+            if loss > thr and not self._loss_spiking:
+                self._loss_spiking = True
+                self._raise(
+                    "loss_spike", WARN, t, step=step, value=loss,
+                    threshold=thr,
+                    detail=f"loss {loss:.4f} at step {step} is "
+                           f">{cfg.loss_spike_factor:g}x its EWMA "
+                           f"{center:.4f}")
+            elif loss <= center:
+                self._loss_spiking = False  # re-arm after recovery
+        self._loss_ewma.update(loss)
+
+    def _obs_goodput(self, ev: dict) -> None:
+        cfg, t = self.cfg, float(ev["t"])
+        frac = float(ev["goodput_frac"])
+        self._goodput_windows += 1
+        if self._goodput_windows <= cfg.goodput_warmup:
+            return
+        if frac < cfg.goodput_floor and not self._goodput_low:
+            self._goodput_low = True
+            self._raise(
+                "goodput_drop", WARN, t, step=int(ev.get("step", -1)),
+                value=frac, threshold=cfg.goodput_floor,
+                detail=f"goodput fraction {frac:.3f} under the "
+                       f"{cfg.goodput_floor:g} floor")
+        elif frac >= cfg.goodput_floor:
+            self._goodput_low = False
+
+    def _obs_serve_round(self, ev: dict) -> None:
+        cfg, t = self.cfg, float(ev["t"])
+        rnd = int(ev.get("round", -1))
+        wall = float(ev.get("wall_s", 0.0))
+        bw = self._burns["token_latency"]
+        bw.add(t, wall > cfg.token_slo_s)
+        self._check_burn("token_latency", cfg.token_slo_s, t, step=rnd)
+        self._obs_serve_queue(ev)
+        kv_total = int(ev.get("kv_total", 0))
+        if kv_total > 0:
+            free = int(ev.get("kv_free", 0)) / kv_total
+            if free <= cfg.kv_free_frac and not self._kv_low:
+                self._kv_low = True
+                self._raise(
+                    "kv_pressure", WARN, t, step=rnd, value=free,
+                    threshold=cfg.kv_free_frac,
+                    detail=f"KV-pool headroom {free:.2%} at round "
+                           f"{rnd} — admissions will stall next")
+            elif free > 2 * cfg.kv_free_frac:
+                self._kv_low = False
+
+    def _obs_serve_queue(self, ev: dict) -> None:
+        cfg, t = self.cfg, float(ev["t"])
+        qmax = int(ev.get("queue_max", 0))
+        if qmax <= 0:
+            return
+        frac = int(ev.get("queue_depth", 0)) / qmax
+        if frac >= cfg.queue_frac and not self._queue_high:
+            self._queue_high = True
+            self._raise(
+                "queue_pressure", WARN, t,
+                step=int(ev.get("round", -1)), value=frac,
+                threshold=cfg.queue_frac,
+                detail=f"admission queue at {frac:.0%} of max_queue="
+                       f"{qmax} — backpressure rejects are imminent")
+        elif frac < 0.5 * cfg.queue_frac:
+            self._queue_high = False
+
+    def _obs_serve_request(self, ev: dict) -> None:
+        cfg, t = self.cfg, float(ev["t"])
+        ok = bool(ev.get("ok", True))
+        ttft = float(ev.get("ttft_s", 0.0))
+        self._recent_reqs.append({
+            "request_id": str(ev.get("request_id", "")),
+            "ttft_s": round(ttft, 6), "ok": ok,
+            "waterfall": ev.get("waterfall"),
+        })
+        self._burns["ttft"].add(t, (not ok) or ttft > cfg.ttft_slo_s)
+        self._check_burn("ttft", cfg.ttft_slo_s, t)
+
+    def _obs_serve_reject(self, ev: dict) -> None:
+        # a shed request spends TTFT error budget: the client saw an
+        # error, not a fast first token
+        ev = dict(ev, ok=False, ttft_s=math.inf)
+        self._obs_serve_request(ev)
+
+    def _obs_rank_progress(self, ev: dict) -> None:
+        """Supervisor feed: {rank: train_steps_total} snapshots. A rank
+        whose progress *rate* falls under the leave-one-out median of
+        its peers by ``drift_factor`` pages with the rank named."""
+        cfg, t = self.cfg, float(ev["t"])
+        for rank, steps in ev.get("steps", {}).items():
+            rank = int(rank)
+            hist = self._rank_hist.setdefault(
+                rank, collections.deque(maxlen=cfg.drift_history))
+            hist.append((t, float(steps)))
+        rates: dict[int, float] = {}
+        for rank, hist in self._rank_hist.items():
+            if len(hist) < cfg.drift_min_samples:
+                continue
+            (t0, s0), (t1, s1) = hist[0], hist[-1]
+            if t1 > t0:
+                rates[rank] = max(s1 - s0, 0.0) / (t1 - t0)
+        if len(rates) < 2:
+            return
+        for rank, rate in sorted(rates.items()):
+            others = [r for rk, r in rates.items() if rk != rank]
+            base = median(others)
+            if base <= 0:
+                continue
+            if rate < base / cfg.drift_factor:
+                if rank not in self._drifting:
+                    self._drifting.add(rank)
+                    self._raise(
+                        "straggler_drift", PAGE, t, value=rate,
+                        threshold=base / cfg.drift_factor,
+                        detail=f"rank {rank} progresses at "
+                               f"{rate:.2f} steps/s vs peer median "
+                               f"{base:.2f} (≥{cfg.drift_factor:g}x "
+                               f"drift)",
+                        attribution={"rank": rank,
+                                     "rate_steps_per_s": round(rate, 4),
+                                     "peer_median_steps_per_s":
+                                         round(base, 4)})
+            elif rate >= base:
+                self._drifting.discard(rank)
+
+    _HANDLERS = {
+        "train_step": _obs_train_step,
+        "loss": _obs_loss,
+        "goodput": _obs_goodput,
+        "serve_round": _obs_serve_round,
+        "serve_queue": _obs_serve_queue,
+        "serve_request": _obs_serve_request,
+        "serve_reject": _obs_serve_reject,
+        "rank_progress": _obs_rank_progress,
+    }
+
+    # -- burn-rate core --------------------------------------------------
+
+    def _check_burn(self, slo: str, slo_s: float, t: float, *,
+                    step: int = -1) -> None:
+        cfg = self.cfg
+        bw = self._burns[slo]
+        fast = bw.burn(cfg.burn_fast_s, t,
+                       min_events=cfg.burn_min_events)
+        slow = bw.burn(cfg.burn_slow_s, t,
+                       min_events=cfg.burn_min_events)
+        self._g_burn.set(round(fast, 4), slo=slo, window="fast")
+        self._g_burn.set(round(slow, 4), slo=slo, window="slow")
+        firing = (fast >= cfg.burn_threshold
+                  and slow >= cfg.burn_threshold)
+        if firing and slo not in self._burn_active:
+            self._burn_active.add(slo)
+            worst = max((r for r in self._recent_reqs
+                         if not r["ok"] or r["ttft_s"] > slo_s),
+                        key=lambda r: r["ttft_s"],
+                        default=None) if slo == "ttft" else None
+            attribution = {"slo": slo,
+                           "burn_fast": round(fast, 4),
+                           "burn_slow": round(slow, 4)}
+            if worst is not None:
+                attribution["request"] = worst
+            self._raise(
+                "slo_burn_rate", PAGE, t, step=step, value=fast,
+                threshold=cfg.burn_threshold,
+                detail=f"{slo} SLO ({slo_s:g}s @ "
+                       f"{cfg.slo_objective:.0%}) burning "
+                       f"{fast:.1f}x budget over the fast window and "
+                       f"{slow:.1f}x over the slow window",
+                attribution=attribution)
+        elif slo in self._burn_active and fast < cfg.burn_threshold:
+            self._burn_active.discard(slo)  # re-arm after recovery
+
+    # -- registry subscription -------------------------------------------
+
+    def poll_registry(self, t: float, registry=None) -> None:
+        """Pull-side feed for processes that own a registry but no
+        serve/train hook path (the supervisor between snapshots): maps
+        the live gauges onto the same detectors the push hooks drive."""
+        reg = registry if registry is not None else get_registry()
+        flat = reg.snapshot()
+        if "goodput_frac" in flat:
+            self.observe({"ev": "goodput", "t": t,
+                          "goodput_frac": flat["goodput_frac"]})
+        if "serve_queue_depth" in flat:
+            self.observe({
+                "ev": "serve_queue", "t": t,
+                "queue_depth": flat["serve_queue_depth"],
+                "queue_max": flat.get("serve_queue_max", 0)})
+
+    # -- rendering --------------------------------------------------------
+
+    def summary(self) -> dict:
+        """Active/total alert snapshot (obs_watch + tests)."""
+        counts: dict[str, int] = {}
+        for a in self.alerts:
+            counts[a.kind] = counts.get(a.kind, 0) + 1
+        return {
+            "alerts_total": len(self.alerts),
+            "by_kind": counts,
+            "pages": sum(a.severity == PAGE for a in self.alerts),
+            "burns_active": sorted(self._burn_active),
+            "drifting_ranks": sorted(self._drifting),
+        }
+
+
+def events_from_jsonl(rec: dict) -> list[dict]:
+    """Map one JSONL record from a run's metrics stream onto normalized
+    watchtower events (``scripts/obs_watch.py`` replay/tail path).
+    ``MetricsLogger.emit`` stamps ``time`` on every record, so replay
+    is exact in event time."""
+    ev = rec.get("event")
+    t = float(rec.get("time", 0.0))
+    out: list[dict] = []
+    if ev == "train_step" and "loss" in rec:
+        out.append({"ev": "loss", "t": t,
+                    "step": int(rec.get("step", -1)),
+                    "loss": float(rec["loss"])})
+        if rec.get("seconds"):
+            out.append({"ev": "train_step", "t": t,
+                        "step": int(rec.get("step", -1)),
+                        "wall_s": float(rec["seconds"])})
+    elif ev == "goodput" and rec.get("goodput_frac") is not None:
+        g = {"ev": "goodput", "t": t,
+             "step": int(rec.get("step", -1)),
+             "goodput_frac": float(rec["goodput_frac"])}
+        out.append(g)
+        wall, steps = rec.get("wall_s"), rec.get("steps")
+        if wall and steps:
+            out.append({"ev": "train_step", "t": t,
+                        "step": int(rec.get("step", -1)),
+                        "wall_s": float(wall) / max(int(steps), 1)})
+    elif ev == "serve_request":
+        out.append({"ev": "serve_request", "t": t, "ok": True,
+                    "request_id": rec.get("request_id", ""),
+                    "ttft_s": float(rec.get("ttft_s", 0.0)),
+                    "waterfall": rec.get("waterfall")})
+    elif ev == "serve_reject":
+        out.append({"ev": "serve_reject", "t": t,
+                    "request_id": rec.get("request_id", ""),
+                    "reason": rec.get("reason", "")})
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Module singleton + the inert hot-path hooks (chaos-style lint contract)
+# ---------------------------------------------------------------------------
+
+_tower: Watchtower | None = None
+
+
+def maybe_init(spec: str | None = None, *, metrics=None,
+               rank: int | None = None,
+               config: WatchConfig | None = None) -> Watchtower | None:
+    """Arm the process tower from ``TPUNN_WATCH`` (or an explicit
+    ``spec``/``config``). No-op beyond one env read when unset or
+    ``"0"``; idempotent when armed."""
+    global _tower
+    if _tower is not None:
+        return _tower
+    spec = os.environ.get(ENV_WATCH) if spec is None else spec
+    if not spec or spec == "0":
+        return None
+    _tower = Watchtower(
+        config if config is not None else parse_spec(spec),
+        rank=flight.default_rank() if rank is None else rank,
+        metrics=metrics,
+    )
+    log.warning("watchtower armed: %s (rank %d)", spec, _tower.rank)
+    return _tower
+
+
+def enabled() -> bool:
+    return _tower is not None
+
+
+def tower() -> Watchtower | None:
+    return _tower
+
+
+def reset() -> None:
+    """Disarm (test isolation)."""
+    global _tower
+    _tower = None
+
+
+def on_train_step(step: int, wall_s: float) -> None:
+    """Trainer step-loop hook (step-time outlier)."""
+    if _tower is None:
+        return
+    _tower.observe({"ev": "train_step", "t": time.time(),
+                    "step": int(step), "wall_s": float(wall_s)})
+
+
+def on_loss(step: int, loss: float) -> None:
+    """Trainer log-cadence hook (loss spike / NaN-inf page)."""
+    if _tower is None:
+        return
+    _tower.observe({"ev": "loss", "t": time.time(), "step": int(step),
+                    "loss": float(loss)})
+
+
+def on_goodput(step: int, goodput_frac: float) -> None:
+    """Trainer telemetry-flush hook (goodput floor)."""
+    if _tower is None:
+        return
+    _tower.observe({"ev": "goodput", "t": time.time(),
+                    "step": int(step),
+                    "goodput_frac": float(goodput_frac)})
+
+
+def on_serve_round(round_: int, wall_s: float, *, queue_depth: int,
+                   queue_max: int, kv_free: int, kv_total: int) -> None:
+    """Serving-engine per-round hook (token-latency SLO, queue/KV
+    pressure). Called from ``ServingEngine.step`` — never from the
+    ``_decode_round`` hot loop (its lint bans extra work there)."""
+    if _tower is None:
+        return
+    _tower.observe({"ev": "serve_round", "t": time.time(),
+                    "round": int(round_), "wall_s": float(wall_s),
+                    "queue_depth": int(queue_depth),
+                    "queue_max": int(queue_max),
+                    "kv_free": int(kv_free),
+                    "kv_total": int(kv_total)})
+
+
+def on_serve_request(rec: dict) -> None:
+    """Request-retire hook (TTFT SLO burn; ``rec`` is the engine's
+    ``serve_request`` record, waterfall included)."""
+    if _tower is None:
+        return
+    _tower.observe({"ev": "serve_request", "t": time.time(), "ok": True,
+                    "request_id": rec.get("request_id", ""),
+                    "ttft_s": float(rec.get("ttft_s", 0.0)),
+                    "waterfall": rec.get("waterfall")})
+
+
+def on_serve_reject(request_id: str, reason: str) -> None:
+    """Scheduler rejection hook — shed traffic burns TTFT budget."""
+    if _tower is None:
+        return
+    _tower.observe({"ev": "serve_reject", "t": time.time(),
+                    "request_id": request_id, "reason": reason})
+
+
+def on_serve_submit(request_id: str, queue_depth: int,
+                    queue_max: int) -> None:
+    """Server submission-path hook: queue pressure stays visible from
+    client threads even when the engine loop itself is wedged."""
+    if _tower is None:
+        return
+    _tower.observe({"ev": "serve_queue", "t": time.time(),
+                    "queue_depth": int(queue_depth),
+                    "queue_max": int(queue_max)})
+
+
+def on_rank_progress(steps_by_rank: dict) -> None:
+    """Elastic-agent hook (straggler drift from aggregate snapshots)."""
+    if _tower is None:
+        return
+    _tower.observe({"ev": "rank_progress", "t": time.time(),
+                    "steps": dict(steps_by_rank)})

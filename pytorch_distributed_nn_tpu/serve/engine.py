@@ -48,7 +48,7 @@ from pytorch_distributed_nn_tpu.inference.generate import (
     _apply_prefill_ragged,
     init_cache,
 )
-from pytorch_distributed_nn_tpu.obs import flight
+from pytorch_distributed_nn_tpu.obs import flight, watchtower
 from pytorch_distributed_nn_tpu.runtime import chaos
 from pytorch_distributed_nn_tpu.serve.kv_pool import KVPool
 from pytorch_distributed_nn_tpu.serve.scheduler import Request, Scheduler
@@ -164,6 +164,7 @@ class ServingEngine:
             pool, max_queue=max_queue, max_seq_len=self.max_seq_len,
             max_prefills_per_round=max_prefills_per_round,
         )
+        self.scheduler.metrics = metrics
         self._cache = _fresh_cache(model, max_slots, self.max_seq_len)
         self._slots: list[Optional[_Slot]] = [None] * max_slots
         self._h_last = np.zeros((max_slots,), np.int32)
@@ -225,6 +226,13 @@ class ServingEngine:
         self._occ_sum += occ
         flight.record("serve", "decode_round", step=sched.round,
                       note=f"occ={occ}/{self.max_slots}")
+        # watchtower feed (token-latency SLO + queue/KV pressure):
+        # here, NOT in _decode_round — its hot-loop lint bans extras
+        watchtower.on_serve_round(
+            sched.round, dt, queue_depth=sched.queue_depth,
+            queue_max=sched.max_queue,
+            kv_free=sched.pool.free_blocks,
+            kv_total=sched.pool.num_blocks)
         retired = self._collect(host_tok)
         if retired:
             self._sync_slots()
@@ -347,26 +355,52 @@ class ServingEngine:
         total = req.t_done - req.t_submit
         decode = req.t_done - req.t_first_token
         per_tok = decode / max(s.emitted - 1, 1)
+        # per-request waterfall: the request_id's timeline through
+        # admission -> queue -> prefill -> decode -> retire, from the
+        # scheduler's lifecycle timestamps + round bookkeeping. Rides
+        # the serve_request JSONL record, the retroactive trace span's
+        # phase children, and any watchtower alert that names this
+        # request.
+        waterfall = dict(
+            queued_s=round(max(req.t_admit - req.t_submit, 0.0), 6),
+            prefill_s=round(max(req.t_first_token - req.t_admit, 0.0),
+                            6),
+            decode_s=round(max(decode, 0.0), 6),
+            round_submitted=req.round_submitted,
+            round_admitted=req.round_admitted,
+            round_done=req.round_done,
+        )
         rec = dict(
             request_id=req.request_id, prompt_len=len(req.prompt),
             new_tokens=s.emitted, ttft_s=ttft, total_s=total,
             per_token_s=per_tok,
             rounds_waited=req.round_admitted - req.round_submitted,
             kv_util=self.scheduler.pool.utilization(),
+            waterfall=waterfall,
         )
         self.completed.append(rec)
         if self.metrics is not None:
             self.metrics.emit("serve_request", **rec)
+        watchtower.on_serve_request(rec)
         tracer = obs.current_recorder()
         if tracer is not None:
             # retroactive per-request span: duration is only known now
             end_us = tracer._now_us()
+            t0_us = end_us - total * 1e6
             tracer.add_event(f"serve/{req.request_id}",
-                             end_us - total * 1e6, total * 1e6,
+                             t0_us, total * 1e6,
                              cat="serve", args=dict(
                                  prompt_len=len(req.prompt),
                                  new_tokens=s.emitted,
                                  ttft_ms=ttft * 1e3))
+            off_us = 0.0
+            for phase in ("queued", "prefill", "decode"):
+                dur_us = waterfall[f"{phase}_s"] * 1e6
+                if dur_us > 0:
+                    tracer.add_event(
+                        f"serve/{req.request_id}/{phase}",
+                        t0_us + off_us, dur_us, cat="serve")
+                off_us += dur_us
 
     def _sync_slots(self) -> None:
         """Push the host slot mirrors to device (admission/retirement

@@ -50,7 +50,7 @@ from typing import Optional
 
 import numpy as np
 
-from pytorch_distributed_nn_tpu.obs import flight
+from pytorch_distributed_nn_tpu.obs import flight, watchtower
 from pytorch_distributed_nn_tpu.obs.registry import get_registry
 from pytorch_distributed_nn_tpu.runtime import chaos
 from pytorch_distributed_nn_tpu.serve.kv_pool import KVPool
@@ -117,6 +117,7 @@ class Scheduler:
         self._queue: collections.deque[Request] = collections.deque()
         self.round = 0  # advanced by the engine, one per decode round
         self.draining = False
+        self.metrics = None  # MetricsLogger; set by the owning engine
         reg = get_registry()
         self._c_requests = reg.counter(
             "serve_requests_total", "request state transitions",
@@ -140,6 +141,15 @@ class Scheduler:
             req.reject_reason = reason
             self._c_rejects.inc(reason=reason)
             flight.record("serve", f"reject:{reason}", note=req.request_id)
+            # a shed request spends the TTFT SLO's error budget — the
+            # watchtower's burn-rate detector must see it (inert no-op
+            # when TPUNN_WATCH is unset), and the JSONL stream must
+            # carry it too or obs_watch replay can't reproduce the
+            # burn page the live tower raised
+            watchtower.on_serve_reject(req.request_id, reason)
+            if self.metrics is not None:
+                self.metrics.emit("serve_reject",
+                                  request_id=req.request_id, reason=reason)
         if state in (DONE, REJECTED, FAILED):
             req.t_done = time.monotonic()
             req.round_done = self.round

@@ -35,7 +35,7 @@ from typing import Callable, Optional, Sequence
 
 import numpy as np
 
-from pytorch_distributed_nn_tpu.obs import flight
+from pytorch_distributed_nn_tpu.obs import flight, watchtower
 from pytorch_distributed_nn_tpu.runtime import failure
 from pytorch_distributed_nn_tpu.serve.engine import ServingEngine
 from pytorch_distributed_nn_tpu.serve.scheduler import Request
@@ -99,6 +99,12 @@ class InferenceServer:
 
     def submit(self, prompt, max_new_tokens: int, **kw) -> Request:
         req = self.engine.submit(prompt, max_new_tokens, **kw)
+        # queue-pressure feed from the CLIENT thread: the watchtower
+        # still sees a filling queue even when the engine loop itself
+        # is wedged and no more rounds (and round hooks) ever run
+        watchtower.on_serve_submit(req.request_id,
+                                   self.engine.scheduler.queue_depth,
+                                   self.engine.scheduler.max_queue)
         self._wake.set()
         return req
 

@@ -23,6 +23,7 @@ from pytorch_distributed_nn_tpu.models import get_model
 from pytorch_distributed_nn_tpu.obs import aggregate as obs_aggregate
 from pytorch_distributed_nn_tpu.obs import flight
 from pytorch_distributed_nn_tpu.obs import runtime_gauges
+from pytorch_distributed_nn_tpu.obs import watchtower
 from pytorch_distributed_nn_tpu.ops import collectives as cc
 from pytorch_distributed_nn_tpu.runtime import chaos
 from pytorch_distributed_nn_tpu.runtime import failure
@@ -67,6 +68,9 @@ class Trainer:
         # chaos engine (TPUNN_CHAOS): armed once per process, inert and
         # allocation-free on the step path when the env is unset
         chaos.maybe_init()
+        # watchtower (TPUNN_WATCH): online anomaly/SLO detection over
+        # the hooks below — same inert-when-unset contract as chaos
+        watchtower.maybe_init()
         self._preemptible = False
         self.mesh = mesh if mesh is not None else make_mesh(
             cfg.mesh.resolve(len(jax.devices()))
@@ -132,6 +136,11 @@ class Trainer:
             import pathlib
 
             flight.set_dump_dir(pathlib.Path(cfg.metrics_path).parent)
+            if watchtower.enabled():
+                # alerts ride the same JSONL stream as the metrics
+                # they fired on (the tower armed before this logger
+                # existed)
+                watchtower.tower().metrics = self.metrics
         self.ckpt = None
         try:
             if cfg.checkpoint_dir:
@@ -286,6 +295,7 @@ class Trainer:
                 t_last = now
                 self.history.append(rec)
                 self._g_loss.set(loss)
+                watchtower.on_loss(g - 1, loss)
                 if self.metrics is not None:
                     covered = g - g_last  # actual steps in this record
                     self.metrics.emit(
@@ -301,6 +311,7 @@ class Trainer:
                              rec.seconds)
             bd = gp.step_end(step=g - 1)
             self._h_step.observe(bd.wall_s)
+            watchtower.on_train_step(g - 1, bd.wall_s)
             if logged:
                 self._flush_telemetry(step=g - 1)
             if failure.preempt_requested():
@@ -349,6 +360,7 @@ class Trainer:
         gp_gauge = reg.gauge("goodput_frac",
                              "compute+collective share of wall time")
         gp_gauge.set(win["goodput_frac"])
+        watchtower.on_goodput(step, win["goodput_frac"])
         if self.cfg.prom_path:
             reg.write_prometheus(self.cfg.prom_path)
         obs_aggregate.maybe_publish(reg)
@@ -486,8 +498,10 @@ class Trainer:
                                      rec.step, rec.loss, rec.seconds)
                     t_last = now
                     self._g_loss.set(float(losses[-1]))
+                    watchtower.on_loss(g - 1, float(losses[-1]))
             bd = gp.step_end(step=g - 1, steps_covered=k_eff)
             self._h_step.observe(bd.wall_s)
+            watchtower.on_train_step(g - 1, bd.wall_s / max(k_eff, 1))
             if logged:
                 self._flush_telemetry(step=g - 1)
             if failure.preempt_requested():

@@ -54,6 +54,14 @@ def _analyze(paths_or_dir, expect_ranks: int | None, last: int,
             # post-mortems don't page anyone over a synthetic failure
             "chaos_injected": {str(r): n
                                for r, n in cls.chaos_injected.items()},
+            # online watchtower alerts that fired before the dump, with
+            # their inline attribution (the alert already names the
+            # suspect rank/collective/request — obs/watchtower.py)
+            "alerts": {str(r): [{"kind": e.get("op"),
+                                 "step": e.get("step"),
+                                 "note": e.get("note")}
+                                for e in d.alert_events]
+                       for r, d in dumps.items() if d.alert_events},
             "divergence": None if div is None else {
                 "index": div.index,
                 "kind": div.kind,

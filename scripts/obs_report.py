@@ -26,6 +26,8 @@ import sys
 
 sys.path.insert(0, ".")  # run from repo root without install
 
+from pytorch_distributed_nn_tpu.obs.stats import percentile  # noqa: E402
+
 PHASES = ("data", "compute", "collective", "checkpoint", "eval", "other")
 
 
@@ -175,13 +177,6 @@ def print_serving_table(events: list[dict], last: int) -> bool:
     if not reqs and summary is None:
         return False
 
-    def _pct(xs: list[float], q: float) -> float:
-        if not xs:
-            return 0.0
-        xs = sorted(xs)
-        i = min(int(len(xs) * q / 100.0), len(xs) - 1)
-        return xs[i]
-
     print("\n== serving ==")
     if reqs:
         ttft = [_num(e, "ttft_s") for e in reqs]
@@ -192,8 +187,9 @@ def print_serving_table(events: list[dict], last: int) -> bool:
         print(f"{'':>14} {'p50':>10} {'p95':>10} {'p99':>10}")
         for name, xs in (("ttft_s", ttft), ("per_token_s", ptok),
                          ("total_s", total)):
-            print(f"{name:>14} {_fmt_s(_pct(xs, 50))} "
-                  f"{_fmt_s(_pct(xs, 95))} {_fmt_s(_pct(xs, 99))}")
+            print(f"{name:>14} {_fmt_s(percentile(xs, 0.50))} "
+                  f"{_fmt_s(percentile(xs, 0.95))} "
+                  f"{_fmt_s(percentile(xs, 0.99))}")
         kv = [_num(e, "kv_util") for e in reqs if "kv_util" in e]
         if kv:
             print(f"KV-pool utilization at retire: mean "

@@ -40,8 +40,7 @@ import jax.numpy as jnp
 import numpy as np
 
 
-def _pct(xs, q):
-    return float(np.percentile(np.asarray(xs), q)) if len(xs) else 0.0
+from pytorch_distributed_nn_tpu.obs.stats import percentile
 
 
 def main(argv=None) -> int:
@@ -80,6 +79,7 @@ def main(argv=None) -> int:
         parse_overrides,
     )
     from pytorch_distributed_nn_tpu.models import get_model
+    from pytorch_distributed_nn_tpu.obs import watchtower
     from pytorch_distributed_nn_tpu.runtime import chaos
     from pytorch_distributed_nn_tpu.runtime.failure import (
         GRACEFUL_EXIT_CODE,
@@ -152,8 +152,10 @@ def main(argv=None) -> int:
     warm_done = len(engine.completed)
     warm_rounds = len(engine.round_seconds)
     # armed after warmup so a serve_reject@ drill can't shed the
-    # compile-cache warm requests and pollute the timed TTFTs
+    # compile-cache warm requests and pollute the timed TTFTs — and so
+    # the watchtower's TTFT burn-rate window never sees compile time
     chaos.maybe_init()
+    watchtower.maybe_init(metrics=metrics)
     t0 = time.monotonic()
     try:
         if args.closed_loop:
@@ -187,10 +189,11 @@ def main(argv=None) -> int:
         tokens_out=int(sum(c["new_tokens"] for c in timed)),
         tokens_per_s=round(
             sum(c["new_tokens"] for c in timed) / max(wall, 1e-9), 2),
-        ttft_p50_s=_pct(ttfts, 50), ttft_p95_s=_pct(ttfts, 95),
-        token_lat_p50_s=_pct(tok_lat, 50),
-        token_lat_p95_s=_pct(tok_lat, 95),
-        token_lat_p99_s=_pct(tok_lat, 99),
+        ttft_p50_s=percentile(ttfts, 0.50),
+        ttft_p95_s=percentile(ttfts, 0.95),
+        token_lat_p50_s=percentile(tok_lat, 0.50),
+        token_lat_p95_s=percentile(tok_lat, 0.95),
+        token_lat_p99_s=percentile(tok_lat, 0.99),
         **{k: v for k, v in engine.summary().items()
            if k in ("rounds", "occupancy", "kv_util")},
     )

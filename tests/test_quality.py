@@ -199,6 +199,76 @@ def test_every_chaos_fault_kind_emits_a_flight_event():
                          f"takes effect")
 
 
+_WATCH = (Path(__file__).parent.parent / "pytorch_distributed_nn_tpu"
+          / "obs" / "watchtower.py")
+
+
+def test_watchtower_hooks_are_provably_inert_when_unset():
+    """ISSUE 7 lint: every public ``on_*`` hook in obs/watchtower.py
+    must open with the literal ``if _tower is None: return`` fast path
+    (the chaos contract) — these sit in the trainer step loop, the
+    serving round, and the scheduler admission path, so an unset
+    ``TPUNN_WATCH`` must cost one global load + one comparison per
+    hook, nothing more."""
+    tree = ast.parse(_WATCH.read_text())
+    hooks = [n for n in tree.body if isinstance(n, ast.FunctionDef)
+             and n.name.startswith("on_")]
+    assert len(hooks) >= 7, "expected train/loss/goodput/serve_round/" \
+                            "serve_request/serve_reject/rank hooks"
+    for fn in hooks:
+        first = fn.body[0]
+        if isinstance(first, ast.Expr) and isinstance(
+                first.value, ast.Constant):  # docstring
+            first = fn.body[1]
+        ok = (isinstance(first, ast.If)
+              and isinstance(first.test, ast.Compare)
+              and isinstance(first.test.left, ast.Name)
+              and first.test.left.id == "_tower"
+              and len(first.test.ops) == 1
+              and isinstance(first.test.ops[0], ast.Is)
+              and isinstance(first.test.comparators[0], ast.Constant)
+              and first.test.comparators[0].value is None
+              and len(first.body) == 1
+              and isinstance(first.body[0], ast.Return))
+        assert ok, (f"watchtower.{fn.name} must start with "
+                    f"'if _tower is None: return' (the disabled "
+                    f"fast path)")
+
+
+def test_watchtower_alerts_record_to_flight_ring_first():
+    """ISSUE 7 lint: ``Watchtower._emit``'s FIRST statement must be the
+    flight-ring record — a crash right after an alert fires must still
+    show the alert post-mortem — and every alert must flow through
+    ``_emit`` (``_raise`` is the only constructor and it calls it)."""
+    tree = ast.parse(_WATCH.read_text())
+    cls = next(n for n in tree.body if isinstance(n, ast.ClassDef)
+               and n.name == "Watchtower")
+    methods = {n.name: n for n in cls.body
+               if isinstance(n, ast.FunctionDef)}
+    emit = methods["_emit"]
+    first = emit.body[0]
+    if isinstance(first, ast.Expr) and isinstance(
+            first.value, ast.Constant):  # docstring
+        first = emit.body[1]
+    is_flight_record = (
+        isinstance(first, ast.Expr)
+        and isinstance(first.value, ast.Call)
+        and isinstance(first.value.func, ast.Attribute)
+        and first.value.func.attr == "record"
+        and isinstance(first.value.func.value, ast.Name)
+        and first.value.func.value.id == "flight"
+        and isinstance(first.value.args[0], ast.Constant)
+        and first.value.args[0].value == "alert")
+    assert is_flight_record, (
+        "Watchtower._emit must call flight.record('alert', ...) FIRST")
+    raise_calls = {node.func.attr
+                   for node in ast.walk(methods["_raise"])
+                   if isinstance(node, ast.Call)
+                   and isinstance(node.func, ast.Attribute)}
+    assert "_emit" in raise_calls, \
+        "Watchtower._raise must fan out through _emit"
+
+
 def test_obs_doctor_selftest_smoke():
     """The doctor's built-in synthetic-hang check, run exactly as an
     operator would (fresh interpreter, repo root)."""

@@ -1,0 +1,505 @@
+"""Watchtower online anomaly detection (ISSUE 7 tentpole).
+
+Covers: shared obs.stats helpers (edge cases), the TPUNN_WATCH spec
+grammar, replay determinism (same event stream twice → byte-identical
+alert JSON), the inert-when-disabled contract (zero alerts AND zero
+registry writes), every detector's fire/hysteresis behavior, and the
+two chaos acceptance drills — ``slow@rank=2:ms=200`` must page a
+``straggler_drift`` alert *naming rank 2* (flight dump + obs_doctor
+attribution included), and shed/stretched serving traffic must page
+the TTFT SLO burn rate.
+"""
+
+import json
+import math
+import os
+import subprocess
+import sys
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from pytorch_distributed_nn_tpu import obs
+from pytorch_distributed_nn_tpu.obs import flight, watchtower
+from pytorch_distributed_nn_tpu.obs.stats import (
+    Ewma,
+    mad,
+    median,
+    percentile,
+)
+from pytorch_distributed_nn_tpu.runtime import chaos
+from pytorch_distributed_nn_tpu.serve.kv_pool import KVPool
+from pytorch_distributed_nn_tpu.serve.scheduler import Scheduler
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    """Disarmed tower + chaos, fresh ring + registry, unset env."""
+    monkeypatch.delenv(watchtower.ENV_WATCH, raising=False)
+    monkeypatch.delenv(chaos.ENV_CHAOS, raising=False)
+    watchtower.reset()
+    chaos.reset()
+    flight.reset_recorder(enabled=True)
+    obs.reset_registry()
+    yield
+    watchtower.reset()
+    chaos.reset()
+
+
+def _tower(spec="1", **kw):
+    kw.setdefault("dump_on_page", False)
+    return watchtower.Watchtower(watchtower.parse_spec(spec), **kw)
+
+
+# ---------------------------------------------------------------------------
+# obs.stats — the shared helpers the reporting + detection layers agree on
+# ---------------------------------------------------------------------------
+
+def test_percentile_edge_cases():
+    assert percentile([], 0.5) == 0.0
+    assert percentile([7.0], 0.0) == 7.0
+    assert percentile([7.0], 1.0) == 7.0
+    xs = [5.0, 1.0, 3.0, 2.0, 4.0]
+    assert percentile(xs, 0.0) == 1.0
+    assert percentile(xs, 1.0) == 5.0
+    assert percentile(xs, 0.5) == 3.0
+    assert xs[0] == 5.0, "percentile must not mutate its input"
+    # out-of-range q clamps instead of indexing off the end
+    assert percentile(xs, 2.0) == 5.0
+    assert percentile(xs, -1.0) == 1.0
+
+
+def test_median_and_mad():
+    assert median([]) == 0.0
+    assert median([3.0]) == 3.0
+    assert median([1.0, 2.0, 9.0]) == 2.0
+    assert mad([]) == 0.0
+    assert mad([1.0, 1.0, 1.0]) == 0.0
+    # MAD of {1,2,3,4,100}: median 3, deviations {2,1,0,1,97} → 1
+    assert mad([1.0, 2.0, 3.0, 4.0, 100.0]) == 1.0
+
+
+def test_ewma():
+    e = Ewma(0.5)
+    assert e.value is None and e.count == 0
+    e.update(10.0)
+    assert e.value == 10.0  # first sample seeds the center
+    e.update(20.0)
+    assert e.value == 15.0 and e.count == 2
+
+
+# ---------------------------------------------------------------------------
+# Spec grammar
+# ---------------------------------------------------------------------------
+
+def test_parse_spec_defaults_and_overrides():
+    assert watchtower.parse_spec("1") == watchtower.WatchConfig()
+    assert watchtower.parse_spec("on") == watchtower.WatchConfig()
+    cfg = watchtower.parse_spec(
+        "ttft_slo_s=0.25:burn_threshold=4:step_warmup=5")
+    assert cfg.ttft_slo_s == 0.25
+    assert cfg.burn_threshold == 4.0
+    assert cfg.step_warmup == 5
+    assert isinstance(cfg.step_warmup, int)
+
+
+@pytest.mark.parametrize("bad", [
+    "ttft=0.2",          # unknown key
+    "typo",              # no '='
+    "ttft_slo_s=fast",   # non-numeric value
+])
+def test_parse_spec_rejects_typos_loudly(bad):
+    with pytest.raises(ValueError):
+        watchtower.parse_spec(bad)
+
+
+# ---------------------------------------------------------------------------
+# Inert when disabled — zero alerts, zero registry writes
+# ---------------------------------------------------------------------------
+
+def test_disabled_hooks_are_complete_noops():
+    before = obs.get_registry().snapshot()
+    ring_before = flight.get_recorder().total_events
+    watchtower.on_train_step(3, 0.5)
+    watchtower.on_loss(3, float("nan"))
+    watchtower.on_goodput(3, 0.01)
+    watchtower.on_serve_round(1, 9.0, queue_depth=9, queue_max=10,
+                              kv_free=0, kv_total=8)
+    watchtower.on_serve_request({"request_id": "r0", "ttft_s": 99.0})
+    watchtower.on_serve_reject("r1", "backpressure")
+    watchtower.on_serve_submit("r2", 10, 10)
+    watchtower.on_rank_progress({0: 100, 1: 1})
+    assert watchtower.tower() is None
+    assert not watchtower.enabled()
+    assert obs.get_registry().snapshot() == before, \
+        "disabled watchtower must not touch the registry"
+    assert flight.get_recorder().total_events == ring_before, \
+        "disabled watchtower must not touch the flight ring"
+
+
+def test_maybe_init_respects_unset_and_zero(monkeypatch):
+    assert watchtower.maybe_init() is None
+    monkeypatch.setenv(watchtower.ENV_WATCH, "0")
+    assert watchtower.maybe_init() is None
+    monkeypatch.setenv(watchtower.ENV_WATCH, "1")
+    t = watchtower.maybe_init()
+    assert t is not None and watchtower.enabled()
+    assert watchtower.maybe_init() is t, "arming is idempotent"
+
+
+# ---------------------------------------------------------------------------
+# Replay determinism — the alerting contract for post-mortems
+# ---------------------------------------------------------------------------
+
+def _mixed_stream():
+    evs = []
+    for i in range(30):
+        evs.append({"ev": "train_step", "t": float(i), "step": i,
+                    "wall_s": 0.1 if i != 25 else 8.0})
+        evs.append({"ev": "loss", "t": float(i) + 0.5, "step": i,
+                    "loss": 2.0 if i != 27 else 50.0})
+    for i in range(12):
+        evs.append({"ev": "serve_reject", "t": 40.0 + i,
+                    "request_id": f"r{i}", "reason": "backpressure"})
+    for k in range(4):
+        evs.append({"ev": "rank_progress", "t": 60.0 + k,
+                    "steps": {0: k * 10, 1: k * 10, 2: k, 3: k * 10}})
+    return evs
+
+
+def test_replay_is_byte_identical():
+    stream = _mixed_stream()
+
+    def run():
+        t = _tower()
+        for ev in stream:
+            t.observe(ev)
+        return [a.as_json() for a in t.alerts]
+
+    first, second = run(), run()
+    assert first == second
+    assert first, "the mixed stream must raise at least one alert"
+    kinds = {json.loads(a)["kind"] for a in first}
+    assert {"step_time_outlier", "loss_spike", "slo_burn_rate",
+            "straggler_drift"} <= kinds, kinds
+
+
+def test_alert_seq_step_and_rounding_are_stable():
+    t = _tower()
+    for ev in _mixed_stream():
+        t.observe(ev)
+    for i, a in enumerate(t.alerts):
+        assert a.seq == i
+        # canonical JSON round-trips (sort_keys, plain floats)
+        assert json.loads(a.as_json())["seq"] == i
+
+
+# ---------------------------------------------------------------------------
+# Individual detectors
+# ---------------------------------------------------------------------------
+
+def test_step_time_outlier_fires_and_counts():
+    t = _tower()
+    for i in range(25):
+        t.observe({"ev": "train_step", "t": float(i), "step": i,
+                   "wall_s": 0.1})
+    t.observe({"ev": "train_step", "t": 30.0, "step": 30, "wall_s": 4.0})
+    assert [a.kind for a in t.alerts] == ["step_time_outlier"]
+    assert t.alerts[0].severity == watchtower.WARN
+    assert t.alerts[0].step == 30
+    reg = obs.get_registry()
+    assert reg.counter("watchtower_alerts_total").value(
+        kind="step_time_outlier", severity="warn") == 1
+    ring = [e for e in flight.get_recorder().snapshot()
+            if e["kind"] == "alert"]
+    assert len(ring) == 1 and ring[0]["op"] == "step_time_outlier"
+
+
+def test_step_outlier_holds_fire_during_warmup():
+    t = _tower()
+    for i in range(5):
+        t.observe({"ev": "train_step", "t": float(i), "step": i,
+                   "wall_s": 0.1 if i else 9.0})
+    assert t.alerts == []
+
+
+def test_loss_nonfinite_pages_with_forensics():
+    t = _tower()
+    t.observe({"ev": "loss", "t": 1.0, "step": 4, "loss": math.inf})
+    (a,) = t.alerts
+    assert a.kind == "loss_nonfinite" and a.severity == watchtower.PAGE
+    assert "forensics" in a.attribution, \
+        "a page must carry inline forensics attribution"
+
+
+def test_loss_spike_warns_once_then_rearms():
+    t = _tower()
+    for i in range(10):
+        t.observe({"ev": "loss", "t": float(i), "step": i, "loss": 2.0})
+    t.observe({"ev": "loss", "t": 10.0, "step": 10, "loss": 9.0})
+    t.observe({"ev": "loss", "t": 11.0, "step": 11, "loss": 9.5})
+    assert [a.kind for a in t.alerts] == ["loss_spike"], \
+        "hysteresis: a continuing spike must not re-alert every step"
+    # recovery below the EWMA re-arms the detector
+    for i in range(12, 22):
+        t.observe({"ev": "loss", "t": float(i), "step": i, "loss": 2.0})
+    t.observe({"ev": "loss", "t": 30.0, "step": 30, "loss": 50.0})
+    assert [a.kind for a in t.alerts] == ["loss_spike", "loss_spike"]
+
+
+def test_queue_and_kv_pressure():
+    t = _tower()
+    t.observe({"ev": "serve_round", "t": 1.0, "round": 1, "wall_s": 0.01,
+               "queue_depth": 10, "queue_max": 10,
+               "kv_free": 0, "kv_total": 16})
+    kinds = sorted(a.kind for a in t.alerts)
+    assert kinds == ["kv_pressure", "queue_pressure"]
+    # repeated pressure does not re-alert until it recovers
+    t.observe({"ev": "serve_round", "t": 2.0, "round": 2, "wall_s": 0.01,
+               "queue_depth": 10, "queue_max": 10,
+               "kv_free": 0, "kv_total": 16})
+    assert len(t.alerts) == 2
+    t.observe({"ev": "serve_round", "t": 3.0, "round": 3, "wall_s": 0.01,
+               "queue_depth": 0, "queue_max": 10,
+               "kv_free": 16, "kv_total": 16})
+    t.observe({"ev": "serve_round", "t": 4.0, "round": 4, "wall_s": 0.01,
+               "queue_depth": 10, "queue_max": 10,
+               "kv_free": 0, "kv_total": 16})
+    assert len(t.alerts) == 4
+
+
+def test_goodput_drop_respects_warmup_and_hysteresis():
+    t = _tower()
+    t.observe({"ev": "goodput", "t": 1.0, "step": 1,
+               "goodput_frac": 0.1})
+    t.observe({"ev": "goodput", "t": 2.0, "step": 2,
+               "goodput_frac": 0.1})
+    assert t.alerts == [], "warmup windows must not alert"
+    t.observe({"ev": "goodput", "t": 3.0, "step": 3,
+               "goodput_frac": 0.1})
+    t.observe({"ev": "goodput", "t": 4.0, "step": 4,
+               "goodput_frac": 0.1})
+    assert [a.kind for a in t.alerts] == ["goodput_drop"]
+
+
+def test_straggler_drift_names_the_rank_and_recovers():
+    t = _tower()
+    for k in range(4):
+        t.observe({"ev": "rank_progress", "t": k * 1.0,
+                   "steps": {0: k * 10, 1: k * 10, 2: k, 3: k * 10}})
+    pages = [a for a in t.alerts if a.kind == "straggler_drift"]
+    assert len(pages) == 1, "one page per drifting rank, not per sample"
+    assert pages[0].severity == watchtower.PAGE
+    assert pages[0].attribution["rank"] == 2
+    assert pages[0].attribution["rate_steps_per_s"] < \
+        pages[0].attribution["peer_median_steps_per_s"]
+    assert t.summary()["drifting_ranks"] == [2]
+    # rank 2 catches back up: the drifting set clears, and a later
+    # relapse would page again
+    for k in range(4, 12):
+        t.observe({"ev": "rank_progress", "t": k * 1.0,
+                   "steps": {0: k * 10, 1: k * 10, 2: k * 10,
+                             3: k * 10}})
+    assert t.summary()["drifting_ranks"] == []
+
+
+# ---------------------------------------------------------------------------
+# SLO burn rate (multi-window)
+# ---------------------------------------------------------------------------
+
+def test_ttft_burn_page_carries_worst_request():
+    t = _tower("burn_min_events=5")
+    # decode-stretch shape: every request finishes, all miss the SLO
+    for i in range(8):
+        t.observe({"ev": "serve_request", "t": float(i), "ok": True,
+                   "request_id": f"r{i}", "ttft_s": 2.0 + i,
+                   "waterfall": {"queued_s": 0.1, "prefill_s": 1.9 + i,
+                                 "decode_s": 0.5}})
+    pages = [a for a in t.alerts if a.kind == "slo_burn_rate"]
+    assert len(pages) == 1
+    att = pages[0].attribution
+    assert att["slo"] == "ttft"
+    # the page fires at the 5th sample (min_events): the worst bad
+    # request seen so far is r4 — the alert names it, waterfall attached
+    assert att["request"]["request_id"] == "r4", \
+        "the page must name the worst offending request"
+    assert att["request"]["waterfall"]["prefill_s"] == 5.9
+    gauges = obs.get_registry().snapshot()
+    assert gauges['watchtower_burn_rate{slo="ttft",window="fast"}'] > 0
+
+
+def test_burn_needs_min_events_and_rearms_on_recovery():
+    t = _tower("burn_min_events=10")
+    for i in range(9):
+        t.observe({"ev": "serve_reject", "t": float(i),
+                   "request_id": f"r{i}", "reason": "backpressure"})
+    assert t.alerts == [], "below min_events the burn must hold fire"
+    t.observe({"ev": "serve_reject", "t": 9.0, "request_id": "r9",
+               "reason": "backpressure"})
+    assert [a.kind for a in t.alerts] == ["slo_burn_rate"]
+    assert t.summary()["burns_active"] == ["ttft"]
+    # a long healthy stretch dilutes the fast window under threshold
+    for i in range(200):
+        t.observe({"ev": "serve_request", "t": 10.0 + i, "ok": True,
+                   "request_id": f"g{i}", "ttft_s": 0.01})
+    assert t.summary()["burns_active"] == []
+
+
+def test_token_latency_burn_from_stretched_rounds():
+    t = _tower("burn_min_events=5")
+    for i in range(8):
+        t.observe({"ev": "serve_round", "t": float(i), "round": i,
+                   "wall_s": 5.0, "queue_depth": 0, "queue_max": 10,
+                   "kv_free": 8, "kv_total": 8})
+    pages = [a for a in t.alerts if a.kind == "slo_burn_rate"]
+    assert len(pages) == 1
+    assert pages[0].attribution["slo"] == "token_latency"
+
+
+# ---------------------------------------------------------------------------
+# Chaos drills — the acceptance scenarios
+# ---------------------------------------------------------------------------
+
+def test_chaos_slow_rank_pages_straggler_and_doctor_sees_it(
+        tmp_path, monkeypatch):
+    """``slow@rank=2:ms=200`` on a 4-rank gang: three fast ranks and
+    one chaos-stalled one drive REAL ChaosEngines; the supervisor-style
+    sampler feeds per-rank step totals into the tower. The page must
+    name rank 2, dump the flight ring, and obs_doctor --json must carry
+    the alert + attribution."""
+    # the agent env contract wins over set_dump_dir — point it at ours
+    monkeypatch.setenv(flight.ENV_FLIGHT_DIR, str(tmp_path))
+    tower = watchtower.maybe_init("drift_factor=1.5:drift_min_samples=3",
+                                  rank=0)
+    tower.dump_on_page = True
+    faults = chaos.parse_spec("slow@rank=2:ms=200")
+    steps = {r: 0 for r in range(4)}
+    stop = threading.Event()
+
+    def worker(rank):
+        eng = chaos.ChaosEngine(faults, rank=rank, seed=1)
+        s = 0
+        while not stop.is_set():
+            eng.step(s)  # rank 2 sleeps 200ms here, peers don't
+            s += 1
+            steps[rank] = s
+            time.sleep(0.01)
+
+    threads = [threading.Thread(target=worker, args=(r,), daemon=True)
+               for r in range(4)]
+    for th in threads:
+        th.start()
+    try:
+        deadline = time.monotonic() + 10.0
+        while time.monotonic() < deadline:
+            time.sleep(0.25)
+            watchtower.on_rank_progress(dict(steps))
+            if tower.alerts:
+                break
+    finally:
+        stop.set()
+        for th in threads:
+            th.join(timeout=2.0)
+
+    pages = [a for a in tower.alerts if a.kind == "straggler_drift"]
+    assert pages, "the chaos-slowed rank must page within the deadline"
+    assert pages[0].attribution["rank"] == 2, \
+        "the alert must name the injected rank"
+    # chaos fired on rank 2 only, and the ring shows it
+    assert any(e["kind"] == "chaos" and "rank=2" in e["note"]
+               for e in flight.get_recorder().snapshot())
+    dump = tmp_path / "flight_rank0.json"
+    assert dump.exists(), "a page must trigger an automatic flight dump"
+    payload = json.loads(dump.read_text())
+    assert payload["reason"].startswith("alert:straggler_drift")
+
+    repo = Path(__file__).parent.parent
+    proc = subprocess.run(
+        [sys.executable, str(repo / "scripts" / "obs_doctor.py"),
+         str(tmp_path), "--json"],
+        capture_output=True, text=True, timeout=120, cwd=repo,
+        env={**os.environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr or proc.stdout
+    report = json.loads(proc.stdout)
+    doctor_alerts = report["alerts"]["0"]
+    assert any(a["kind"] == "straggler_drift"
+               and '"rank": 2' in a["note"]
+               for a in doctor_alerts), doctor_alerts
+
+
+def test_chaos_serve_reject_burns_ttft_budget():
+    """``serve_reject@p=1`` through the REAL scheduler admission path:
+    every shed request spends TTFT error budget, so the burn-rate page
+    fires without a single completed request."""
+    watchtower.maybe_init("burn_min_events=5", rank=0)
+    watchtower.tower().dump_on_page = False
+    chaos.maybe_init("serve_reject@p=1", rank=0, seed=3)
+    sched = Scheduler(KVPool(num_blocks=8, block_size=4), max_queue=4)
+    for i in range(8):
+        req = sched.submit([1, 2, 3], 2)
+        assert req.state == "rejected" and req.reject_reason == "chaos"
+    pages = [a for a in watchtower.tower().alerts
+             if a.kind == "slo_burn_rate"]
+    assert len(pages) == 1
+    assert pages[0].attribution["slo"] == "ttft"
+    assert pages[0].attribution["request"]["ok"] is False
+
+
+# ---------------------------------------------------------------------------
+# JSONL replay path (scripts/obs_watch.py)
+# ---------------------------------------------------------------------------
+
+def test_events_from_jsonl_mapping():
+    evs = watchtower.events_from_jsonl(
+        {"event": "train_step", "time": 5.0, "step": 3, "loss": 2.5,
+         "seconds": 0.2})
+    assert [e["ev"] for e in evs] == ["loss", "train_step"]
+    assert evs[1]["wall_s"] == 0.2
+    evs = watchtower.events_from_jsonl(
+        {"event": "goodput", "time": 9.0, "step": 10,
+         "goodput_frac": 0.4, "wall_s": 2.0, "steps": 10})
+    assert [e["ev"] for e in evs] == ["goodput", "train_step"]
+    assert evs[1]["wall_s"] == 0.2
+    evs = watchtower.events_from_jsonl(
+        {"event": "serve_reject", "time": 1.0, "request_id": "r1",
+         "reason": "backpressure"})
+    assert evs == [{"ev": "serve_reject", "t": 1.0, "request_id": "r1",
+                    "reason": "backpressure"}]
+    assert watchtower.events_from_jsonl({"event": "eval"}) == []
+
+
+def test_obs_watch_cli_replay_is_deterministic(tmp_path):
+    lines = []
+    for i in range(30):
+        lines.append({"event": "train_step", "time": float(i), "step": i,
+                      "loss": 2.0 if i != 28 else 99.0,
+                      "seconds": 0.1 if i != 27 else 7.0})
+    for i in range(12):
+        lines.append({"event": "serve_reject", "time": 40.0 + i,
+                      "request_id": f"r{i}", "reason": "backpressure"})
+    jsonl = tmp_path / "metrics.jsonl"
+    jsonl.write_text("".join(json.dumps(r) + "\n" for r in lines))
+
+    repo = Path(__file__).parent.parent
+
+    def run():
+        return subprocess.run(
+            [sys.executable, str(repo / "scripts" / "obs_watch.py"),
+             str(jsonl), "--json"],
+            capture_output=True, text=True, timeout=120, cwd=repo,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+
+    first, second = run(), run()
+    assert first.returncode == 1, \
+        (first.stderr, "a replay with pages must exit nonzero")
+    assert first.stdout == second.stdout, "replay must be byte-identical"
+    out_lines = first.stdout.strip().splitlines()
+    summary = json.loads(out_lines[-1])
+    kinds = set(summary["summary"]["by_kind"])
+    assert {"step_time_outlier", "loss_spike", "slo_burn_rate"} <= kinds
+    for line in out_lines[:-1]:
+        assert json.loads(line)["kind"] in watchtower.ALERT_KINDS
