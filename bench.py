@@ -140,6 +140,7 @@ _METRIC_NAMES = {
     "loader": "input-pipeline samples/sec ({preset})",
     "quality": "held-out NLL (llama3_8b_zero)",
     "serve": "serving tokens/sec (llama3_8b_zero)",
+    "fleet": "fleet serving tokens/sec (llama3_8b_zero)",
 }
 
 # Nominal GPU-class MFU for the BASELINE configs whose absolute rate
@@ -810,21 +811,142 @@ def bench_serve(args) -> int:
     return 0
 
 
+def bench_fleet(args) -> int:
+    """Replica-fleet serving (serve/fleet.py): the SAME open-loop
+    ragged workload through 1 replica and through N replicas behind
+    the KV-aware router, so ``vs_baseline`` is the fleet's tokens/s
+    scaling (ideal = N; the gap is router + supervision overhead).
+    Then the N-replica run is repeated with one chaos ``kill_replica``
+    injected mid-stream: stranded requests fail over to survivors with
+    their emitted prefix, and the record carries p99 TTFT with and
+    without the kill — the failover tax the paper's robustness story
+    must bound (acceptance: < 2x the steady-state p99)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorch_distributed_nn_tpu.config import get_config
+    from pytorch_distributed_nn_tpu.models import get_model
+    from pytorch_distributed_nn_tpu.runtime import chaos
+    from pytorch_distributed_nn_tpu.serve import Fleet, ragged_prompt_sampler
+    from pytorch_distributed_nn_tpu.serve.engine import _bucket_len
+
+    cfg = get_config("llama3_8b_zero")
+    if args.serve_tiny:
+        cfg.model.extra = dict(num_layers=4, d_model=256, num_heads=8,
+                               num_kv_heads=4, mlp_dim=1024,
+                               vocab_size=1024)
+        cfg.model.compute_dtype = "float32"
+    else:
+        cfg.model.extra = dict(num_layers=8, d_model=1024, num_heads=8,
+                               num_kv_heads=4, mlp_dim=3584,
+                               vocab_size=32000)
+    cfg.model.remat = False
+    model = get_model(cfg.model)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32),
+                        train=False)["params"]
+
+    slots = args.per_chip_batch or 4
+    n_rep = max(args.fleet_replicas, 2)
+    n_req = max(args.serve_requests, slots * n_rep)
+    max_seq = 64 if args.serve_tiny else 256
+    budget_cycle = (2, 8, 32)
+    budgets = [budget_cycle[i % len(budget_cycle)]
+               for i in range(n_req)]
+    sampler = ragged_prompt_sampler(
+        model.vocab_size, min_len=4,
+        max_len=max_seq - max(budget_cycle) - 1, seed=0)
+    prompts = [sampler() for _ in range(n_req)]
+    warm_lens = sorted({min(_bucket_len(len(p)), max_seq)
+                        for p in prompts})
+    period = 1.0 / args.serve_rate if args.serve_rate > 0 else 0.0
+
+    def run(replicas: int, kill: str | None):
+        chaos.reset()
+        if kill:
+            chaos.maybe_init(kill)
+        fleet = Fleet(model, params, replicas=replicas,
+                      max_slots=slots, max_seq_len=max_seq,
+                      max_queue=n_req)
+        fleet.start(warmup_prompt_lens=warm_lens)
+        t0 = time.perf_counter()
+        t_next = t0
+        tickets = []
+        for p, n in zip(prompts, budgets):
+            wait = t_next - time.perf_counter()
+            if wait > 0:
+                time.sleep(wait)
+            t_next += period
+            tickets.append(fleet.submit(p, n))
+        for t in tickets:
+            t.wait(300.0)
+        wall = time.perf_counter() - t0
+        fleet.stop()
+        chaos.reset()
+        done = [c for c in fleet.completed]
+        toks = sum(c["new_tokens"] for c in done)
+        ttfts = np.array([c["ttft_s"] for c in done
+                          if c["ttft_s"] >= 0.0])
+        return dict(tps=toks / wall, ttfts=ttfts,
+                    completed=len(done),
+                    failovers=fleet.failovers)
+
+    single = run(1, None)
+    steady = run(n_rep, None)
+    # kill replica 1 a few rounds in: mid-stream, load-independent
+    chaotic = run(n_rep, "kill_replica@replica=1:step=5")
+
+    def p99(xs):
+        return float(np.percentile(xs, 99)) if len(xs) else 0.0
+
+    backend = jax.default_backend()
+    from pytorch_distributed_nn_tpu.utils.metrics import MetricsLogger
+
+    MetricsLogger(stream=sys.stdout).emit_benchmark(
+        metric=_METRIC_NAMES["fleet"],
+        value=round(steady["tps"], 1), unit="tokens/sec",
+        vs_baseline=round(steady["tps"] / single["tps"], 3),
+        vs_baseline_kind=f"fleet_{n_rep}x_over_single_replica",
+        backend=backend,
+        replicas=n_rep, requests=n_req,
+        completed=steady["completed"],
+        single_tokens_per_s=round(single["tps"], 1),
+        ttft_p99_ms=round(p99(steady["ttfts"]) * 1e3, 2),
+        ttft_p99_with_kill_ms=round(p99(chaotic["ttfts"]) * 1e3, 2),
+        kill_tokens_per_s=round(chaotic["tps"], 1),
+        kill_completed=chaotic["completed"],
+        kill_failovers=chaotic["failovers"],
+        detail=f"open-loop {args.serve_rate:g} req/s, {n_req} ragged "
+               f"requests, {slots} slots/replica, {n_rep} replicas vs "
+               f"1; kill drill: kill_replica@replica=1:step=5"
+               + (" [tiny dims]" if args.serve_tiny else ""),
+    )
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--preset", default="resnet50_dp",
                     choices=sorted(PER_CHIP_BATCH))
     ap.add_argument("--metric", default="throughput",
                     choices=("throughput", "bus_bw", "decode", "loader",
-                             "quality", "serve"),
+                             "quality", "serve", "fleet"),
                     help="bus_bw: BASELINE's grad-allreduce bus-bandwidth "
                          "metric (use with --preset bert_base_buckets); "
                          "decode: KV-cache generation tokens/s; loader: "
                          "input-pipeline samples/s vs chip consumption; "
                          "serve: continuous-batching engine tokens/s vs "
-                         "a static-batch baseline under ragged load")
+                         "a static-batch baseline under ragged load; "
+                         "fleet: N-replica fleet tokens/s scaling vs one "
+                         "replica + p99 TTFT with/without a kill drill")
     ap.add_argument("--serve", action="store_true",
                     help="shorthand for --metric serve")
+    ap.add_argument("--fleet", action="store_true",
+                    help="shorthand for --metric fleet")
+    ap.add_argument("--fleet-replicas", type=int, default=3,
+                    help="fleet metric: replica count for the scaling "
+                         "and kill-drill runs")
     ap.add_argument("--serve-requests", type=int, default=24,
                     help="serve metric: synthetic requests in the timed "
                          "open-loop run")
@@ -897,6 +1019,8 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
     if args.serve:
         args.metric = "serve"
+    if args.fleet:
+        args.metric = "fleet"
 
     from pytorch_distributed_nn_tpu.runtime.platform import (
         apply_platform_overrides,
@@ -918,6 +1042,8 @@ def main(argv=None) -> int:
         return bench_quality(args)
     if args.metric == "serve":
         return bench_serve(args)
+    if args.metric == "fleet":
+        return bench_fleet(args)
 
     import jax
 

@@ -135,6 +135,11 @@ class RestartPolicy:
       restarts immediately and charges nothing: a preempted worker did
       nothing wrong.
 
+    Process-agnostic on purpose: the elastic agent below governs OS
+    processes with it, and the serving fleet (serve/fleet.py) reuses
+    it unchanged per replica — thread-backed replicas crash, hang, and
+    drain through the same budget/backoff/preempt semantics.
+
     ``clock`` is injectable for fake-clock tests.
     """
 

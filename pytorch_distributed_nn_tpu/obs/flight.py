@@ -46,7 +46,11 @@ Event kinds:
 - ``alert`` — a watchtower alert (obs/watchtower.py): every online
   detection lands here emit-first, and page-severity alerts trigger an
   automatic :func:`dump_now` — the ring that reaches disk already
-  names what the run knew was wrong.
+  names what the run knew was wrong;
+- ``fleet`` — replica-fleet lifecycle (serve/fleet.py): counted state
+  transitions (``state:<s>``), ``replica_down`` (with the stranded
+  request ids in the note), failover ``readmit`` markers, and rolling
+  ``reload`` completions — a dead replica's dump names its victims.
 
 Stdlib-only on purpose: dump paths run inside signal handlers and
 heartbeat daemon threads of processes whose main thread is wedged
@@ -102,7 +106,7 @@ class FlightEvent:
 
     seq: int
     kind: str  # collective | dispatch | step | checkpoint | data
-    #          # | chaos | preempt | serve | alert
+    #          # | chaos | preempt | serve | alert | fleet
     op: str
     step: int
     t0: float

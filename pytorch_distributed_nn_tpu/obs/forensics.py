@@ -78,6 +78,14 @@ class RankDump:
         doctor sees what the run already knew about itself."""
         return [e for e in self.events if e.get("kind") == "alert"]
 
+    @property
+    def fleet_events(self) -> list[dict]:
+        """Replica-fleet lifecycle (serve/fleet.py): state changes,
+        replica_down, re-admissions, reloads. A fleet failover dump is
+        diagnosed from these — which replica died and which requests it
+        stranded."""
+        return [e for e in self.events if e.get("kind") == "fleet"]
+
     def last_event(self) -> dict | None:
         return self.events[-1] if self.events else None
 
@@ -221,7 +229,53 @@ def attribute(events: list[dict]) -> dict:
         if e.get("kind") == "serve" and \
                 str(e.get("op", "")).startswith(("reject:", "evict:")):
             out["suspect_request"] = e.get("note", "")
+    # fleet failover (serve/fleet.py): name the dead replica and the
+    # requests it stranded. Keys are CONDITIONAL — non-fleet rings keep
+    # their existing attribution dict byte-identical (replay contract).
+    downs = [e for e in events if e.get("kind") == "fleet"
+             and e.get("op") == "replica_down"]
+    if downs:
+        replica, stranded = _parse_replica_down(downs[-1])
+        out["dead_replica"] = replica
+        out["stranded_requests"] = stranded
     return out
+
+
+def _parse_replica_down(ev: dict) -> tuple[str, list[str]]:
+    """('r1', ['freq-3', ...]) from a fleet replica_down event note
+    (``r1 reason=... stranded=freq-3,freq-5``)."""
+    note = str(ev.get("note", ""))
+    replica = note.split(" ", 1)[0] if note else ""
+    m = re.search(r"stranded=([^\s]+)", note)
+    stranded = [s for s in (m.group(1).split(",") if m else []) if s]
+    return replica, stranded
+
+
+def fleet_summary(dumps: dict[int, RankDump]) -> dict | None:
+    """Aggregate fleet lifecycle across the dumps: dead replicas with
+    their stranded requests, re-admission count, reload count, state-
+    transition tally. None when no dump holds fleet events (single-
+    engine runs stay fleet-silent)."""
+    events = [e for d in dumps.values() for e in d.fleet_events]
+    if not events:
+        return None
+    downs, readmits, reloads = [], 0, 0
+    states: dict[str, int] = {}
+    for e in events:
+        op = str(e.get("op", ""))
+        if op == "replica_down":
+            replica, stranded = _parse_replica_down(e)
+            downs.append({"replica": replica, "stranded": stranded,
+                          "note": e.get("note", "")})
+        elif op == "readmit":
+            readmits += 1
+        elif op == "reload":
+            reloads += 1
+        elif op.startswith("state:"):
+            s = op.split(":", 1)[1]
+            states[s] = states.get(s, 0) + 1
+    return {"replicas_down": downs, "readmits": readmits,
+            "reloads": reloads, "state_transitions": states}
 
 
 # ---------------------------------------------------------------------------
@@ -477,6 +531,18 @@ def render_report(dumps: dict[int, RankDump],
         for r in sorted(alerts):
             for ev in alerts[r][-5:]:
                 out(f"  rank {r}: {_fmt_event(ev)}")
+
+    fleet = fleet_summary(dumps)
+    if fleet is not None:
+        out("")
+        out("fleet (serve/fleet.py — replica lifecycle in the ring):")
+        for down in fleet["replicas_down"]:
+            ids = ", ".join(down["stranded"]) or "(none)"
+            out(f"  replica {down['replica']} DOWN — stranded "
+                f"request(s): {ids}")
+        out(f"  re-admissions: {fleet['readmits']}, reloads: "
+            f"{fleet['reloads']}, state transitions: "
+            f"{fleet['state_transitions']}")
 
     hung = {r: d.incomplete() for r, d in dumps.items()
             if d.incomplete()}

@@ -29,7 +29,10 @@ loop) and to the metric registry, and raises structured
   rejected request spends TTFT error budget too — load shedding IS an
   SLO violation to the client) — pages only when BOTH windows burn,
   so a blip can't page and a slow leak still does;
-- ``goodput_drop`` — goodput fraction under a floor at log cadence.
+- ``goodput_drop`` — goodput fraction under a floor at log cadence;
+- ``replica_down`` — fleet feed (serve/fleet.py): a serving replica
+  crashed or went heartbeat-stale; pages with the replica index and
+  the stranded request ids being re-admitted on survivors.
 
 Every alert is a first-class event (:meth:`Watchtower._emit`, lint:
 flight-ring record FIRST): ``watchtower_alerts_total{kind,severity}``
@@ -86,7 +89,7 @@ PAGE = "page"
 
 ALERT_KINDS = ("step_time_outlier", "loss_spike", "loss_nonfinite",
                "straggler_drift", "queue_pressure", "kv_pressure",
-               "slo_burn_rate", "goodput_drop")
+               "slo_burn_rate", "goodput_drop", "replica_down")
 
 
 @dataclasses.dataclass
@@ -244,6 +247,14 @@ class Watchtower:
         # recent finished requests, worst-TTFT-first attribution feed
         self._recent_reqs: collections.deque[dict] = collections.deque(
             maxlen=32)
+        # TTFT-budget idempotency (fleet failover): each request id
+        # spends the TTFT error budget at most once — a request
+        # re-admitted after a replica death, or rejected then retried,
+        # must not burn the budget again (rejects already spend it
+        # once). Bounded set: deque evicts the oldest charged id.
+        self._ttft_charged: set[str] = set()
+        self._ttft_charged_q: collections.deque[str] = \
+            collections.deque(maxlen=4096)
 
     # -- the alert choke point -------------------------------------------
 
@@ -395,11 +406,23 @@ class Watchtower:
         cfg, t = self.cfg, float(ev["t"])
         ok = bool(ev.get("ok", True))
         ttft = float(ev.get("ttft_s", 0.0))
+        rid = str(ev.get("request_id", ""))
         self._recent_reqs.append({
-            "request_id": str(ev.get("request_id", "")),
+            "request_id": rid,
             "ttft_s": round(ttft, 6), "ok": ok,
             "waterfall": ev.get("waterfall"),
         })
+        # one budget sample per request id (set-based, so replaying the
+        # same stream stays byte-identical): the first terminal outcome
+        # — reject or completion — is the one the client experienced;
+        # a fleet re-admission of the same id must not charge twice
+        if rid and rid in self._ttft_charged:
+            return
+        if rid:
+            if len(self._ttft_charged_q) == self._ttft_charged_q.maxlen:
+                self._ttft_charged.discard(self._ttft_charged_q[0])
+            self._ttft_charged_q.append(rid)
+            self._ttft_charged.add(rid)
         self._burns["ttft"].add(t, (not ok) or ttft > cfg.ttft_slo_s)
         self._check_burn("ttft", cfg.ttft_slo_s, t)
 
@@ -450,6 +473,22 @@ class Watchtower:
             elif rate >= base:
                 self._drifting.discard(rank)
 
+    def _obs_replica_down(self, ev: dict) -> None:
+        """Fleet feed (serve/fleet.py): a serving replica crashed or
+        went heartbeat-stale. Always a page — every stream it held is
+        mid-failover and its capacity is gone until a restart."""
+        t = float(ev["t"])
+        replica = int(ev.get("replica", -1))
+        reason = str(ev.get("reason", ""))
+        stranded = [str(r) for r in ev.get("stranded", [])]
+        self._raise(
+            "replica_down", PAGE, t, value=float(len(stranded)),
+            detail=f"replica {replica} down ({reason}); "
+                   f"{len(stranded)} in-flight request(s) re-admitted "
+                   f"on survivors",
+            attribution={"replica": replica, "reason": reason,
+                         "stranded_requests": stranded})
+
     _HANDLERS = {
         "train_step": _obs_train_step,
         "loss": _obs_loss,
@@ -459,6 +498,7 @@ class Watchtower:
         "serve_request": _obs_serve_request,
         "serve_reject": _obs_serve_reject,
         "rank_progress": _obs_rank_progress,
+        "replica_down": _obs_replica_down,
     }
 
     # -- burn-rate core --------------------------------------------------
@@ -565,6 +605,11 @@ def events_from_jsonl(rec: dict) -> list[dict]:
         out.append({"ev": "serve_reject", "t": t,
                     "request_id": rec.get("request_id", ""),
                     "reason": rec.get("reason", "")})
+    elif ev == "fleet_replica_down":
+        out.append({"ev": "replica_down", "t": t,
+                    "replica": int(rec.get("replica", -1)),
+                    "reason": rec.get("reason", ""),
+                    "stranded": rec.get("stranded", [])})
     return out
 
 
@@ -686,3 +731,14 @@ def on_rank_progress(steps_by_rank: dict) -> None:
         return
     _tower.observe({"ev": "rank_progress", "t": time.time(),
                     "steps": dict(steps_by_rank)})
+
+
+def on_replica_down(replica: int, reason: str,
+                    stranded: list | None = None) -> None:
+    """Fleet supervisor hook (serve/fleet.py): a replica crashed or
+    went stale; ``stranded`` lists the request ids being re-admitted."""
+    if _tower is None:
+        return
+    _tower.observe({"ev": "replica_down", "t": time.time(),
+                    "replica": int(replica), "reason": str(reason),
+                    "stranded": list(stranded or [])})

@@ -35,6 +35,20 @@ Spec grammar (faults joined by ``;``)::
                                          probability p (seeded) — the
                                          overload/load-shed drill for
                                          serve/scheduler.py
+    kill_replica@replica=1[:after_s=2][:step=...]
+                                         raise ReplicaKillError in the
+                                         replica's driver loop — the
+                                         fleet crash-failover drill
+                                         (serve/fleet.py); after_s gates
+                                         on wall time since arming,
+                                         step on the replica's round
+    hang_replica@replica=1[:ms=...][:step=...]
+                                         sleep inside the replica's
+                                         driver loop (default:
+                                         effectively forever) — the
+                                         replica's heartbeat goes
+                                         stale and the fleet's
+                                         FailureDetector flags it
 
 ``rank`` / ``inc`` (incarnation, from ``TPUNN_RESTART``) are optional
 filters; a fault without them fires in every process / incarnation.
@@ -84,11 +98,20 @@ CRASH_EXIT_CODE = 43
 DEFAULT_HANG_MS = 3_600_000.0
 
 FAULT_KINDS = ("crash", "hang", "slow", "preempt", "corrupt_ckpt",
-               "store_flaky", "serve_reject")
+               "store_flaky", "serve_reject", "kill_replica",
+               "hang_replica")
 
-_INT_KEYS = ("step", "rank", "inc")
-_FLOAT_KEYS = ("ms", "p")
+_INT_KEYS = ("step", "rank", "inc", "replica")
+_FLOAT_KEYS = ("ms", "p", "after_s")
 _STR_KEYS = ("collective",)
+
+
+class ReplicaKillError(RuntimeError):
+    """Raised by an injected ``kill_replica`` fault inside a replica's
+    driver loop. Thread-backed replicas cannot ``os._exit`` (that would
+    take the whole fleet down instead of one replica); the fleet
+    supervisor catches this — like any other worker exception — and
+    runs the failover path."""
 
 
 @dataclasses.dataclass
@@ -101,6 +124,8 @@ class Fault:
     collective: str = ""
     ms: float = 0.0
     p: float = 0.0
+    replica: int | None = None
+    after_s: float = 0.0
 
 
 def parse_spec(spec: str) -> list[Fault]:
@@ -154,6 +179,7 @@ def _validate(fault: Fault) -> None:
         "corrupt_ckpt": ("step",), "hang": ("collective",),
         "slow": ("ms",), "store_flaky": ("p",),
         "serve_reject": ("p",),
+        "kill_replica": ("replica",), "hang_replica": ("replica",),
     }[fault.kind]
     for key in need:
         missing = (getattr(fault, key) in (None, "", 0.0)
@@ -189,6 +215,7 @@ class ChaosEngine:
         self._rng = random.Random((seed << 8) ^ rank)
         self._fired: set[int] = set()  # fault ids that fire once
         self._step = 0  # last step seen via on_step
+        self._t0 = time.monotonic()  # armed-at (kill_replica after_s=)
 
     def _matches(self, fault: Fault, *, step: int | None = None) -> bool:
         if fault.rank is not None and fault.rank != self.rank:
@@ -266,6 +293,24 @@ class ChaosEngine:
                 return True
         return False
 
+    def replica_round(self, replica: int, round_: int) -> None:
+        """Fleet replica-driver hook: kill/hang one replica. Both fire
+        once; ``step=`` keys on the replica's own round counter and
+        ``after_s=`` on wall time since the engine armed."""
+        for i, fault in enumerate(self.faults):
+            if (fault.kind not in ("kill_replica", "hang_replica")
+                    or i in self._fired or fault.replica != replica
+                    or not self._matches(fault, step=round_)):
+                continue
+            if fault.after_s \
+                    and time.monotonic() - self._t0 < fault.after_s:
+                continue
+            self._fired.add(i)
+            if fault.kind == "kill_replica":
+                self._inject_kill_replica(fault, replica)
+            else:
+                self._inject_hang_replica(fault, replica)
+
     # -- injections (each one _emits first: lint-enforced) ---------------
 
     def _inject_crash(self, fault: Fault) -> None:
@@ -305,6 +350,20 @@ class ChaosEngine:
         # which turns this hook's True into a counted rejection — the
         # flight ring must already hold the injection when it does
         self._emit(fault, note=f"{fault.spec} [{request_id}]")
+
+    def _inject_kill_replica(self, fault: Fault, replica: int) -> None:
+        self._emit(fault, note=f"{fault.spec} [replica {replica}]")
+        raise ReplicaKillError(
+            f"chaos: injected kill on replica {replica}")
+
+    def _inject_hang_replica(self, fault: Fault, replica: int) -> None:
+        self._emit(fault, note=f"{fault.spec} [replica {replica}]")
+        # the driver thread wedges here; its heartbeat's progress
+        # watchdog goes quiet and the fleet's FailureDetector flags the
+        # replica stale. The fleet abandons the thread (daemon) — when
+        # the sleep ends it must observe its stop flag and exit without
+        # touching the engine a successor replica replaced.
+        time.sleep((fault.ms or DEFAULT_HANG_MS) / 1000.0)
 
 
 def corrupt_step_dir(step_dir: str) -> int:
@@ -411,3 +470,14 @@ def on_admit(request_id: str = "") -> bool:
     if _engine is None:
         return False
     return _engine.admit(request_id)
+
+
+def on_replica_round(replica: int, round_: int) -> None:
+    """``serve.fleet`` replica-driver hook (kill_replica /
+    hang_replica). Called once per driver-loop iteration, outside the
+    engine's ``_decode_round`` hot loop (its lint bans extras there).
+    May raise :class:`ReplicaKillError` (crash drill) or block (hang
+    drill) — the fleet supervisor owns the failover either way."""
+    if _engine is None:
+        return
+    _engine.replica_round(replica, round_)

@@ -1,4 +1,4 @@
-"""Continuous-batching serving subsystem (ISSUE 5).
+"""Continuous-batching serving subsystem (ISSUE 5 + fleet, ISSUE 8).
 
 Layering (each module's docstring carries its own contract):
 
@@ -10,16 +10,35 @@ Layering (each module's docstring carries its own contract):
   positions over one dense KV cache, mid-batch retirement, greedy
   decode bit-identical to sequential ``inference.generate``;
 - :mod:`serve.server` — thread loopback front-end, SIGTERM drain,
-  open/closed-loop synthetic clients.
+  open/closed-loop synthetic clients;
+- :mod:`serve.router` — fleet placement policy: score READY replicas
+  by KV headroom minus queue pressure, one counted choke point;
+- :mod:`serve.fleet` — replica supervisor: N engines behind one
+  admission point, heartbeat failure detection, chaos-tested failover
+  with in-flight re-admission, rolling zero-reject weight reload.
 
-CLI: ``scripts/serve.py``; load test: ``bench.py --serve``; docs:
-``docs/serving.md``.
+CLI: ``scripts/serve.py``; load test: ``bench.py --serve`` /
+``bench.py --fleet``; docs: ``docs/serving.md``.
 """
 
 from pytorch_distributed_nn_tpu.serve.engine import (  # noqa: F401
     ServingEngine,
 )
+from pytorch_distributed_nn_tpu.serve.fleet import (  # noqa: F401
+    Fleet,
+    FleetTicket,
+    ReplicaHandle,
+)
 from pytorch_distributed_nn_tpu.serve.kv_pool import KVPool  # noqa: F401
+from pytorch_distributed_nn_tpu.serve.router import (  # noqa: F401
+    DEAD,
+    DRAINING,
+    READY,
+    RELOADING,
+    REPLICA_STATES,
+    STARTING,
+    Router,
+)
 from pytorch_distributed_nn_tpu.serve.scheduler import (  # noqa: F401
     Request,
     Scheduler,

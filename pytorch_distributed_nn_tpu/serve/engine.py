@@ -147,11 +147,15 @@ class ServingEngine:
     def __init__(self, model, params, *, max_slots: int = 4,
                  max_seq_len: int = 256, block_size: int = 16,
                  max_queue: int = 64, max_prefills_per_round: int = 2,
-                 eos_token: Optional[int] = None, metrics=None) -> None:
+                 eos_token: Optional[int] = None, metrics=None,
+                 tag: str = "") -> None:
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
         self.model = model
         self.params = params
+        # owner label (fleet replica name): rides every serve_request
+        # record so per-replica occupancy survives into the JSONL
+        self.tag = tag
         self.max_slots = max_slots
         self.max_seq_len = int(max_seq_len)
         self.eos_token = eos_token
@@ -378,6 +382,8 @@ class ServingEngine:
             kv_util=self.scheduler.pool.utilization(),
             waterfall=waterfall,
         )
+        if self.tag:
+            rec["replica"] = self.tag
         self.completed.append(rec)
         if self.metrics is not None:
             self.metrics.emit("serve_request", **rec)

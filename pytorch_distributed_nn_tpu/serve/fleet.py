@@ -1,0 +1,753 @@
+"""Replica fleet: supervisor, failover, and rolling weight reload.
+
+One :class:`serve.engine.ServingEngine` is one crash domain — when it
+dies, every in-flight stream dies with it. This module turns N engines
+into a fleet a request can survive:
+
+- **replicas** — each replica is an engine plus a driver thread (the
+  worker), a :class:`runtime.failure.HeartbeatReporter` beating into an
+  in-process store, and its own :class:`launch.RestartPolicy` (the
+  PR-3 restart governor, reused verbatim: budget window, exponential
+  backoff + seeded jitter, free restarts for graceful preemption);
+- **admission** — :meth:`Fleet.submit` admits a request exactly once
+  fleet-wide: the :class:`serve.router.Router` scores READY replicas by
+  KV-block headroom and queue depth (one counted choke point), and the
+  chosen replica's own scheduler applies the real backpressure;
+- **failover** — every admission is journaled (prompt, budget,
+  placement). Replica death is detected two ways: a worker exception
+  (chaos ``kill_replica`` raises :class:`runtime.chaos.ReplicaKillError`
+  in the driver loop) surfaces on the next :meth:`poll`, and a wedged
+  worker (chaos ``hang_replica`` sleeps in the driver loop) stops
+  notifying its heartbeat's progress watchdog, so the REAL
+  :class:`runtime.failure.FailureDetector` flags the replica stale.
+  Either way the fleet marks the replica DEAD (counted state change),
+  dumps the flight ring, pages the watchtower (``replica_down``), and
+  re-admits each stranded request on a survivor with prompt +
+  tokens-emitted-so-far as the new prompt — greedy decode is a pure
+  function of the sequence prefix, so the stitched stream is
+  bit-identical to an uninterrupted run (golden-tested);
+- **rolling reload** — :meth:`Fleet.reload` rolls replicas one at a
+  time through the graceful-drain contract: the router stops placing on
+  the replica, the worker finishes everything it holds and exits with
+  ``failure.GRACEFUL_EXIT_CODE`` (83), the restart policy charges
+  nothing (``reason="preempt"``), and a fresh engine rejoins with the
+  new params. No request is ever rejected by a reload — draining here
+  means "stop feeding", never ``scheduler.drain()``'s queued-reject.
+
+Design contract (lint-enforced by tests/test_quality.py, mirroring the
+scheduler's ``_transition``): every replica state change goes through
+:meth:`Fleet._set_state`, which bumps
+``serve_replica_state_total{state}`` and lands a ``fleet`` event in the
+flight ring — replica lifecycle can never drift off the books.
+
+Thread model: client threads call :meth:`submit`; each replica's worker
+thread drives only its own engine; one supervisor thread (started by
+:meth:`start`) calls :meth:`poll` — exits, staleness, delayed restarts,
+ticket finalization — under the fleet lock. Workers never take the
+fleet lock, so a wedged replica cannot wedge supervision.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import logging
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from pytorch_distributed_nn_tpu.launch import RestartPolicy
+from pytorch_distributed_nn_tpu.obs import flight, watchtower
+from pytorch_distributed_nn_tpu.obs.registry import get_registry
+from pytorch_distributed_nn_tpu.runtime import chaos, failure
+from pytorch_distributed_nn_tpu.serve.engine import ServingEngine
+from pytorch_distributed_nn_tpu.serve.router import (
+    DEAD,
+    DRAINING,
+    READY,
+    RELOADING,
+    STARTING,
+    Router,
+)
+from pytorch_distributed_nn_tpu.serve.scheduler import DONE, REJECTED
+
+log = logging.getLogger(__name__)
+
+_ids = itertools.count()
+
+
+class _MemStore:
+    """In-process stand-in for the native store client, satisfying the
+    slice of its surface the heartbeat protocol uses (``set`` / ``get``
+    / ``check`` / ``close``) — so the fleet reuses the REAL
+    ``HeartbeatReporter`` and ``FailureDetector`` unmodified, same
+    keys, same staleness math, no sockets."""
+
+    def __init__(self) -> None:
+        self._d: dict[str, bytes] = {}
+        self._lock = threading.Lock()
+
+    def set(self, key: str, value: bytes) -> None:
+        with self._lock:
+            self._d[key] = bytes(value)
+
+    def get(self, key: str, timeout_ms: int = 0) -> bytes:
+        with self._lock:
+            return self._d[key]
+
+    def check(self, key: str) -> bool:
+        with self._lock:
+            return key in self._d
+
+    def close(self) -> None:
+        pass
+
+
+class FleetTicket:
+    """The client's handle on one fleet-admitted request. Survives
+    failover: the underlying per-replica ``Request`` may be replaced,
+    ``done``/``tokens`` here are the logical request's."""
+
+    def __init__(self, request_id: str, prompt: np.ndarray,
+                 max_new_tokens: int,
+                 deadline_s: Optional[float]) -> None:
+        self.request_id = request_id
+        self.prompt = prompt
+        self.max_new_tokens = int(max_new_tokens)
+        self.deadline_s = deadline_s
+        self.t_submit = time.monotonic()
+        self.t_first_token = 0.0
+        self.t_done = 0.0
+        # tokens emitted by dead replicas, re-fed as prompt suffix on
+        # re-admission; final tokens = prefix + surviving life's output
+        self.prefix: list[int] = []
+        self.failovers: list[dict] = []
+        self.status = "pending"  # pending | done | rejected | failed
+        self.reject_reason = ""
+        self.tokens: Optional[np.ndarray] = None
+        self.done = threading.Event()
+        self._attempt: Optional[tuple[int, object]] = None
+
+    @property
+    def ok(self) -> bool:
+        return self.status == "done"
+
+    @property
+    def ttft_s(self) -> float:
+        return (self.t_first_token - self.t_submit
+                if self.t_first_token else -1.0)
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self.done.wait(timeout)
+
+    def result(self, timeout: Optional[float] = None):
+        """Block for the tokens; None on timeout or a non-DONE end."""
+        if not self.done.wait(timeout):
+            return None
+        return self.tokens if self.ok else None
+
+
+class _ReplicaWorker:
+    """One replica's driver thread: pump the engine, heartbeat
+    progress, honor stop/preempt, and run the chaos replica drill."""
+
+    def __init__(self, index: int, engine: ServingEngine,
+                 reporter: failure.HeartbeatReporter,
+                 idle_wait_s: float) -> None:
+        self.index = index
+        self.engine = engine
+        self.reporter = reporter
+        self.idle_wait_s = idle_wait_s
+        self.started_at = time.monotonic()
+        self.exit_reason: Optional[str] = None  # ok | preempt | crash
+        self.exit_code: Optional[int] = None
+        self.error: Optional[BaseException] = None
+        self._stop = threading.Event()
+        self._preempt = threading.Event()
+        self._thread = threading.Thread(
+            target=self._run, name=f"fleet-r{index}", daemon=True)
+
+    def start(self) -> None:
+        self.started_at = time.monotonic()
+        self._thread.start()
+
+    @property
+    def alive(self) -> bool:
+        return self._thread.is_alive()
+
+    def request_stop(self) -> None:
+        """Hard stop (death declared / fleet shutdown): the loop exits
+        before its next engine touch — a thread waking from an injected
+        hang must never step an engine its successor replaced."""
+        self._stop.set()
+
+    def request_preempt(self) -> None:
+        """Graceful-drain notice (rolling reload): finish everything
+        the engine holds, then exit ``GRACEFUL_EXIT_CODE`` — the
+        thread-world analog of the PR-3 SIGTERM/exit-83 contract."""
+        self._preempt.set()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        self._thread.join(timeout)
+
+    def _run(self) -> None:
+        code, reason = 0, "ok"
+        try:
+            while not self._stop.is_set():
+                # chaos kill/hang drill, outside the engine's lint-
+                # guarded hot loop; may raise (kill) or block (hang)
+                chaos.on_replica_round(self.index,
+                                       self.engine.scheduler.round + 1)
+                if self._stop.is_set():
+                    break  # declared dead while hung: hands off
+                self.reporter.notify_progress()
+                if self._preempt.is_set() and not self.engine.has_work:
+                    code, reason = failure.GRACEFUL_EXIT_CODE, "preempt"
+                    break
+                if self.engine.has_work:
+                    self.engine.step()
+                else:
+                    time.sleep(self.idle_wait_s)
+        except BaseException as e:  # noqa: BLE001 — any death is a crash
+            code, reason = chaos.CRASH_EXIT_CODE, "crash"
+            self.error = e
+            log.warning("fleet replica %d crashed: %r", self.index, e)
+        self.exit_code, self.exit_reason = code, reason
+
+
+@dataclasses.dataclass
+class ReplicaHandle:
+    """The fleet's book entry for one replica slot. ``state`` is
+    written ONLY by :meth:`Fleet._set_state` (lint-enforced)."""
+
+    index: int
+    name: str
+    policy: RestartPolicy
+    engine: Optional[ServingEngine] = None
+    worker: Optional[_ReplicaWorker] = None
+    reporter: Optional[failure.HeartbeatReporter] = None
+    state: str = ""
+    incarnations: int = 0
+    restart_at: Optional[float] = None
+    stop_reason: str = ""
+
+
+class Fleet:
+    """N serving replicas behind one admission point."""
+
+    def __init__(self, model, params, *, replicas: int = 2,
+                 max_slots: int = 4, max_seq_len: int = 256,
+                 block_size: int = 16, max_queue: int = 64,
+                 max_prefills_per_round: int = 2,
+                 eos_token: Optional[int] = None, metrics=None,
+                 max_restarts: int = 3,
+                 restart_window_s: Optional[float] = None,
+                 backoff_base_s: float = 0.05,
+                 backoff_max_s: float = 2.0,
+                 heartbeat_interval_s: float = 0.1,
+                 heartbeat_timeout_s: float = 10.0,
+                 progress_window_s: Optional[float] = None,
+                 idle_wait_s: float = 0.002,
+                 poll_interval_s: float = 0.01) -> None:
+        if replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {replicas}")
+        self.model = model
+        self.params = params
+        self.metrics = metrics
+        self.eos_token = eos_token
+        self._engine_kw = dict(
+            max_slots=max_slots, max_seq_len=max_seq_len,
+            block_size=block_size, max_queue=max_queue,
+            max_prefills_per_round=max_prefills_per_round)
+        self._hb_interval = heartbeat_interval_s
+        self._progress_window = (progress_window_s
+                                 if progress_window_s is not None
+                                 else max(heartbeat_timeout_s / 2,
+                                          2 * heartbeat_interval_s))
+        self._idle_wait = idle_wait_s
+        self._poll_interval = poll_interval_s
+        self.router = Router()
+        self._store = _MemStore()
+        self._detector = failure.FailureDetector(
+            self._store, ranks=list(range(replicas)), incarnation=0,
+            timeout_s=heartbeat_timeout_s)
+        self._lock = threading.RLock()
+        self._journal: dict[str, FleetTicket] = {}
+        self.completed: list[dict] = []
+        self.failovers = 0
+        reg = get_registry()
+        self._c_replica_state = reg.counter(
+            "serve_replica_state_total", "replica state transitions",
+            labels=("state",))
+        self._replicas: list[ReplicaHandle] = []
+        for i in range(replicas):
+            h = ReplicaHandle(
+                index=i, name=f"r{i}",
+                policy=RestartPolicy(
+                    max_restarts=max_restarts,
+                    window_s=restart_window_s,
+                    backoff_base_s=backoff_base_s,
+                    backoff_max_s=backoff_max_s, seed=i))
+            self._replicas.append(h)
+            self._set_state(h, STARTING, reason="init")
+            self._spawn(h, params)
+            self._set_state(h, READY, reason="up")
+        self._started = False
+        self._sup_stop = threading.Event()
+        self._sup_thread: Optional[threading.Thread] = None
+
+    # -- the single replica-state choke point ------------------------------
+
+    def _set_state(self, h: ReplicaHandle, state: str,
+                   reason: str = "") -> None:
+        """EVERY replica state change funnels through here (lint-
+        enforced): the ``serve_replica_state_total{state}`` counter and
+        the flight ring can't drift from the fleet's actual shape."""
+        h.state = state
+        self._c_replica_state.inc(state=state)
+        flight.record("fleet", f"state:{state}",
+                      note=f"{h.name} {reason}".strip())
+        if self.metrics is not None:
+            self.metrics.emit("fleet_state", replica=h.index,
+                              state=state, reason=reason)
+
+    # -- replica lifecycle -------------------------------------------------
+
+    def _spawn(self, h: ReplicaHandle, params) -> None:
+        """Fresh engine + heartbeat + worker for one replica slot (first
+        start, post-crash restart, or post-reload rejoin)."""
+        h.engine = ServingEngine(
+            self.model, params, eos_token=self.eos_token,
+            metrics=self.metrics, tag=h.name, **self._engine_kw)
+        h.reporter = failure.HeartbeatReporter(
+            self._store, rank=h.index, incarnation=0,
+            interval_s=self._hb_interval,
+            progress_window_s=self._progress_window)
+        h.worker = _ReplicaWorker(h.index, h.engine, h.reporter,
+                                  self._idle_wait)
+        h.incarnations += 1
+        h.restart_at = None
+        if getattr(self, "_started", False):
+            h.worker.start()
+
+    def warmup(self, prompt_lens=(8,)) -> None:
+        """Compile the serve jits (prefill per prompt bucket, row
+        insert, the batched decode step) before any worker thread
+        runs them. Without this, the first decode on a cold process
+        stalls a worker for the whole XLA compile — long enough to
+        starve its progress watchdog and read as a hang to the failure
+        detector (a false replica_down on a healthy fleet). One
+        throwaway forward per bucket; the jit cache is keyed on the
+        model so every replica shares the result."""
+        from pytorch_distributed_nn_tpu.serve.engine import (
+            _bucket_len,
+            _fresh_cache,
+            _insert_row,
+            _serve_prefill,
+            _serve_step,
+        )
+        import jax.numpy as jnp
+        eng = self._replicas[0].engine
+        max_slots = eng.max_slots
+        cache = _fresh_cache(self.model, max_slots, eng.max_seq_len)
+        for plen in prompt_lens:
+            pad = min(_bucket_len(int(plen)), eng.max_seq_len)
+            row = _fresh_cache(self.model, 1, pad)
+            _, row = _serve_prefill(
+                self.model, self.params, row,
+                jnp.zeros((1, pad), jnp.int32),
+                jnp.asarray([int(plen)], jnp.int32))
+            cache = _insert_row(cache, row, 0)
+        nxt, _, _ = _serve_step(
+            self.model, self.params, cache,
+            jnp.zeros((max_slots,), jnp.int32),
+            jnp.zeros((max_slots,), jnp.int32),
+            jnp.zeros((max_slots,), bool))
+        np.asarray(nxt)  # block until compiled + executed
+
+    def start(self, *, warmup_prompt_lens=(8,)) -> "Fleet":
+        """Start every replica's worker plus the supervisor thread.
+        Compiles the serve jits first (see :meth:`warmup`) so a cold
+        process cannot misread compilation as a hung replica; pass
+        ``warmup_prompt_lens=()`` to skip."""
+        if self._started:
+            return self
+        if warmup_prompt_lens:
+            self.warmup(warmup_prompt_lens)
+        self._started = True
+        for h in self._replicas:
+            if h.worker is not None and not h.worker.alive \
+                    and h.worker.exit_reason is None:
+                h.worker.start()
+        self._sup_thread = threading.Thread(
+            target=self._supervise, name="fleet-supervisor", daemon=True)
+        self._sup_thread.start()
+        return self
+
+    def _supervise(self) -> None:
+        while not self._sup_stop.wait(self._poll_interval):
+            try:
+                self.poll()
+            except Exception:  # supervision must outlive any one fault
+                log.exception("fleet poll failed")
+
+    def stop(self) -> None:
+        """Shut the fleet down: stop workers, finish in-flight work
+        synchronously, reject whatever is still queued (``draining``),
+        release heartbeats, finalize every ticket."""
+        if self._sup_thread is not None:
+            self._sup_stop.set()
+            self._sup_thread.join(timeout=5.0)
+            self._sup_thread = None
+        for h in self._replicas:
+            if h.worker is not None and h.worker.alive:
+                h.worker.request_stop()
+                h.worker.join(timeout=5.0)
+            if h.state not in (DEAD,):
+                self._set_state(h, DRAINING, reason="stop")
+                if h.engine is not None and not (
+                        h.worker is not None and h.worker.alive):
+                    h.engine.drain()
+            if h.reporter is not None:
+                h.reporter.stop()
+        self._started = False
+        self.poll()
+
+    def __enter__(self) -> "Fleet":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- client surface ----------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int, *,
+               deadline_s: Optional[float] = None,
+               request_id: Optional[str] = None) -> FleetTicket:
+        """Admit once, place once (router-scored), journal for
+        failover. Always returns a ticket; a rejected one is already
+        terminal."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        ticket = FleetTicket(
+            request_id or f"freq-{next(_ids)}", prompt,
+            max_new_tokens, deadline_s)
+        with self._lock:
+            self._journal[ticket.request_id] = ticket
+            self._place(ticket, prompt, int(max_new_tokens),
+                        resubmit=False)
+        return ticket
+
+    def generate(self, prompt, max_new_tokens: int,
+                 timeout: Optional[float] = None):
+        """Blocking convenience: submit + wait, tokens or None."""
+        ticket = self.submit(prompt, max_new_tokens)
+        if not self._started:
+            self.run_until_idle()
+        return ticket.result(timeout)
+
+    def run_until_idle(self) -> None:
+        """Synchronous drive (fleet not started): round-robin every
+        live engine until all queues and batches are empty, finalizing
+        tickets as they finish. Deterministic — tests use this."""
+        while True:
+            busy = False
+            for h in self._replicas:
+                if h.state in (READY, DRAINING, RELOADING) \
+                        and h.engine is not None and h.engine.has_work:
+                    h.engine.step()
+                    busy = True
+            self.poll()
+            if not busy:
+                return
+
+    # -- placement ---------------------------------------------------------
+
+    def _place(self, ticket: FleetTicket, prompt: np.ndarray,
+               max_new: int, *, resubmit: bool) -> Optional[int]:
+        """One admission attempt through the router (caller holds the
+        fleet lock). Terminalizes the ticket when no replica is ready
+        or the chosen replica rejects. Returns the replica index that
+        accepted the request, None otherwise."""
+        h = self.router.place(self._replicas,
+                              len(prompt) + max_new)
+        if h is None:
+            self._finalize_rejected(ticket, "no_replica")
+            return None
+        req = h.engine.submit(
+            prompt, max_new, deadline_s=ticket.deadline_s,
+            request_id=ticket.request_id, resubmit=resubmit)
+        ticket._attempt = (h.index, req)
+        if req.done.is_set() and req.state == REJECTED:
+            self._finalize_rejected(ticket, req.reject_reason)
+            return None
+        return h.index
+
+    # -- supervision -------------------------------------------------------
+
+    def poll(self) -> None:
+        """One supervision pass: crashed workers, stale heartbeats,
+        due restarts, finished tickets. Thread-safe; the supervisor
+        thread calls it continuously once :meth:`start` has run."""
+        with self._lock:
+            self._check_exits()
+            self._check_stale()
+            self._restart_due()
+            self._finalize_tickets()
+
+    def _check_exits(self) -> None:
+        for h in self._replicas:
+            if (h.state != DEAD and h.worker is not None
+                    and h.worker.exit_reason == "crash"):
+                err = h.worker.error
+                self._fail_replica(
+                    h, kind="crash",
+                    reason=f"crash:{type(err).__name__}"
+                    if err is not None else "crash")
+
+    def _check_stale(self) -> None:
+        alive = {h.index for h in self._replicas
+                 if h.state != DEAD and h.worker is not None
+                 and h.worker.alive and h.worker.exit_reason is None}
+        if not alive:
+            return
+        for idx in self._detector.stale_ranks(alive=alive):
+            self._fail_replica(self._replicas[idx], kind="hang",
+                               reason="hang:heartbeat_stale")
+
+    def _fail_replica(self, h: ReplicaHandle, *, kind: str,
+                      reason: str) -> None:
+        """The failover core: declare the replica dead (counted), dump
+        the ring, page the watchtower, re-admit every stranded request
+        on a survivor, and schedule the restart the policy allows."""
+        stranded = self._stranded_of(h)
+        ids = [t.request_id for t, _ in stranded]
+        self._set_state(h, DEAD, reason=reason)
+        if h.worker is not None:
+            h.worker.request_stop()
+        if h.reporter is not None:
+            h.reporter.stop()
+        flight.record("fleet", "replica_down",
+                      note=f"{h.name} reason={reason} "
+                           f"stranded={','.join(ids)}")
+        # a dead replica is a post-mortem: the ring must reach disk now
+        flight.dump_now(f"replica_down:{h.name}", force=True)
+        watchtower.on_replica_down(h.index, reason, ids)
+        if self.metrics is not None:
+            self.metrics.emit("fleet_replica_down", replica=h.index,
+                              reason=reason, stranded=ids)
+        log.warning("fleet: replica %s down (%s), re-admitting %d "
+                    "stranded request(s)", h.name, reason, len(ids))
+        t_detect = time.monotonic()
+        for ticket, emitted in stranded:
+            self._readmit(ticket, emitted, from_replica=h.index,
+                          t_detect=t_detect, reason=reason)
+        worker = h.worker
+        duration = (time.monotonic() - worker.started_at
+                    if worker is not None else 0.0)
+        code = (worker.exit_code if worker is not None
+                and worker.exit_code is not None
+                else chaos.CRASH_EXIT_CODE)
+        decision = h.policy.on_exit(
+            reason=kind, code=code, duration_s=duration,
+            beat_seen=True)
+        if decision.action == "restart":
+            h.restart_at = time.monotonic() + decision.delay_s
+        else:
+            h.restart_at = None
+            h.stop_reason = decision.why
+            log.warning("fleet: replica %s stays down: %s", h.name,
+                        decision.why)
+
+    def _stranded_of(self, h: ReplicaHandle) -> list[tuple]:
+        """(ticket, tokens-emitted-so-far) for every journaled request
+        whose current life sits on this replica and isn't terminal —
+        running requests recover their slot's emitted tokens, queued
+        ones restart from the bare prompt."""
+        out = []
+        for ticket in self._journal.values():
+            if ticket.done.is_set() or ticket._attempt is None:
+                continue
+            idx, req = ticket._attempt
+            if idx != h.index or req.done.is_set():
+                continue  # terminal lives finalize normally
+            emitted: list[int] = []
+            if h.engine is not None:
+                for slot in h.engine._slots:
+                    if slot is not None and slot.req is req:
+                        emitted = [int(t) for t in slot.tokens]
+                        break
+            if emitted and ticket.t_first_token == 0.0:
+                ticket.t_first_token = req.t_first_token
+            out.append((ticket, emitted))
+        return out
+
+    def _readmit(self, ticket: FleetTicket, emitted: list[int], *,
+                 from_replica: int, t_detect: float,
+                 reason: str) -> None:
+        """Re-admit one stranded request on a survivor: prompt +
+        emitted-so-far becomes the new prompt (greedy re-decode is
+        output-invariant), the remaining budget the new max_new."""
+        ticket.prefix.extend(emitted)
+        remaining = ticket.max_new_tokens - len(ticket.prefix)
+        if remaining <= 0:  # stream was already complete; just stitch
+            self._finalize_done(ticket, from_replica)
+            return
+        new_prompt = ticket.prompt
+        if ticket.prefix:
+            new_prompt = np.concatenate(
+                [ticket.prompt,
+                 np.asarray(ticket.prefix, np.int32)])
+        self.failovers += 1
+        placed = self._place(ticket, new_prompt, remaining,
+                             resubmit=True)
+        readmit_s = time.monotonic() - t_detect
+        to_replica = placed if placed is not None else -1
+        fo = dict(from_replica=from_replica, to_replica=to_replica,
+                  reason=reason, readmit_s=round(readmit_s, 6),
+                  prefix_tokens=len(ticket.prefix))
+        ticket.failovers.append(fo)
+        flight.record("fleet", "readmit",
+                      note=f"{ticket.request_id} r{from_replica}->"
+                           f"r{to_replica} prefix={len(ticket.prefix)}")
+        if self.metrics is not None:
+            self.metrics.emit("fleet_failover",
+                              request_id=ticket.request_id, **fo)
+
+    def _restart_due(self) -> None:
+        now = time.monotonic()
+        for h in self._replicas:
+            if (h.state == DEAD and h.restart_at is not None
+                    and now >= h.restart_at):
+                self._set_state(h, STARTING,
+                                reason=f"restart #{h.incarnations}")
+                self._spawn(h, self.params)
+                self._set_state(h, READY, reason="up")
+
+    def _finalize_tickets(self) -> None:
+        for ticket in list(self._journal.values()):
+            if ticket.done.is_set() or ticket._attempt is None:
+                continue
+            idx, req = ticket._attempt
+            if not req.done.is_set():
+                continue
+            if req.state == DONE:
+                if ticket.t_first_token == 0.0:
+                    ticket.t_first_token = req.t_first_token
+                self._finalize_done(ticket, idx)
+            else:
+                self._finalize_rejected(
+                    ticket, req.reject_reason or req.state,
+                    failed=(req.state == "failed"))
+
+    def _finalize_done(self, ticket: FleetTicket,
+                       replica: int) -> None:
+        tail = []
+        if ticket._attempt is not None:
+            _, req = ticket._attempt
+            if req.tokens is not None:
+                tail = [int(t) for t in req.tokens]
+        ticket.tokens = np.asarray(ticket.prefix + tail, np.int32)
+        ticket.t_done = time.monotonic()
+        ticket.status = "done"
+        rec = dict(
+            request_id=ticket.request_id,
+            prompt_len=len(ticket.prompt),
+            new_tokens=len(ticket.tokens),
+            ttft_s=round(ticket.ttft_s, 6),
+            total_s=round(ticket.t_done - ticket.t_submit, 6),
+            replica=f"r{replica}", failovers=ticket.failovers)
+        self.completed.append(rec)
+        del self._journal[ticket.request_id]
+        ticket.done.set()
+
+    def _finalize_rejected(self, ticket: FleetTicket, reason: str,
+                           failed: bool = False) -> None:
+        ticket.reject_reason = reason
+        ticket.t_done = time.monotonic()
+        ticket.status = "failed" if failed else "rejected"
+        self._journal.pop(ticket.request_id, None)
+        ticket.done.set()
+
+    # -- rolling reload ----------------------------------------------------
+
+    def reload(self, params) -> dict:
+        """Live weight reload, one replica at a time: exclude from
+        placement (RELOADING), graceful-drain the worker (it finishes
+        everything it holds, exits ``GRACEFUL_EXIT_CODE``), restart
+        with the new params (policy charges nothing: ``preempt``),
+        rejoin READY. Under steady load the remaining replicas absorb
+        placement the whole time and nothing is ever rejected with
+        ``draining`` — this path never calls ``scheduler.drain()``.
+
+        Returns ``{replicas_rolled, skipped_dead}``."""
+        rolled, skipped = 0, 0
+        self.params = params
+        for h in list(self._replicas):
+            if h.state == DEAD:
+                skipped += 1  # a later restart picks up self.params
+                continue
+            with self._lock:
+                self._set_state(h, RELOADING, reason="reload")
+            worker = h.worker
+            if worker is not None and worker.alive:
+                worker.request_preempt()
+                worker.join(timeout=120.0)
+                if worker.alive:
+                    raise RuntimeError(
+                        f"fleet reload: replica {h.name} did not "
+                        f"drain in time")
+            else:
+                # synchronous fleet: drain by stepping in place
+                while h.engine is not None and h.engine.has_work:
+                    h.engine.step()
+                self.poll()
+            with self._lock:
+                duration = (time.monotonic() - worker.started_at
+                            if worker is not None else 0.0)
+                h.policy.on_exit(
+                    reason="preempt", code=failure.GRACEFUL_EXIT_CODE,
+                    duration_s=duration, beat_seen=True)
+                if h.reporter is not None:
+                    h.reporter.stop()
+                self._spawn(h, params)
+                self._set_state(h, READY, reason="reloaded")
+                rolled += 1
+            flight.record("fleet", "reload", note=f"{h.name} rejoined")
+        if self.metrics is not None:
+            self.metrics.emit("fleet_reload", replicas=rolled,
+                              skipped_dead=skipped)
+        return dict(replicas_rolled=rolled, skipped_dead=skipped)
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def replicas(self) -> list[ReplicaHandle]:
+        return list(self._replicas)
+
+    @property
+    def live_replicas(self) -> int:
+        return sum(1 for h in self._replicas if h.state == READY)
+
+    def summary(self) -> dict:
+        """Fleet-lifetime aggregates (bench + fleet_summary JSONL)."""
+        per_replica = []
+        for h in self._replicas:
+            eng = h.engine.summary() if h.engine is not None else {}
+            per_replica.append(dict(
+                replica=h.name, state=h.state,
+                incarnations=h.incarnations,
+                budget_restarts=h.policy.budget_restarts,
+                preempt_restarts=h.policy.preempt_restarts,
+                stop_reason=h.stop_reason, **eng))
+        return dict(
+            replicas=len(self._replicas),
+            live=self.live_replicas,
+            requests_done=len(self.completed),
+            in_flight=len(self._journal),
+            failovers=self.failovers,
+            tokens_out=int(sum(r["new_tokens"]
+                               for r in self.completed)),
+            per_replica=per_replica,
+        )

@@ -1,0 +1,90 @@
+"""Fleet request router: admit once, place on the best replica.
+
+The fleet's policy half (the supervisor half is :mod:`serve.fleet`):
+given the live replica set, pick where one request should run. The
+router never talks to a replica — it only *scores* them from the
+health/state the fleet maintains and the queue/KV gauges each replica's
+scheduler and pool already expose, and returns the chosen handle. The
+fleet then performs the actual (single) admission on that replica's
+scheduler, so a request is admitted exactly once fleet-wide; the
+replica's own bounded queue and KV reservation-at-admission stay the
+real backpressure.
+
+Placement score, higher is better::
+
+    score = kv_headroom_frac - queue_frac
+
+- ``kv_headroom_frac`` — the replica's free KV blocks *after* this
+  request's worst-case reservation (``ceil((L + max_new) / block)``),
+  as a fraction of its pool. A replica that cannot reserve the blocks
+  scores negative and is only chosen when every ready replica is in
+  the same state (the request then queues there, FIFO);
+- ``queue_frac`` — waiting requests over ``max_queue``: deep queues
+  repel new work even when KV is free (TTFT lives in the queue).
+
+Only ``READY`` replicas are candidates: ``starting``/``reloading``
+replicas are warming, ``draining`` replicas are being rolled, ``dead``
+replicas are the failover path's business. Ties break on the lowest
+replica index, so placement is deterministic for a given fleet state.
+
+Design contract (lint-enforced by tests/test_quality.py, mirroring the
+scheduler's ``_transition``): EVERY placement decision goes through
+:meth:`Router.place`, which bumps the
+``serve_router_placements_total{outcome}`` counter — no caller can
+pick a replica off the books — and the scoring helper ``_score`` is
+called from nowhere else.
+"""
+
+from __future__ import annotations
+
+from pytorch_distributed_nn_tpu.obs.registry import get_registry
+
+# replica lifecycle (the fleet's _set_state is the only writer —
+# lint-enforced, see tests/test_quality.py)
+STARTING = "starting"
+READY = "ready"
+DRAINING = "draining"
+RELOADING = "reloading"
+DEAD = "dead"
+
+REPLICA_STATES = (STARTING, READY, DRAINING, RELOADING, DEAD)
+
+
+class Router:
+    """Scores replicas and picks one; one counted choke point."""
+
+    def __init__(self) -> None:
+        reg = get_registry()
+        self._c_placements = reg.counter(
+            "serve_router_placements_total",
+            "router placement decisions", labels=("outcome",))
+
+    def _score(self, handle, total_tokens: int) -> float:
+        """Higher is better; negative means the replica cannot reserve
+        this request's KV budget right now (it would queue)."""
+        pool = handle.engine.scheduler.pool
+        sched = handle.engine.scheduler
+        need = -(-int(total_tokens) // pool.block_size)
+        headroom = (pool.free_blocks - need) / max(pool.num_blocks, 1)
+        queue_frac = sched.queue_depth / max(sched.max_queue, 1)
+        return headroom - queue_frac
+
+    def place(self, replicas, total_tokens: int):
+        """Pick the best READY replica for a request of
+        ``total_tokens`` worst-case KV footprint; None when no replica
+        is ready (the fleet rejects the request as ``no_replica``).
+
+        THE placement choke point: every decision — including the
+        failure to make one — lands in
+        ``serve_router_placements_total{outcome}``."""
+        best = None
+        best_score = 0.0
+        for handle in replicas:
+            if handle.state != READY:
+                continue
+            score = self._score(handle, total_tokens)
+            if best is None or score > best_score:
+                best, best_score = handle, score
+        self._c_placements.inc(
+            outcome="placed" if best is not None else "no_replica")
+        return best

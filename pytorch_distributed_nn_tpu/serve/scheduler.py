@@ -88,6 +88,10 @@ class Request:
     round_submitted: int = -1
     round_admitted: int = -1
     round_done: int = -1
+    # fleet failover re-admission (serve/fleet.py): this request id was
+    # already counted queued/running in its first life on a replica
+    # that died — _transition must not double-count those states
+    resubmitted: bool = False
 
     @property
     def total_tokens(self) -> int:
@@ -136,7 +140,16 @@ class Scheduler:
         enforced): the counter can't drift from reality, and terminal
         states release the waiting client exactly once."""
         req.state = state
-        self._c_requests.inc(state=state)
+        # fleet re-admission idempotency: a request re-submitted with
+        # the same id after a replica death already counted its
+        # queued/running transitions in its first life — one logical
+        # request must land in serve_requests_total{state} once per
+        # state, or the fleet's request accounting drifts up with every
+        # failover. Terminal states still count (the first life never
+        # reached one); rejects stay per-occurrence (each reject IS a
+        # distinct shed event and already spends the TTFT budget once).
+        if not (req.resubmitted and state in (QUEUED, RUNNING)):
+            self._c_requests.inc(state=state)
         if state == REJECTED:
             req.reject_reason = reason
             self._c_rejects.inc(reason=reason)
@@ -159,10 +172,14 @@ class Scheduler:
 
     def submit(self, prompt, max_new_tokens: int, *,
                deadline_s: Optional[float] = None,
-               request_id: Optional[str] = None) -> Request:
+               request_id: Optional[str] = None,
+               resubmit: bool = False) -> Request:
         """Thread-safe admission attempt. Always returns a Request; a
         rejected one is already terminal (``done`` set, ``state ==
-        REJECTED``, ``reject_reason`` says why)."""
+        REJECTED``, ``reject_reason`` says why). ``resubmit`` marks a
+        fleet failover re-admission (same ``request_id`` as a request
+        stranded on a dead replica): its queued/running transitions are
+        not re-counted (see :meth:`_transition`)."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("empty prompt")
@@ -173,6 +190,7 @@ class Scheduler:
             prompt=prompt, max_new_tokens=int(max_new_tokens),
             request_id=request_id or f"req-{next(_ids)}",
             deadline_s=deadline_s, t_submit=time.monotonic(),
+            resubmitted=bool(resubmit),
         )
         with self._lock:
             req.round_submitted = self.round

@@ -10,7 +10,9 @@ per-rank collective streams, names the **first divergent collective**
 (hang vs crash vs graceful preemption vs straggler), surfaces any
 injected TPUNN_CHAOS faults so synthetic failures can't be
 misattributed, and prints per-rank step-time percentiles so a slow
-rank stands out even when nothing diverged.
+rank stands out even when nothing diverged. Dumps from a serving fleet
+(serve/fleet.py) additionally name the dead replica and the in-flight
+requests it stranded (``--json`` carries them under ``fleet``).
 
 Usage:
     python scripts/obs_doctor.py RUNDIR              # globs flight_rank*.json
@@ -70,6 +72,10 @@ def _analyze(paths_or_dir, expect_ranks: int | None, last: int,
             },
             "stragglers": [dataclasses.asdict(r) for r in
                            forensics.straggler_report(dumps)],
+            # replica-fleet lifecycle (serve/fleet.py): a failover dump
+            # names the dead replica and the requests it stranded; None
+            # for non-fleet runs so existing consumers see no new noise
+            "fleet": forensics.fleet_summary(dumps),
         }, indent=2))
     else:
         print(forensics.render_report(dumps, expected, last=last))

@@ -217,6 +217,60 @@ def print_serving_table(events: list[dict], last: int) -> bool:
     return True
 
 
+def print_fleet_table(events: list[dict], last: int) -> bool:
+    """Replica-fleet section (serve/fleet.py): per-replica occupancy
+    from the ``replica`` tag on ``serve_request`` records, replica
+    deaths with their stranded requests, failover re-admission latency
+    percentiles, and rolling reloads. Silently skipped when the file
+    has no fleet events (single-engine and training runs)."""
+    downs = [e for e in events if e.get("event") == "fleet_replica_down"]
+    fos = [e for e in events if e.get("event") == "fleet_failover"]
+    states = [e for e in events if e.get("event") == "fleet_state"]
+    reloads = [e for e in events if e.get("event") == "fleet_reload"]
+    tagged = [e for e in events
+              if e.get("event") == "serve_request" and e.get("replica")]
+    if not (downs or fos or states or reloads):
+        return False
+
+    print("\n== fleet ==")
+    if tagged:
+        per: dict[str, list[dict]] = {}
+        for e in tagged:
+            per.setdefault(str(e.get("replica")), []).append(e)
+        print(f"{'replica':>8} {'requests':>9} {'tokens':>8} "
+              f"{'ttft_p50':>10} {'ttft_p99':>10}")
+        for name in sorted(per):
+            rs = per[name]
+            ttft = [_num(e, "ttft_s") for e in rs]
+            toks = sum(int(_num(e, "new_tokens")) for e in rs)
+            print(f"{name:>8} {len(rs):>9} {toks:>8} "
+                  f"{_fmt_s(percentile(ttft, 0.50))} "
+                  f"{_fmt_s(percentile(ttft, 0.99))}")
+    if downs:
+        print(f"replica deaths: {len(downs)}")
+        for e in downs[-last:]:
+            stranded = e.get("stranded") or []
+            ids = ", ".join(str(s) for s in stranded) or "(none)"
+            print(f"  replica {int(_num(e, 'replica', -1))} DOWN "
+                  f"({e.get('reason', '?')}) — stranded: {ids}")
+    if fos:
+        lat = [_num(e, "readmit_s") for e in fos]
+        print(f"failovers: {len(fos)}  re-admission latency "
+              f"p50 {percentile(lat, 0.50) * 1e3:.2f}ms  "
+              f"p99 {percentile(lat, 0.99) * 1e3:.2f}ms")
+        for e in fos[-last:]:
+            print(f"  {e.get('request_id', '?'):>8}  "
+                  f"r{int(_num(e, 'from_replica', -1))}"
+                  f"->r{int(_num(e, 'to_replica', -1))}  "
+                  f"prefix {int(_num(e, 'prefix_tokens')):>3} tok  "
+                  f"readmit {_num(e, 'readmit_s') * 1e3:8.2f}ms")
+    if reloads:
+        rolled = sum(int(_num(e, "replicas")) for e in reloads)
+        print(f"rolling reloads: {len(reloads)} "
+              f"({rolled} replica(s) rolled)")
+    return True
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("jsonl", help="metrics JSONL path "
@@ -231,13 +285,17 @@ def main(argv=None) -> int:
     if not events:
         print(f"no events in {args.jsonl}")
         return 1
-    has_serve = any(e.get("event") in ("serve_request", "serve_summary")
+    has_serve = any(e.get("event") in
+                    ("serve_request", "serve_summary", "fleet_state",
+                     "fleet_replica_down", "fleet_failover",
+                     "fleet_reload")
                     for e in events)
     ok = print_goodput_table(events, args.last, quiet=has_serve)
     print_comms_table(events, args.trace or None)
     serve_ok = print_serving_table(events, args.last)
+    fleet_ok = print_fleet_table(events, args.last)
     print_metric_tail(events, args.last)
-    return 0 if (ok or serve_ok) else 1
+    return 0 if (ok or serve_ok or fleet_ok) else 1
 
 
 if __name__ == "__main__":

@@ -42,17 +42,23 @@ def test_parse_full_grammar():
         "preempt@step=9;"
         "corrupt_ckpt@step=6;"
         "store_flaky@p=0.1;"
-        "serve_reject@p=0.3"
+        "serve_reject@p=0.3;"
+        "kill_replica@replica=1:after_s=2;"
+        "hang_replica@replica=0:ms=50:step=3"
     )
     kinds = [f.kind for f in faults]
     assert kinds == ["crash", "hang", "slow", "preempt", "corrupt_ckpt",
-                     "store_flaky", "serve_reject"]
+                     "store_flaky", "serve_reject",
+                     "kill_replica", "hang_replica"]
     assert faults[0].step == 7 and faults[0].rank == 1
     assert faults[0].inc == 0
     assert faults[1].collective == "all_reduce" and faults[1].ms == 50.0
     assert faults[2].ms == 200.0 and faults[2].rank == 2
     assert faults[5].p == 0.1
     assert faults[6].p == 0.3
+    assert faults[7].replica == 1 and faults[7].after_s == 2.0
+    assert faults[8].replica == 0 and faults[8].ms == 50.0
+    assert faults[8].step == 3
 
 
 @pytest.mark.parametrize("bad", [
@@ -68,6 +74,10 @@ def test_parse_full_grammar():
     "serve_reject",         # missing required p=
     "serve_reject@p=2",     # p out of range
     "serve_reject@step=1",  # step alone doesn't satisfy required p=
+    "kill_replica",         # missing required replica=
+    "kill_replica@after_s=1",   # after_s alone doesn't satisfy replica=
+    "hang_replica@ms=5",    # missing required replica=
+    "kill_replica@replica=x",   # bad int
     "",                     # empty
 ])
 def test_parse_rejects_bad_specs(bad):
@@ -86,6 +96,7 @@ def test_hooks_are_noops_when_unset():
     chaos.on_collective("all_reduce")
     chaos.on_checkpoint_saved(None, 1)
     chaos.on_store_op("set", "k")
+    chaos.on_replica_round(0, 1)
     assert _chaos_ring_events() == []
     assert chaos.engine() is None
 
@@ -378,3 +389,45 @@ def test_trainer_graceful_preempt_saves_and_exits(tmp_path, monkeypatch):
         trainer.close()
     # handler restored on close
     assert not failure.preempt_requested()
+
+
+# ---------------------------------------------------------------------------
+# Replica faults (ISSUE 8): the fleet driver hook
+# ---------------------------------------------------------------------------
+
+def test_kill_replica_fires_once_on_matching_replica_and_round():
+    eng = chaos.ChaosEngine(
+        chaos.parse_spec("kill_replica@replica=1:step=2"), rank=0)
+    eng.replica_round(0, 2)  # wrong replica: inert
+    eng.replica_round(1, 1)  # wrong round: inert
+    assert _chaos_ring_events() == []
+    with pytest.raises(chaos.ReplicaKillError):
+        eng.replica_round(1, 2)
+    eng.replica_round(1, 2)  # fire-once: a second pass is inert
+    events = _chaos_ring_events()
+    assert len(events) == 1 and events[0]["op"] == "kill_replica"
+    assert "replica 1" in events[0]["note"]
+    counter = obs.get_registry().counter("chaos_injected_total")
+    assert counter.value(kind="kill_replica") == 1
+
+
+def test_kill_replica_after_s_gates_on_wall_clock():
+    eng = chaos.ChaosEngine(
+        chaos.parse_spec("kill_replica@replica=0:after_s=30"), rank=0)
+    eng.replica_round(0, 1)  # armed 30s not elapsed yet: inert
+    assert _chaos_ring_events() == []
+    eng._t0 -= 31.0  # pretend the engine armed 31s ago
+    with pytest.raises(chaos.ReplicaKillError):
+        eng.replica_round(0, 2)
+
+
+def test_hang_replica_sleeps_and_emits_first():
+    eng = chaos.ChaosEngine(
+        chaos.parse_spec("hang_replica@replica=0:ms=30"), rank=0)
+    t0 = time.perf_counter()
+    eng.replica_round(0, 1)  # blocks ~30ms, then returns
+    assert time.perf_counter() - t0 >= 0.02
+    events = _chaos_ring_events()
+    assert len(events) == 1 and events[0]["op"] == "hang_replica"
+    eng.replica_round(0, 2)  # fire-once
+    assert len(_chaos_ring_events()) == 1

@@ -1,0 +1,396 @@
+"""Fleet serving (ISSUE 8 tentpole): KV-aware router placement, the
+replica supervisor, chaos-tested failover with in-flight re-admission
+(golden bit-identity vs sequential ``generate``), rolling reload with
+zero rejects, the scheduler/watchtower re-admission idempotency
+contract, and the doctor's fleet forensics."""
+
+import time
+import types
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_nn_tpu import obs
+from pytorch_distributed_nn_tpu.config import ModelConfig
+from pytorch_distributed_nn_tpu.inference.generate import generate
+from pytorch_distributed_nn_tpu.models import get_model
+from pytorch_distributed_nn_tpu.obs import flight, forensics, watchtower
+from pytorch_distributed_nn_tpu.runtime import chaos
+from pytorch_distributed_nn_tpu.serve import (
+    DEAD,
+    READY,
+    Fleet,
+    KVPool,
+    Router,
+    Scheduler,
+)
+
+VOCAB = 97
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    """Disarmed chaos, fresh flight ring + metric registry per test."""
+    monkeypatch.delenv(chaos.ENV_CHAOS, raising=False)
+    monkeypatch.delenv(chaos.ENV_CHAOS_SEED, raising=False)
+    chaos.reset()
+    flight.reset_recorder(enabled=True)
+    obs.reset_registry()
+    yield
+    chaos.reset()
+
+
+@pytest.fixture(scope="module")
+def tiny_llama():
+    model = get_model(ModelConfig(
+        name="llama3_8b", compute_dtype="float32", dtype="float32",
+        extra=dict(num_layers=2, d_model=64, num_heads=4, num_kv_heads=2,
+                   mlp_dim=128, vocab_size=VOCAB),
+    ))
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.key(1), tokens, train=False)["params"]
+    return model, params
+
+
+def _prompts(lengths, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, VOCAB, size=(n,)).astype(np.int32)
+            for n in lengths]
+
+
+def _golden(model, params, prompt, n):
+    return np.asarray(generate(model, params, prompt[None], n))[
+        0, len(prompt):]
+
+
+def _fleet_ring(op=None):
+    evs = [e for e in flight.get_recorder().snapshot()
+           if e["kind"] == "fleet"]
+    return [e for e in evs if e["op"] == op] if op else evs
+
+
+# ---------------------------------------------------------------------------
+# Router (no model needed: scored off scheduler/pool gauges)
+# ---------------------------------------------------------------------------
+
+def _handle(index, state, *, free_blocks=16, num_blocks=16,
+            block_size=4, queue_depth=0, max_queue=8):
+    pool = types.SimpleNamespace(free_blocks=free_blocks,
+                                 num_blocks=num_blocks,
+                                 block_size=block_size)
+    sched = types.SimpleNamespace(pool=pool, queue_depth=queue_depth,
+                                  max_queue=max_queue)
+    return types.SimpleNamespace(
+        index=index, state=state,
+        engine=types.SimpleNamespace(scheduler=sched))
+
+
+def test_router_places_only_on_ready_replicas():
+    r = Router()
+    picked = r.place([_handle(0, "starting"), _handle(1, READY),
+                      _handle(2, "draining"), _handle(3, DEAD)], 8)
+    assert picked is not None and picked.index == 1
+    reg = obs.get_registry()
+    assert reg.counter("serve_router_placements_total").value(
+        outcome="placed") == 1
+
+
+def test_router_prefers_kv_headroom_and_shallow_queues():
+    r = Router()
+    # more free KV wins
+    a, b = _handle(0, READY, free_blocks=2), _handle(1, READY,
+                                                     free_blocks=14)
+    assert r.place([a, b], 8).index == 1
+    # ...but a deep queue repels even with KV free
+    busy = _handle(0, READY, queue_depth=8)
+    idle = _handle(1, READY, queue_depth=0)
+    assert r.place([busy, idle], 8).index == 1
+    # deterministic lowest-index tie-break
+    assert r.place([_handle(0, READY), _handle(1, READY)], 8).index == 0
+
+
+def test_router_no_replica_is_a_counted_outcome():
+    r = Router()
+    assert r.place([_handle(0, DEAD), _handle(1, "reloading")], 8) is None
+    reg = obs.get_registry()
+    assert reg.counter("serve_router_placements_total").value(
+        outcome="no_replica") == 1
+
+
+# ---------------------------------------------------------------------------
+# Fleet, synchronous drive (deterministic, no threads)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow  # ~7s: pays the serve jit warmup compile
+def test_fleet_sync_golden_and_summary(tiny_llama):
+    model, params = tiny_llama
+    fleet = Fleet(model, params, replicas=2, max_slots=2,
+                  max_seq_len=128, block_size=16)
+    prompts, budgets = _prompts([5, 9, 12, 7]), [6, 4, 8, 5]
+    tickets = [fleet.submit(p, n) for p, n in zip(prompts, budgets)]
+    fleet.run_until_idle()
+    for t, p, n in zip(tickets, prompts, budgets):
+        assert t.ok, (t.status, t.reject_reason)
+        np.testing.assert_array_equal(t.tokens, _golden(model, params,
+                                                        p, n))
+    # placements spread across both replicas (router scores queue depth)
+    replicas = {rec["replica"] for rec in fleet.completed}
+    assert replicas == {"r0", "r1"}
+    s = fleet.summary()
+    assert s["requests_done"] == 4 and s["in_flight"] == 0
+    assert s["failovers"] == 0 and s["live"] == 2
+    assert len(s["per_replica"]) == 2
+
+
+@pytest.mark.slow  # model fixture + fleet warmup compile
+def test_fleet_rejects_when_no_replica_is_ready(tiny_llama):
+    model, params = tiny_llama
+    fleet = Fleet(model, params, replicas=1, max_slots=1,
+                  max_seq_len=64)
+    fleet._set_state(fleet.replicas[0], DEAD, reason="test")
+    t = fleet.submit([1, 2, 3], 4)
+    assert t.done.is_set() and t.status == "rejected"
+    assert t.reject_reason == "no_replica"
+    assert t.result(0.0) is None
+
+
+# ---------------------------------------------------------------------------
+# Failover drills (threaded fleet + REAL heartbeat protocol + chaos)
+# ---------------------------------------------------------------------------
+
+def _run_fleet_drill(model, params, *, replicas, spec=None, n_req=6,
+                     fleet_kw=None, budgets=(6, 9, 5, 8, 4, 7),
+                     wait_all_ready=False):
+    """Submit everything, arm chaos, start the fleet, wait, stop.
+    Submitting before start makes placement deterministic (queue_frac
+    spreads requests round-robin across replicas by score). With
+    ``wait_all_ready`` the drill also waits out the restart backoff so
+    a killed replica has rejoined before the fleet stops."""
+    if spec:
+        chaos.maybe_init(spec, rank=0, incarnation=0, seed=0)
+    prompts = _prompts([5, 9, 12, 7, 10, 6][:n_req])
+    budgets = list(budgets)[:n_req]
+    fleet = Fleet(model, params, replicas=replicas, max_slots=2,
+                  max_seq_len=128, block_size=16,
+                  **(fleet_kw or {}))
+    tickets = [fleet.submit(p, n) for p, n in zip(prompts, budgets)]
+    try:
+        fleet.start()
+        for t in tickets:
+            assert t.wait(120.0), f"ticket {t.request_id} timed out"
+        deadline = time.monotonic() + 15.0
+        while (wait_all_ready and time.monotonic() < deadline
+               and any(h.state != READY for h in fleet.replicas)):
+            time.sleep(0.05)
+    finally:
+        fleet.stop()
+    return fleet, tickets, prompts, budgets
+
+
+@pytest.mark.slow  # ~7s: threaded failover drill with restart wait
+def test_kill_replica_failover_is_output_invariant(tiny_llama, tmp_path,
+                                                   monkeypatch):
+    """The acceptance criterion: a replica killed mid-decode strands
+    its in-flight requests; the fleet re-admits them (prompt + emitted
+    prefix) on survivors and the stitched streams are bit-identical to
+    the uninterrupted greedy decode."""
+    monkeypatch.setenv(flight.ENV_FLIGHT_DIR, str(tmp_path))
+    model, params = tiny_llama
+    fleet, tickets, prompts, budgets = _run_fleet_drill(
+        model, params, replicas=3,
+        spec="kill_replica@replica=1:step=2", wait_all_ready=True)
+    for t, p, n in zip(tickets, prompts, budgets):
+        assert t.ok, (t.request_id, t.status, t.reject_reason)
+        np.testing.assert_array_equal(
+            t.tokens, _golden(model, params, p, n),
+            err_msg=f"failover perturbed {t.request_id}")
+    # the kill actually happened and was survived
+    assert fleet.failovers >= 1
+    failed_over = [t for t in tickets if t.failovers]
+    assert failed_over
+    for fo in failed_over[0].failovers:
+        assert fo["from_replica"] == 1 and fo["to_replica"] != 1
+        assert fo["reason"].startswith("crash")
+    # the dead replica was declared, dumped, and restarted
+    assert _fleet_ring("replica_down")
+    assert list(tmp_path.glob("flight_rank*.json"))
+    h = fleet.replicas[1]
+    assert h.incarnations >= 2  # restarted after the backoff
+    assert any("r1 restart" in e.get("note", "")
+               for e in _fleet_ring("state:starting"))
+    reg = obs.get_registry()
+    assert reg.counter("serve_replica_state_total").value(
+        state=DEAD) >= 1
+    assert reg.counter("chaos_injected_total").value(
+        kind="kill_replica") == 1
+
+
+@pytest.mark.slow  # ~7s + a 0.6s heartbeat-timeout timing assumption
+def test_hang_replica_detected_via_heartbeat_staleness(tiny_llama):
+    """A hung replica emits no progress beats; the REAL FailureDetector
+    (over the in-process store) flags it stale, the fleet fails it over
+    identically to a crash — and the outputs stay bit-identical."""
+    model, params = tiny_llama
+    fleet, tickets, prompts, budgets = _run_fleet_drill(
+        model, params, replicas=3,
+        spec="hang_replica@replica=0:step=2:ms=30000",
+        fleet_kw=dict(heartbeat_interval_s=0.05,
+                      heartbeat_timeout_s=0.6,
+                      progress_window_s=0.2))
+    for t, p, n in zip(tickets, prompts, budgets):
+        assert t.ok, (t.request_id, t.status, t.reject_reason)
+        np.testing.assert_array_equal(t.tokens,
+                                      _golden(model, params, p, n))
+    assert fleet.failovers >= 1
+    failed_over = [t for t in tickets if t.failovers]
+    assert failed_over
+    assert all(fo["reason"] == "hang:heartbeat_stale"
+               for t in failed_over for fo in t.failovers)
+
+
+@pytest.mark.slow  # threaded drill with a mid-decode kill
+def test_failover_ttft_penalty_is_bounded(tiny_llama):
+    """Failed-over requests pay detection + re-decode, but the penalty
+    must stay within the drill's own wall time — a loose bound that
+    still catches a lost/stuck re-admission (which would block until
+    the 120s ticket timeout)."""
+    model, params = tiny_llama
+    t0 = time.monotonic()
+    fleet, tickets, _, _ = _run_fleet_drill(
+        model, params, replicas=3,
+        spec="kill_replica@replica=1:step=2")
+    wall = time.monotonic() - t0
+    for t in tickets:
+        assert t.ok and 0.0 < t.ttft_s <= wall
+        assert t.t_done - t.t_submit <= wall
+
+
+# ---------------------------------------------------------------------------
+# Rolling reload
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow  # 20-request load with a live weight swap
+def test_rolling_reload_zero_rejects_under_load(tiny_llama):
+    """fleet.reload(params) rolls one replica at a time while traffic
+    flows: every request completes, none is ever rejected — the reload
+    path must not touch ``scheduler.drain()`` (whose rejects are
+    labelled ``draining``)."""
+    model, params = tiny_llama
+    fleet = Fleet(model, params, replicas=2, max_slots=2,
+                  max_seq_len=128, block_size=16, max_queue=64)
+    prompts = _prompts([5, 9, 12, 7] * 5, seed=3)
+    tickets = []
+    try:
+        fleet.start()
+        for i, p in enumerate(prompts):
+            tickets.append(fleet.submit(p, 4 + (i % 3)))
+            if i == 6:
+                out = fleet.reload(params)
+                assert out == dict(replicas_rolled=2, skipped_dead=0)
+            time.sleep(0.01)
+        for t in tickets:
+            assert t.wait(120.0)
+    finally:
+        fleet.stop()
+    assert all(t.ok for t in tickets), \
+        [(t.request_id, t.status, t.reject_reason)
+         for t in tickets if not t.ok]
+    reg = obs.get_registry()
+    assert reg.counter("serve_rejects_total").value(
+        reason="draining") == 0
+    # each replica rejoined as a fresh incarnation, charged to no budget
+    for h in fleet.replicas:
+        assert h.incarnations == 2
+        assert h.policy.budget_restarts == 0
+    assert _fleet_ring("reload")
+
+
+# ---------------------------------------------------------------------------
+# Re-admission idempotency (the satellite bugfix's regression tests)
+# ---------------------------------------------------------------------------
+
+def test_scheduler_resubmit_does_not_double_count_lifecycle():
+    """A failover resubmits the SAME request id on a survivor. The
+    per-request lifecycle counters must describe the logical request:
+    queued/running charged once fleet-wide, terminal charged once."""
+    s1 = Scheduler(KVPool(16, 4))
+    c = obs.get_registry().counter("serve_requests_total")
+    first = s1.submit([1, 2, 3], 4, request_id="req-x")
+    [admitted] = s1.next_admissions(free_slots=1)
+    assert admitted is first
+    assert c.value(state="queued") == 1
+    assert c.value(state="running") == 1
+    # the replica dies; a survivor re-admits the same id
+    s2 = Scheduler(KVPool(16, 4))
+    second = s2.submit([1, 2, 3, 7, 8], 2, request_id="req-x",
+                       resubmit=True)
+    assert second.resubmitted
+    assert c.value(state="queued") == 1  # NOT double-counted
+    [readmitted] = s2.next_admissions(free_slots=1)
+    assert c.value(state="running") == 1  # NOT double-counted
+    s2.retire(readmitted, np.asarray([9, 9], np.int32))
+    assert c.value(state="done") == 1  # terminal outcome counts once
+
+
+def test_scheduler_resubmit_terminal_rejection_still_counts():
+    """Idempotency covers the happy-path states only: if the re-
+    admission itself is rejected, the client saw a real terminal
+    outcome and it must be counted."""
+    s = Scheduler(KVPool(16, 4), max_seq_len=8)
+    c = obs.get_registry().counter("serve_requests_total")
+    r = s.submit(np.arange(1, 8), 6, request_id="req-y",
+                 resubmit=True)  # 7 + 6 > 8
+    assert r.state == "rejected"
+    assert c.value(state="rejected") == 1
+
+
+def test_watchtower_charges_ttft_budget_once_per_request_id():
+    """The watchtower half of the same contract: replayed/re-admitted
+    terminal records for one request id charge the TTFT error budget
+    exactly once (set-based, so replay stays byte-identical)."""
+    tower = watchtower.Watchtower(dump_on_page=False)
+    ev = {"ev": "serve_request", "t": 100.0, "ok": True,
+          "request_id": "req-z", "ttft_s": 0.01}
+    tower.observe(dict(ev))
+    tower.observe(dict(ev, t=101.0, ttft_s=99.0))  # same id: ignored
+    assert len(tower._burns["ttft"].samples) == 1
+    tower.observe(dict(ev, t=102.0, request_id="req-w"))
+    assert len(tower._burns["ttft"].samples) == 2
+
+
+# ---------------------------------------------------------------------------
+# Forensics: the doctor names the dead replica + stranded requests
+# ---------------------------------------------------------------------------
+
+def test_doctor_fleet_summary_names_dead_replica(tmp_path):
+    flight.record("fleet", "state:ready", note="r1 up")
+    flight.record("fleet", "replica_down",
+                  note="r1 reason=crash:ReplicaKillError "
+                       "stranded=freq-3,freq-5")
+    flight.record("fleet", "readmit", note="freq-3 r1->r0 prefix=2")
+    flight.record("fleet", "readmit", note="freq-5 r1->r2 prefix=1")
+    flight.dump_now("replica_down:r1", directory=str(tmp_path),
+                    force=True)
+    dumps = forensics.load_dumps(str(tmp_path))
+    attr = forensics.attribute(next(iter(dumps.values())).events)
+    assert attr["dead_replica"] == "r1"
+    assert attr["stranded_requests"] == ["freq-3", "freq-5"]
+    s = forensics.fleet_summary(dumps)
+    assert s is not None
+    assert s["replicas_down"][0]["replica"] == "r1"
+    assert s["replicas_down"][0]["stranded"] == ["freq-3", "freq-5"]
+    assert s["readmits"] == 2
+    report = forensics.render_report(dumps, None)
+    for needle in ("r1", "freq-3", "freq-5"):
+        assert needle in report
+
+
+def test_fleet_summary_is_none_for_training_dumps(tmp_path):
+    flight.record("collective", "all_reduce", step=1, nbytes=64)
+    flight.dump_now("test", directory=str(tmp_path), force=True)
+    dumps = forensics.load_dumps(str(tmp_path))
+    assert forensics.fleet_summary(dumps) is None
+    assert "dead_replica" not in forensics.attribute(
+        next(iter(dumps.values())).events)

@@ -408,3 +408,94 @@ def test_decode_hot_loop_has_no_host_device_transfers():
         f"_decode_round must fetch device->host exactly once "
         f"(np.asarray of the (slots,) token array), found {fetches}"
     )
+
+
+def test_replica_state_changes_only_through_counted_set_state():
+    """ISSUE 8 lint: the fleet's replica lifecycle mirrors the
+    scheduler's request lifecycle — every ``starting → ready →
+    draining/reloading → dead`` move must hit the
+    ``serve_replica_state_total`` counter and the flight ring.
+    Structural proof: (a) the ONLY place a handle's ``.state`` is
+    assigned across serve/fleet.py + serve/router.py is
+    ``Fleet._set_state`` (the dataclass default is an AnnAssign, not a
+    mutation); (b) ``_set_state`` increments ``_c_replica_state`` and
+    records a ``fleet`` flight event."""
+    offenders = []
+    set_state = None
+    for fname in ("fleet.py", "router.py"):
+        tree = ast.parse((_SERVE / fname).read_text())
+        for cls in [n for n in tree.body
+                    if isinstance(n, ast.ClassDef)]:
+            for fn in [n for n in cls.body
+                       if isinstance(n, ast.FunctionDef)]:
+                if cls.name == "Fleet" and fn.name == "_set_state":
+                    set_state = fn
+                    continue
+                for node in ast.walk(fn):
+                    if isinstance(node, (ast.Assign, ast.AugAssign)):
+                        targets = (node.targets
+                                   if isinstance(node, ast.Assign)
+                                   else [node.target])
+                        for t in targets:
+                            if isinstance(t, ast.Attribute) \
+                                    and t.attr == "state":
+                                offenders.append(
+                                    f"{fname}:{cls.name}.{fn.name}")
+    assert set_state is not None, "Fleet._set_state not found"
+    assert not offenders, (
+        f"replica .state assigned outside Fleet._set_state (bypasses "
+        f"the serve_replica_state_total accounting): {offenders}"
+    )
+    incremented = set()
+    for node in ast.walk(set_state):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "inc"
+                and isinstance(node.func.value, ast.Attribute)):
+            incremented.add(node.func.value.attr)
+    assert "_c_replica_state" in incremented, (
+        f"_set_state must bump serve_replica_state_total, "
+        f"found {sorted(incremented)}"
+    )
+    assert "record" in _calls_in(set_state), \
+        "_set_state must record the transition to the flight ring"
+
+
+def test_router_placement_is_counted_and_scoring_is_internal():
+    """ISSUE 8 lint: ``Router.place`` is THE placement choke point —
+    it must bump ``serve_router_placements_total`` on every decision,
+    and the scoring helper ``_score`` must be called from nowhere else
+    in the serving package (no caller can pick a replica off the
+    books)."""
+    place = None
+    score_callers = []
+    for fname in ("fleet.py", "router.py"):
+        tree = ast.parse((_SERVE / fname).read_text())
+        for cls in [n for n in tree.body
+                    if isinstance(n, ast.ClassDef)]:
+            for fn in [n for n in cls.body
+                       if isinstance(n, ast.FunctionDef)]:
+                if cls.name == "Router" and fn.name == "place":
+                    place = fn
+                for node in ast.walk(fn):
+                    if (isinstance(node, ast.Call)
+                            and isinstance(node.func, ast.Attribute)
+                            and node.func.attr == "_score"):
+                        score_callers.append(
+                            f"{fname}:{cls.name}.{fn.name}")
+    assert place is not None, "Router.place not found"
+    assert score_callers == ["router.py:Router.place"], (
+        f"_score must be called only from Router.place, "
+        f"found {score_callers}"
+    )
+    incremented = set()
+    for node in ast.walk(place):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "inc"
+                and isinstance(node.func.value, ast.Attribute)):
+            incremented.add(node.func.value.attr)
+    assert "_c_placements" in incremented, (
+        f"Router.place must bump serve_router_placements_total, "
+        f"found {sorted(incremented)}"
+    )
