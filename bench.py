@@ -925,6 +925,73 @@ def bench_fleet(args) -> int:
     return 0
 
 
+def _ledger_selftest() -> int:
+    """End-to-end gate check on synthetic trajectories (tier-1 smoke,
+    tests/test_quality.py): an in-band series must pass, a regressed
+    one must fail WITH the metric named, torn/unparsed records must be
+    tolerated. No backend, no jax — pure file analysis."""
+    import tempfile
+
+    from pytorch_distributed_nn_tpu.obs import xray
+
+    def write(d, n, parsed):
+        with open(os.path.join(d, f"BENCH_r{n:02d}.json"), "w") as f:
+            json.dump({"n": n, "cmd": "selftest", "rc": 0,
+                       "parsed": parsed}, f)
+
+    with tempfile.TemporaryDirectory(prefix="tpunn-ledger-") as d:
+        # healthy trajectory: last value inside the prior noise band
+        for n, v in enumerate([100.0, 101.0, 99.0, 100.5], start=1):
+            write(d, n, {"metric": "samples/sec/chip (selftest)",
+                         "value": v, "unit": "samples/s"})
+        write(d, 5, None)  # a failed round (parsed: null) must be skipped
+        v1 = xray.check_ledger(xray.load_bench_records(d))
+        assert v1["ok"], f"in-band series flagged: {v1}"
+        assert v1["skipped_records"] == 1, v1
+        assert v1["metrics"][0]["status"] == "ok", v1
+
+        # regressed trajectory: the newest record collapses 40%
+        write(d, 6, {"metric": "samples/sec/chip (selftest)",
+                     "value": 60.0, "unit": "samples/s"})
+        v2 = xray.check_ledger(xray.load_bench_records(d))
+        assert not v2["ok"], f"regression not flagged: {v2}"
+        assert any("samples/sec/chip (selftest)" in r
+                   for r in v2["regressions"]), v2
+
+        # lower-is-better direction: NLL drifting DOWN is fine
+        for n, v in enumerate([2.31, 2.30, 2.32, 2.10], start=1):
+            write(d, 10 + n, {"metric": "final NLL (selftest)",
+                              "value": v, "unit": "nll"})
+        os.remove(os.path.join(d, "BENCH_r06.json"))
+        v3 = xray.check_ledger(xray.load_bench_records(d))
+        assert v3["ok"], f"NLL improvement flagged: {v3}"
+    print("ledger selftest ok")
+    return 0
+
+
+def bench_ledger(args) -> int:
+    """--ledger: the perf-regression gate over the BENCH_r*.json
+    trajectory. Pure file analysis — dispatched BEFORE any backend
+    probe, so it runs on a dev box with nothing but the records."""
+    from pytorch_distributed_nn_tpu.obs import xray
+
+    if args.selftest:
+        return _ledger_selftest()
+    records = xray.load_bench_records(args.ledger_dir,
+                                      pattern=args.ledger_glob)
+    if not records:
+        print(json.dumps({"event": "ledger", "ok": False, "error":
+                          f"no {args.ledger_glob} under "
+                          f"{args.ledger_dir}"}))
+        return 2
+    verdict = xray.check_ledger(records, mad_k=args.ledger_mad_k,
+                                rel_floor=args.ledger_floor)
+    print(json.dumps({"event": "ledger", **verdict}, sort_keys=True))
+    for r in verdict["regressions"]:
+        print(f"REGRESSION: {r}", file=sys.stderr)
+    return 0 if verdict["ok"] else 1
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("--preset", default="resnet50_dp",
@@ -1016,11 +1083,31 @@ def main(argv=None) -> int:
                     help="dotted config override applied after the "
                          "preset (repeatable), e.g. --set model.remat="
                          "false — for on-chip A/B experiments")
+    ap.add_argument("--ledger", action="store_true",
+                    help="perf-regression gate: fit a noise band "
+                         "(median ± k·MAD) per metric over the prior "
+                         "BENCH_r*.json records and fail — naming the "
+                         "metric — if the newest round falls outside it. "
+                         "Pure file analysis; no backend needed")
+    ap.add_argument("--ledger-dir", default=".",
+                    help="--ledger: directory holding the BENCH records")
+    ap.add_argument("--ledger-glob", default="BENCH_r*.json",
+                    help="--ledger: glob for the record files")
+    ap.add_argument("--ledger-mad-k", type=float, default=4.0,
+                    help="--ledger: band half-width in MADs")
+    ap.add_argument("--ledger-floor", type=float, default=0.05,
+                    help="--ledger: relative band floor (guards "
+                         "near-zero MAD on short, quiet histories)")
+    ap.add_argument("--selftest", action="store_true",
+                    help="--ledger: run the synthetic-trajectory gate "
+                         "check instead of reading real records")
     args = ap.parse_args(argv)
     if args.serve:
         args.metric = "serve"
     if args.fleet:
         args.metric = "fleet"
+    if args.ledger:
+        return bench_ledger(args)
 
     from pytorch_distributed_nn_tpu.runtime.platform import (
         apply_platform_overrides,

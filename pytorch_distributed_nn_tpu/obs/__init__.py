@@ -23,17 +23,25 @@ heartbeats) with:
 - :mod:`obs.watchtower` — online anomaly detection (ISSUE 7): streaming
   detectors over the metric/flight streams raising structured alerts
   (step-time outliers, loss spikes, straggler drift, queue/KV pressure,
-  multi-window SLO burn rate), inert unless ``TPUNN_WATCH`` is set.
+  multi-window SLO burn rate), inert unless ``TPUNN_WATCH`` is set;
+- :mod:`obs.xray` — anomaly-triggered device profiling (ISSUE 10):
+  bounded, rate-limited ``jax.profiler`` captures (page/interval/
+  on-demand triggers), per-op MFU/roofline attribution, compile
+  telemetry feeding the ``recompile_storm`` detector, and the
+  ``bench.py --ledger`` perf-regression gate; inert unless
+  ``TPUNN_XRAY`` is set.
 
 ``scripts/obs_report.py`` renders the JSONL/trace output;
 ``scripts/obs_doctor.py`` analyzes flight dumps;
 ``scripts/obs_watch.py`` tails/replays alerts and burn rates;
+``scripts/obs_xray.py`` renders capture attribution tables;
 ``bench.py --goodput`` attaches the breakdown to benchmark records.
 """
 
 from pytorch_distributed_nn_tpu.obs import flight  # noqa: F401
 from pytorch_distributed_nn_tpu.obs import stats  # noqa: F401
 from pytorch_distributed_nn_tpu.obs import watchtower  # noqa: F401
+from pytorch_distributed_nn_tpu.obs import xray  # noqa: F401
 from pytorch_distributed_nn_tpu.obs.goodput import (  # noqa: F401
     PHASES,
     GoodputMeter,

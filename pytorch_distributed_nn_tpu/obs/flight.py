@@ -50,7 +50,11 @@ Event kinds:
 - ``fleet`` — replica-fleet lifecycle (serve/fleet.py): counted state
   transitions (``state:<s>``), ``replica_down`` (with the stranded
   request ids in the note), failover ``readmit`` markers, and rolling
-  ``reload`` completions — a dead replica's dump names its victims.
+  ``reload`` completions — a dead replica's dump names its victims;
+- ``xray`` — profiler lifecycle (obs/xray.py): ``capture`` /
+  ``capture_done`` markers (the note names the trigger and the capture
+  directory) and per-compilation ``compile`` breadcrumbs, so a dump
+  names the captures that exist for the incident.
 
 Stdlib-only on purpose: dump paths run inside signal handlers and
 heartbeat daemon threads of processes whose main thread is wedged
@@ -67,6 +71,7 @@ import os
 import signal
 import socket
 import sys
+import tempfile
 import threading
 import time
 
@@ -106,7 +111,7 @@ class FlightEvent:
 
     seq: int
     kind: str  # collective | dispatch | step | checkpoint | data
-    #          # | chaos | preempt | serve | alert | fleet
+    #          # | chaos | preempt | serve | alert | fleet | xray
     op: str
     step: int
     t0: float
@@ -263,8 +268,19 @@ class FlightRecorder:
     # -- dumping ---------------------------------------------------------
 
     def _resolve_dir(self, directory=None) -> str:
-        return str(directory or os.environ.get(ENV_FLIGHT_DIR)
-                   or self._dump_dir or ".")
+        d = (directory or os.environ.get(ENV_FLIGHT_DIR)
+             or self._dump_dir)
+        if d:
+            return str(d)
+        # Last resort is a stable tmp location, NOT the CWD: an
+        # unconfigured process (tests, ad-hoc scripts) must never
+        # litter whatever directory it happens to run from.
+        d = os.path.join(tempfile.gettempdir(), "tpunn-flight")
+        try:
+            os.makedirs(d, exist_ok=True)
+        except OSError:
+            return tempfile.gettempdir()
+        return d
 
     def dump(self, reason: str, *, directory=None, rank: int | None = None,
              force: bool = False) -> str | None:
@@ -351,6 +367,14 @@ def on_collective(op: str, **kw) -> None:
 
 def set_dump_dir(directory) -> None:
     _recorder.set_dump_dir(directory)
+
+
+def resolve_dump_dir(directory=None) -> str:
+    """Where post-mortem artifacts land right now (explicit arg >
+    ``TPUNN_FLIGHT_DIR`` > :func:`set_dump_dir` > a stable tmp dir).
+    Companion artifacts (xray capture dirs) use this to land next to
+    the flight dump."""
+    return _recorder._resolve_dir(directory)
 
 
 def dump_now(reason: str, *, directory=None, force: bool = False

@@ -79,6 +79,15 @@ class RankDump:
         return [e for e in self.events if e.get("kind") == "alert"]
 
     @property
+    def xray_events(self) -> list[dict]:
+        """Profiler-capture lifecycle (obs/xray.py): every anomaly-
+        triggered capture emits ``capture`` (with the landing dir in the
+        note) before the profiler starts and ``capture_done`` after —
+        so the doctor can point the operator at the device trace that
+        covers the incident window."""
+        return [e for e in self.events if e.get("kind") == "xray"]
+
+    @property
     def fleet_events(self) -> list[dict]:
         """Replica-fleet lifecycle (serve/fleet.py): state changes,
         replica_down, re-admissions, reloads. A fleet failover dump is
@@ -238,6 +247,13 @@ def attribute(events: list[dict]) -> dict:
         replica, stranded = _parse_replica_down(downs[-1])
         out["dead_replica"] = replica
         out["stranded_requests"] = stranded
+    # xray capture (obs/xray.py): the device trace that covers the
+    # incident window. Same conditional-key contract as fleet above.
+    caps = [e for e in events if e.get("kind") == "xray"
+            and e.get("op") == "capture"]
+    if caps:
+        note = str(caps[-1].get("note", ""))
+        out["xray_capture"] = note.rsplit(" -> ", 1)[-1] if note else ""
     return out
 
 

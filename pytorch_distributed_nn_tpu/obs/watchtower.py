@@ -32,7 +32,15 @@ loop) and to the metric registry, and raises structured
 - ``goodput_drop`` — goodput fraction under a floor at log cadence;
 - ``replica_down`` — fleet feed (serve/fleet.py): a serving replica
   crashed or went heartbeat-stale; pages with the replica index and
-  the stranded request ids being re-admitted on survivors.
+  the stranded request ids being re-admitted on survivors;
+- ``recompile_storm`` — compile-telemetry feed (obs/xray.py): the same
+  jitted function re-compiling ``recompile_min`` times inside
+  ``recompile_window_s`` mid-run (shape churn, cache-key drift) warns
+  with the re-traced function named and the seconds lost.
+
+Page-severity alerts also start one bounded :mod:`obs.xray` profiler
+capture when ``TPUNN_XRAY`` is armed — the alert's attribution then
+names the capture directory next to the flight dump.
 
 Every alert is a first-class event (:meth:`Watchtower._emit`, lint:
 flight-ring record FIRST): ``watchtower_alerts_total{kind,severity}``
@@ -76,7 +84,7 @@ import os
 import time
 from typing import Optional
 
-from pytorch_distributed_nn_tpu.obs import flight, forensics
+from pytorch_distributed_nn_tpu.obs import flight, forensics, xray
 from pytorch_distributed_nn_tpu.obs.registry import get_registry
 from pytorch_distributed_nn_tpu.obs.stats import Ewma, mad, median
 
@@ -89,7 +97,8 @@ PAGE = "page"
 
 ALERT_KINDS = ("step_time_outlier", "loss_spike", "loss_nonfinite",
                "straggler_drift", "queue_pressure", "kv_pressure",
-               "slo_burn_rate", "goodput_drop", "replica_down")
+               "slo_burn_rate", "goodput_drop", "replica_down",
+               "recompile_storm")
 
 
 @dataclasses.dataclass
@@ -124,6 +133,9 @@ class WatchConfig:
     # goodput
     goodput_floor: float = 0.5
     goodput_warmup: int = 2        # windows before the floor applies
+    # recompile storm (compile-telemetry feed from obs/xray.py)
+    recompile_min: int = 3         # same-function compiles to alert
+    recompile_window_s: float = 120.0  # trailing window per function
 
 
 _FIELD_TYPES = {f.name: f.type for f in dataclasses.fields(WatchConfig)}
@@ -244,6 +256,8 @@ class Watchtower:
         # rank -> trailing (t, steps_total) snapshots (supervisor feed)
         self._rank_hist: dict[int, collections.deque] = {}
         self._drifting: set[int] = set()
+        # function name -> trailing (t, seconds) compile events
+        self._compile_hist: dict[str, collections.deque] = {}
         # recent finished requests, worst-TTFT-first attribution feed
         self._recent_reqs: collections.deque[dict] = collections.deque(
             maxlen=32)
@@ -284,6 +298,13 @@ class Watchtower:
             # inline forensics: the page names a suspect, not a symptom
             attribution.setdefault("forensics", forensics.attribute(
                 flight.get_recorder().snapshot()))
+            # anomaly-triggered profiling: a page starts one bounded
+            # xray capture (rate limiter permitting) and the alert
+            # names where it landed — inert no-op when TPUNN_XRAY is
+            # unset, so replayed streams stay byte-identical
+            cap = xray.on_page(kind, step=step)
+            if cap:
+                attribution.setdefault("xray_capture", cap)
         alert = Alert(
             seq=len(self.alerts), kind=kind, severity=severity,
             t=round(float(t), 6), step=int(step),
@@ -489,6 +510,33 @@ class Watchtower:
             attribution={"replica": replica, "reason": reason,
                          "stranded_requests": stranded})
 
+    def _obs_compile(self, ev: dict) -> None:
+        """Compile-telemetry feed (obs/xray.py log watch): the same
+        function re-compiling ``recompile_min`` times inside a
+        ``recompile_window_s`` trailing window is a jit cache-miss
+        storm — shape churn or cache-key drift stalling the very steps
+        it lands on. Warns with the re-traced function NAMED; firing
+        clears that function's history, so re-alerting needs a whole
+        fresh storm (hysteresis)."""
+        cfg, t = self.cfg, float(ev["t"])
+        name = str(ev.get("name", ""))
+        hist = self._compile_hist.setdefault(name, collections.deque())
+        hist.append((t, float(ev.get("seconds", 0.0))))
+        while hist and hist[0][0] < t - cfg.recompile_window_s:
+            hist.popleft()
+        if len(hist) < cfg.recompile_min:
+            return
+        n, total_s = len(hist), sum(s for _, s in hist)
+        hist.clear()
+        self._raise(
+            "recompile_storm", WARN, t, value=float(n),
+            threshold=float(cfg.recompile_min),
+            detail=f"{name!r} re-compiled {n}x within "
+                   f"{cfg.recompile_window_s:g}s ({total_s:.2f}s lost "
+                   f"to compilation) — jit cache misses mid-run",
+            attribution={"function": name, "count": n,
+                         "compile_seconds": round(total_s, 4)})
+
     _HANDLERS = {
         "train_step": _obs_train_step,
         "loss": _obs_loss,
@@ -499,6 +547,7 @@ class Watchtower:
         "serve_reject": _obs_serve_reject,
         "rank_progress": _obs_rank_progress,
         "replica_down": _obs_replica_down,
+        "compile": _obs_compile,
     }
 
     # -- burn-rate core --------------------------------------------------
@@ -742,3 +791,12 @@ def on_replica_down(replica: int, reason: str,
     _tower.observe({"ev": "replica_down", "t": time.time(),
                     "replica": int(replica), "reason": str(reason),
                     "stranded": list(stranded or [])})
+
+
+def on_compile(name: str, seconds: float) -> None:
+    """Compile-telemetry hook (obs/xray.py log watch): one observed
+    XLA compilation of ``name`` feeds the recompile_storm detector."""
+    if _tower is None:
+        return
+    _tower.observe({"ev": "compile", "t": time.time(),
+                    "name": str(name), "seconds": float(seconds)})

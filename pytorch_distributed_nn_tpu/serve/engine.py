@@ -48,7 +48,7 @@ from pytorch_distributed_nn_tpu.inference.generate import (
     _apply_prefill_ragged,
     init_cache,
 )
-from pytorch_distributed_nn_tpu.obs import flight, watchtower
+from pytorch_distributed_nn_tpu.obs import flight, watchtower, xray
 from pytorch_distributed_nn_tpu.runtime import chaos
 from pytorch_distributed_nn_tpu.serve.kv_pool import KVPool
 from pytorch_distributed_nn_tpu.serve.scheduler import Request, Scheduler
@@ -237,6 +237,9 @@ class ServingEngine:
             queue_max=sched.max_queue,
             kv_free=sched.pool.free_blocks,
             kv_total=sched.pool.num_blocks)
+        # xray capture clock (serving-side): rounds advance an active
+        # capture window / interval trigger, same placement rule
+        xray.on_serve_round(sched.round)
         retired = self._collect(host_tok)
         if retired:
             self._sync_slots()

@@ -24,6 +24,7 @@ from pytorch_distributed_nn_tpu.obs import aggregate as obs_aggregate
 from pytorch_distributed_nn_tpu.obs import flight
 from pytorch_distributed_nn_tpu.obs import runtime_gauges
 from pytorch_distributed_nn_tpu.obs import watchtower
+from pytorch_distributed_nn_tpu.obs import xray
 from pytorch_distributed_nn_tpu.ops import collectives as cc
 from pytorch_distributed_nn_tpu.runtime import chaos
 from pytorch_distributed_nn_tpu.runtime import failure
@@ -71,6 +72,9 @@ class Trainer:
         # watchtower (TPUNN_WATCH): online anomaly/SLO detection over
         # the hooks below — same inert-when-unset contract as chaos
         watchtower.maybe_init()
+        # xray (TPUNN_XRAY): anomaly-triggered device profiling; pages
+        # raised by the tower above start bounded captures
+        xray.maybe_init()
         self._preemptible = False
         self.mesh = mesh if mesh is not None else make_mesh(
             cfg.mesh.resolve(len(jax.devices()))
@@ -249,6 +253,7 @@ class Trainer:
             # timestamps drive obs_doctor's straggler percentiles
             flight.mark_step(g)
             chaos.on_step(g)  # fault injection point (crash/slow/preempt)
+            xray.on_step(g)  # capture window clock / interval trigger
             if i == 0 and gp.wire_bytes_per_step is None:
                 # trace-time collective accounting rides the first
                 # dispatch (the call that traces step_fn): recorded
@@ -261,6 +266,25 @@ class Trainer:
                                 self.state, x, y)
                 if comm_records:
                     gp.wire_bytes_per_step = cc.wire_bytes(comm_records)
+                    # per-op attribution cross-checks collective time
+                    # against these analytic wire bytes
+                    xray.on_wire_bytes(gp.wire_bytes_per_step)
+                if xray.enabled():
+                    # analytic per-chip step FLOPs turn the capture's
+                    # time shares into achieved FLOP/s + roofline
+                    # fractions; the cost model is only worth its
+                    # (one-off) HLO pass when a capture could use it
+                    try:
+                        from pytorch_distributed_nn_tpu.utils.flops \
+                            import train_flops_per_sample
+
+                        xray.on_flops(
+                            train_flops_per_sample(cfg)
+                            * cfg.data.batch_size
+                            / max(len(jax.devices()), 1))
+                    except Exception as e:  # noqa: BLE001
+                        log.debug("xray flops context unavailable: %s",
+                                  e)
             else:
                 with gp.phase("compute"):
                     with flight.dispatch("train_step", step=g):
@@ -440,6 +464,7 @@ class Trainer:
                     xs, ys = next(batches)
             flight.mark_step(self.data_step + 1, note=f"k={k_eff}")
             chaos.on_step(self.data_step + 1)  # fault injection point
+            xray.on_step(self.data_step + 1)  # capture window clock
             with gp.phase("compute"):
                 with flight.dispatch("multistep", step=self.data_step + 1,
                                      note=f"k={k_eff}"):

@@ -12,7 +12,10 @@ injected TPUNN_CHAOS faults so synthetic failures can't be
 misattributed, and prints per-rank step-time percentiles so a slow
 rank stands out even when nothing diverged. Dumps from a serving fleet
 (serve/fleet.py) additionally name the dead replica and the in-flight
-requests it stranded (``--json`` carries them under ``fleet``).
+requests it stranded (``--json`` carries them under ``fleet``). When
+obs.xray was armed (TPUNN_XRAY=), the profiler-capture dirs that fired
+before the dump ride along under ``xray_captures`` — the device trace
+covering the incident window (render with scripts/obs_xray.py).
 
 Usage:
     python scripts/obs_doctor.py RUNDIR              # globs flight_rank*.json
@@ -76,6 +79,15 @@ def _analyze(paths_or_dir, expect_ranks: int | None, last: int,
             # names the dead replica and the requests it stranded; None
             # for non-fleet runs so existing consumers see no new noise
             "fleet": forensics.fleet_summary(dumps),
+            # profiler captures (obs/xray.py) that fired before the
+            # dump — the landing dir per rank, so a post-mortem can go
+            # straight from the incident to the device trace covering
+            # it; {} for runs with TPUNN_XRAY unset
+            "xray_captures": {
+                str(r): [e.get("note", "").rsplit(" -> ", 1)[-1]
+                         for e in d.xray_events
+                         if e.get("op") == "capture"]
+                for r, d in dumps.items() if d.xray_events},
         }, indent=2))
     else:
         print(forensics.render_report(dumps, expected, last=last))

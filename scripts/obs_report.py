@@ -13,9 +13,13 @@ Reads the JSONL metrics file a training run wrote
   (``--trace``), yielding implied bus bandwidth;
 - the train/eval metric tail.
 
+- the xray capture section (``--xray DIR``): per-op attribution tables
+  from any anomaly-triggered ``obs.xray`` captures under that
+  directory (see ``scripts/obs_xray.py`` for the standalone renderer).
+
 Usage:
     python scripts/obs_report.py runs/metrics.jsonl [--trace runs/xprof]
-        [--last N]
+        [--xray runs/obs] [--last N]
 """
 
 from __future__ import annotations
@@ -271,6 +275,36 @@ def print_fleet_table(events: list[dict], last: int) -> bool:
     return True
 
 
+def print_xray_table(xray_dir: str | None, last: int) -> bool:
+    """Xray section: per-op attribution from anomaly-triggered
+    ``obs.xray`` captures under ``--xray DIR``. Silently skipped when
+    no directory is given; noisy when one is given but holds no
+    captures (the operator asked and should hear "nothing there")."""
+    if not xray_dir:
+        return False
+    from pytorch_distributed_nn_tpu.obs import xray
+
+    paths = xray.find_captures(xray_dir)
+    if not paths:
+        print(f"\nno xray captures under {xray_dir}")
+        return False
+    print("\n== xray captures ==")
+    for p in paths[-last:]:
+        try:
+            summary = xray.load_capture(p)
+        except (OSError, json.JSONDecodeError):
+            print(f"  unreadable capture: {p}")
+            continue
+        att = summary.get("attribution") or {}
+        print(f"-- {summary.get('reason', '?')} at step "
+              f"{summary.get('trigger_step', -1)} "
+              f"(source={att.get('source', 'none')}) --")
+        table = xray.render_op_table(att, top=last)
+        if table:
+            print(table)
+    return True
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__)
     ap.add_argument("jsonl", help="metrics JSONL path "
@@ -278,13 +312,20 @@ def main(argv=None) -> int:
     ap.add_argument("--trace", default="",
                     help="xprof trace dir (perfetto_trace.json.gz) for "
                          "the trace-derived collective cross-check")
+    ap.add_argument("--xray", default="",
+                    help="directory holding obs.xray capture dirs "
+                         "(xray_*/xray_summary.json) to render")
     ap.add_argument("--last", type=int, default=5,
                     help="windows/rows to show per table")
     args = ap.parse_args(argv)
     events = load_events(args.jsonl)
     if not events:
         print(f"no events in {args.jsonl}")
-        return 1
+        if not args.xray:
+            return 1
+        # the operator explicitly asked for the xray section — render
+        # it even when the run JSONL is missing/empty
+        return 0 if print_xray_table(args.xray, args.last) else 1
     has_serve = any(e.get("event") in
                     ("serve_request", "serve_summary", "fleet_state",
                      "fleet_replica_down", "fleet_failover",
@@ -294,8 +335,9 @@ def main(argv=None) -> int:
     print_comms_table(events, args.trace or None)
     serve_ok = print_serving_table(events, args.last)
     fleet_ok = print_fleet_table(events, args.last)
+    xray_ok = print_xray_table(args.xray or None, args.last)
     print_metric_tail(events, args.last)
-    return 0 if (ok or serve_ok or fleet_ok) else 1
+    return 0 if (ok or serve_ok or fleet_ok or xray_ok) else 1
 
 
 if __name__ == "__main__":

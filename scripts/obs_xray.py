@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Render obs.xray capture summaries: per-op attribution + compile tally.
+
+Each anomaly-triggered capture (armed via ``TPUNN_XRAY=``) lands as an
+``xray_<rank>_<nn>_<reason>/xray_summary.json`` directory next to the
+flight-ring dump. This script finds every capture under a directory and
+prints, per capture:
+
+- the trigger (reason, step, wall window) and whether the device
+  profiler ran or the flight ring was the only source;
+- the per-op table: time share, calls, bytes, and — when the engine had
+  cost context — FLOPs, achieved FLOP/s and roofline fraction per
+  compute op, with collectives cross-checked against the recorded wire
+  bytes;
+- the compile tally observed during the capture window.
+
+Usage:
+    python scripts/obs_xray.py [dir]            # default: flight dump dir
+    python scripts/obs_xray.py runs/obs --json  # machine-readable
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+sys.path.insert(0, ".")  # run from repo root without install
+
+from pytorch_distributed_nn_tpu.obs import flight, xray  # noqa: E402
+
+
+def print_capture(path: str, summary: dict, *, top: int) -> None:
+    att = summary.get("attribution") or {}
+    print(f"== xray capture: {summary.get('dir', path)} ==")
+    print(f"  trigger: {summary.get('reason', '?')} at step "
+          f"{summary.get('trigger_step', -1)}  "
+          f"({summary.get('steps', 0)} step(s), "
+          f"{max(float(summary.get('t_end', 0.0)) - float(summary.get('t_start', 0.0)), 0.0):.3f}s wall, "
+          f"profiler={'on' if summary.get('profiler') else 'off'}, "
+          f"source={att.get('source', 'none')})")
+    compiles = summary.get("compiles") or {}
+    if compiles:
+        total = sum(compiles.values())
+        secs = float(summary.get("compile_seconds", 0.0))
+        names = ", ".join(f"{k}×{v}" for k, v in
+                          sorted(compiles.items(), key=lambda kv: -kv[1]))
+        print(f"  compiles in window: {total} ({secs:.2f}s): {names}")
+    table = xray.render_op_table(att, top=top)
+    if table:
+        print("  " + table.replace("\n", "\n  "))
+    comm = att.get("comm") or {}
+    if comm.get("ring_vs_recorder") is not None:
+        print(f"  wire-byte cross-check: ring/recorder = "
+              f"{comm['ring_vs_recorder']:.3f} "
+              f"(ring {comm.get('ring_nbytes', 0) / 1e6:.2f} MB vs "
+              f"recorder {comm.get('expected_wire_bytes', 0) / 1e6:.2f} "
+              f"MB over the window)")
+    print()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("dir", nargs="?", default="",
+                    help="directory holding xray_*/xray_summary.json "
+                         "(default: the flight dump dir — "
+                         "TPUNN_FLIGHT_DIR or the tmp fallback)")
+    ap.add_argument("--top", type=int, default=12,
+                    help="rows to show per per-op table")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON object per capture instead of "
+                         "tables")
+    args = ap.parse_args(argv)
+    directory = args.dir or flight.resolve_dump_dir()
+    paths = xray.find_captures(directory)
+    if not paths:
+        print(f"no xray captures under {directory}", file=sys.stderr)
+        return 1
+    for p in paths:
+        try:
+            summary = xray.load_capture(p)
+        except (OSError, json.JSONDecodeError) as e:
+            print(f"unreadable capture {p}: {e}", file=sys.stderr)
+            continue
+        if args.json:
+            print(json.dumps({"path": p, **summary}, sort_keys=True))
+        else:
+            print_capture(p, summary, top=args.top)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -9,12 +9,13 @@ platform with 8 virtual devices and never touches the real chip.
 import os
 import tempfile
 
-# Flight-recorder dumps (obs/flight.py) fall back to CWD when no dir is
-# configured — fine for a production run, but tests that trip dump
-# triggers (watchdog/launch hang tests) must not litter the repo root.
-# Worker processes spawned by launch tests inherit this too; tests that
-# assert on dump locations override it per-test (monkeypatch /
-# LaunchConfig.flight_dir both win over this default).
+# Flight-recorder dumps (obs/flight.py) fall back to a tmp dir when no
+# dir is configured — never the CWD — but tests that trip dump triggers
+# (watchdog/launch hang tests) should still land in one predictable
+# per-session place, not the shared tmp fallback. Worker processes
+# spawned by launch tests inherit this too; tests that assert on dump
+# locations override it per-test (monkeypatch / LaunchConfig.flight_dir
+# both win over this default).
 os.environ.setdefault(
     "TPUNN_FLIGHT_DIR", tempfile.mkdtemp(prefix="tpunn-flight-test-"))
 
@@ -44,6 +45,25 @@ def pytest_configure(config):
         "slow: heavy tests (trace capture, long training) excluded from "
         "the tier-1 `-m 'not slow'` run",
     )
+
+
+@pytest.fixture(autouse=True, scope="session")
+def _no_flight_dumps_in_repo_root():
+    """Regression guard for the flight CWD-fallback bug: a test that
+    tripped a dump trigger with TPUNN_FLIGHT_DIR unset used to leave
+    flight_rank*.json in the repo root (one was committed by accident).
+    The fallback is now a tmp dir; this keeps it that way."""
+    import glob
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    before = set(glob.glob(os.path.join(root, "flight_rank*.json")))
+    assert not before, (
+        f"stale flight dumps in repo root before tests: {sorted(before)}")
+    yield
+    after = set(glob.glob(os.path.join(root, "flight_rank*.json")))
+    assert not after, (
+        f"test run littered flight dumps into the repo root: "
+        f"{sorted(after)} — obs/flight.py must never fall back to CWD")
 
 
 @pytest.fixture(scope="session")

@@ -269,6 +269,85 @@ def test_watchtower_alerts_record_to_flight_ring_first():
         "Watchtower._raise must fan out through _emit"
 
 
+_XRAY = (Path(__file__).parent.parent / "pytorch_distributed_nn_tpu"
+         / "obs" / "xray.py")
+
+
+def test_xray_hooks_are_provably_inert_when_unset():
+    """ISSUE 10 lint: every public ``on_*`` hook in obs/xray.py must
+    open with the literal ``if _xray is None: return`` fast path (the
+    chaos/watchtower contract) — on_step sits in the trainer step loop
+    and on_serve_round in the serving engine's step, so an unset
+    ``TPUNN_XRAY`` must cost one global load + one comparison per
+    hook, nothing more (the --goodput A/B in docs/observability.md
+    depends on this)."""
+    tree = ast.parse(_XRAY.read_text())
+    hooks = [n for n in tree.body if isinstance(n, ast.FunctionDef)
+             and n.name.startswith("on_")]
+    assert len(hooks) >= 4, "expected on_step/on_serve_round/on_page/" \
+                            "on_wire_bytes hooks"
+    for fn in hooks:
+        first = fn.body[0]
+        if isinstance(first, ast.Expr) and isinstance(
+                first.value, ast.Constant):  # docstring
+            first = fn.body[1]
+        ok = (isinstance(first, ast.If)
+              and isinstance(first.test, ast.Compare)
+              and isinstance(first.test.left, ast.Name)
+              and first.test.left.id == "_xray"
+              and len(first.test.ops) == 1
+              and isinstance(first.test.ops[0], ast.Is)
+              and isinstance(first.test.comparators[0], ast.Constant)
+              and first.test.comparators[0].value is None
+              and len(first.body) == 1
+              and isinstance(first.body[0], ast.Return))
+        assert ok, (f"xray.{fn.name} must start with "
+                    f"'if _xray is None: return' (the disabled "
+                    f"fast path)")
+
+
+def test_xray_capture_emits_flight_event_first():
+    """ISSUE 10 lint: ``XrayEngine._capture``'s FIRST statement must be
+    the flight-ring record — if jax.profiler wedges the process, the
+    ring that reaches disk must already say a capture was starting (and
+    where it was going to land)."""
+    tree = ast.parse(_XRAY.read_text())
+    cls = next(n for n in tree.body if isinstance(n, ast.ClassDef)
+               and n.name == "XrayEngine")
+    cap = next(n for n in cls.body if isinstance(n, ast.FunctionDef)
+               and n.name == "_capture")
+    first = cap.body[0]
+    if isinstance(first, ast.Expr) and isinstance(
+            first.value, ast.Constant):  # docstring
+        first = cap.body[1]
+    is_flight_record = (
+        isinstance(first, ast.Expr)
+        and isinstance(first.value, ast.Call)
+        and isinstance(first.value.func, ast.Attribute)
+        and first.value.func.attr == "record"
+        and isinstance(first.value.func.value, ast.Name)
+        and first.value.func.value.id == "flight"
+        and isinstance(first.value.args[0], ast.Constant)
+        and first.value.args[0].value == "xray")
+    assert is_flight_record, (
+        "XrayEngine._capture must call flight.record('xray', ...) FIRST "
+        "— before starting the profiler")
+
+
+def test_bench_ledger_selftest_smoke():
+    """The perf-regression gate's built-in check, run exactly as CI
+    would (fresh interpreter, repo root, no backend needed)."""
+    repo = Path(__file__).parent.parent
+    proc = subprocess.run(
+        [sys.executable, str(repo / "bench.py"), "--ledger",
+         "--selftest"],
+        capture_output=True, text=True, timeout=300, cwd=repo,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr or proc.stdout
+    assert "ledger selftest ok" in proc.stdout
+
+
 def test_obs_doctor_selftest_smoke():
     """The doctor's built-in synthetic-hang check, run exactly as an
     operator would (fresh interpreter, repo root)."""
