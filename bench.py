@@ -141,6 +141,9 @@ _METRIC_NAMES = {
     "quality": "held-out NLL (llama3_8b_zero)",
     "serve": "serving tokens/sec (llama3_8b_zero)",
     "fleet": "fleet serving tokens/sec (llama3_8b_zero)",
+    # higher-is-better on purpose: no latency/seconds substring, so the
+    # ledger (obs.xray.metric_direction) gates a DROP in capacity
+    "capacity": "capacity sustainable req/s (llama3_8b_zero)",
 }
 
 # Nominal GPU-class MFU for the BASELINE configs whose absolute rate
@@ -925,6 +928,235 @@ def bench_fleet(args) -> int:
     return 0
 
 
+_CAPACITY_SPEC = (
+    "diurnal@rps=4:duration_s=6:amplitude=0.5:period_s=6;"
+    "flash@at_s=3:peak=3:ramp_s=1:hold_s=1;"
+    "tenant@name=chat:weight=3:prompt_med=12:prompt_sigma=0.5"
+    ":prompt_max=40:out_med=8:out_sigma=0.4:out_max=16;"
+    "tenant@name=batch:weight=1:prompt=zipf:prompt_a=1.5"
+    ":prompt_max=40:out_med=12:out_max=16")
+
+
+def bench_capacity(args) -> int:
+    """--capacity: the Skyline capacity frontier against a REAL fleet.
+    Sweeps offered-load rungs of one seeded traffic trace
+    (serve/traffic.py) across replica counts, replays each rung into a
+    live Fleet, judges the completion stream with the watchtower's
+    multi-window burn-rate signal (obs/capacity.py — the same pager
+    production uses), and emits max-sustainable-req/s as the benchmark
+    metric, so the --ledger noise band gates capacity regressions like
+    any other series. ``TPUNN_CHAOS`` composes: an armed
+    ``kill_replica@`` fires inside the replica driver mid-rung and the
+    failover window lands in the report."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_nn_tpu.config import get_config
+    from pytorch_distributed_nn_tpu.models import get_model
+    from pytorch_distributed_nn_tpu.obs import capacity
+    from pytorch_distributed_nn_tpu.runtime import chaos
+    from pytorch_distributed_nn_tpu.serve import Fleet, traffic
+    from pytorch_distributed_nn_tpu.serve.engine import _bucket_len
+
+    cfg = get_config("llama3_8b_zero")
+    if args.serve_tiny:
+        cfg.model.extra = dict(num_layers=4, d_model=256, num_heads=8,
+                               num_kv_heads=4, mlp_dim=1024,
+                               vocab_size=1024)
+        cfg.model.compute_dtype = "float32"
+    else:
+        cfg.model.extra = dict(num_layers=8, d_model=1024, num_heads=8,
+                               num_kv_heads=4, mlp_dim=3584,
+                               vocab_size=32000)
+    cfg.model.remat = False
+    model = get_model(cfg.model)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32),
+                        train=False)["params"]
+
+    spec = traffic.parse_spec(args.capacity_spec)
+    rates = tuple(float(r) for r in args.capacity_rates.split(","))
+    replica_counts = tuple(
+        int(n) for n in args.capacity_replicas.split(","))
+    slots = args.per_chip_batch or 4
+    max_seq = 64 if args.serve_tiny else 256
+    seed = 0
+    # warm every prompt bucket any rung will hit, once per fleet
+    lens = {min(_bucket_len(int(r["prompt_len"])), max_seq)
+            for scale in rates
+            for r in traffic.generate_trace(spec, seed=seed,
+                                            rps_scale=scale)}
+    warm_lens = sorted(lens)
+
+    def make_run_rung(replicas: int):
+        def run(trace, duration_s):
+            chaos.reset()
+            chaos.maybe_init()  # TPUNN_CHAOS composes per rung
+            fleet = Fleet(model, params, replicas=replicas,
+                          max_slots=slots, max_seq_len=max_seq,
+                          max_queue=max(len(trace), 8))
+            fleet.start(warmup_prompt_lens=warm_lens)
+            tickets = traffic.replay_trace(
+                trace, lambda p, n: fleet.submit(p, n),
+                vocab_size=model.vocab_size, realtime=True)
+            for t in tickets:
+                t.wait(300.0)
+            fleet.stop()
+            chaos.reset()
+            by_id = {c["request_id"]: c for c in fleet.completed}
+            events = []
+            rejects = 0
+            for rec, ticket in zip(trace, tickets):
+                t_sub = float(rec["t"])
+                comp = by_id.get(ticket.request_id)
+                if ticket.ok and comp is not None:
+                    t_done = t_sub + float(comp["total_s"])
+                    per_tok = ((comp["total_s"] - comp["ttft_s"])
+                               / max(comp["new_tokens"], 1))
+                    events.append({
+                        "ev": "serve_request", "t": t_done, "ok": True,
+                        "request_id": ticket.request_id,
+                        "ttft_s": float(comp["ttft_s"]),
+                        "replica": comp.get("replica", ""),
+                        "new_tokens": int(comp["new_tokens"]),
+                        "failovers": comp.get("failovers", [])})
+                    events.append({"ev": "serve_round", "t": t_done,
+                                   "round": len(events),
+                                   "wall_s": max(per_tok, 0.0)})
+                else:
+                    rejects += 1
+                    events.append({"ev": "serve_reject", "t": t_sub,
+                                   "request_id": ticket.request_id,
+                                   "reason": str(ticket.status)})
+            # the fleet's failover dicts carry readmit latency but no
+            # wall clock; anchor each window to the affected request's
+            # trace arrival — what the capacity report reasons in
+            fos = [(rec, fo) for rec, tk in zip(trace, tickets)
+                   for fo in tk.failovers]
+            for rec, fo in fos:
+                events.append({"ev": "replica_down",
+                               "t": float(rec["t"]),
+                               "replica": fo.get("from_replica", -1),
+                               "reason": fo.get("reason", "failover"),
+                               "stranded": [rec["i"]]})
+            events.sort(key=lambda e: (e["t"], e.get("request_id", "")))
+            toks = sum(e.get("new_tokens", 0) for e in events)
+            window = max([duration_s] + [e["t"] for e in events])
+            wins = [{"replica": fo.get("from_replica", -1),
+                     "t_down": round(float(rec["t"]), 6),
+                     "readmitted": 1,
+                     "t_recovered": round(
+                         float(rec["t"])
+                         + float(fo.get("readmit_s", 0.0)), 6)}
+                    for rec, fo in fos]
+            return {"events": events,
+                    "goodput_tps": round(toks / window, 4),
+                    "offered_rps": round(len(trace) / window, 4),
+                    "requests": len(trace), "rejects": rejects,
+                    "failover_windows": wins}
+        return run
+
+    chaos_spec = os.environ.get(chaos.ENV_CHAOS, "")
+    report = capacity.plan_capacity(
+        spec, replica_counts=replica_counts, rates=rates,
+        make_run_rung=make_run_rung, seed=seed,
+        chaos_spec=chaos_spec or None)
+    if args.capacity_out:
+        with open(args.capacity_out, "w") as f:
+            for ev in capacity.report_events(report):
+                f.write(json.dumps(ev, sort_keys=True) + "\n")
+
+    top = str(max(replica_counts))
+    front = report["sweeps"][top]["frontier"]
+    base = report["sweeps"][str(min(replica_counts))]["frontier"]
+    slo = "interactive"
+    value = front.get(slo) or 0.0
+    backend = jax.default_backend()
+    from pytorch_distributed_nn_tpu.utils.metrics import MetricsLogger
+
+    MetricsLogger(stream=sys.stdout).emit_benchmark(
+        metric=_METRIC_NAMES["capacity"],
+        value=round(value, 3), unit="req/s",
+        vs_baseline=round(value / base[slo], 3)
+        if base.get(slo) else None,
+        vs_baseline_kind=f"frontier_{top}x_over_"
+                         f"{min(replica_counts)}_replica",
+        backend=backend,
+        shape=report["shape"], replicas=int(top),
+        frontier=front,
+        knee_rps=report["sweeps"][top]["knee_rps"],
+        replicas_needed={k: v["replicas"] for k, v in
+                         report["replicas_needed"].items()},
+        chaos=chaos_spec,
+        detail=f"rungs x{args.capacity_rates} of "
+               f"'{report['spec']}', replicas "
+               f"{args.capacity_replicas}, SLO={slo}"
+               + (" [tiny dims]" if args.serve_tiny else "")
+               + (f" [chaos {chaos_spec}]" if chaos_spec else ""),
+    )
+    return 0
+
+
+def _capacity_selftest() -> int:
+    """The Skyline determinism + chaos-drill gate (tier-1 smoke,
+    tests/test_quality.py). No backend, no jax compute: the rungs run
+    the deterministic service model, the judge is the real watchtower.
+    Asserts the acceptance criteria directly: byte-identical trace
+    JSONL, identical capacity report twice, a kill_replica@ drill
+    mid-flash-crowd moves the frontier and names the failover window,
+    and the capacity metric gates higher-is-better in the ledger."""
+    import logging as _logging
+
+    from pytorch_distributed_nn_tpu.obs import capacity, xray
+    from pytorch_distributed_nn_tpu.serve import traffic
+
+    # the burn pager logs loudly by design; the selftest only needs
+    # the verdicts
+    _logging.getLogger(
+        "pytorch_distributed_nn_tpu.obs.watchtower").setLevel(
+        _logging.CRITICAL)
+
+    spec = traffic.parse_spec(_CAPACITY_SPEC)
+    t1 = traffic.generate_trace(spec, seed=7)
+    t2 = traffic.generate_trace(spec, seed=7)
+    assert traffic.trace_to_jsonl(t1) == traffic.trace_to_jsonl(t2), \
+        "trace JSONL not byte-identical for same spec+seed"
+    assert t1 and {r["tenant"] for r in t1} == {"chat", "batch"}, \
+        f"tenant mix missing: {len(t1)} requests"
+
+    kw = dict(replica_counts=(1, 2), rates=(0.5, 1.0, 2.0, 4.0),
+              seed=7)
+    # slots=2/decode_tps=60: tight enough that losing 1 of 2 replicas
+    # actually drops the frontier a rung (not just reshapes the window)
+    plan = lambda kill: capacity.plan_capacity(  # noqa: E731
+        spec, make_run_rung=lambda n: capacity.simulated_run_rung(
+            n, slots=2, decode_tps=60.0, chaos_spec=kill),
+        chaos_spec=kill, **kw)
+    rep_a, rep_b = plan(None), plan(None)
+    assert (capacity.report_to_json(rep_a)
+            == capacity.report_to_json(rep_b)), \
+        "capacity report not identical twice in a row"
+
+    # kill replica 0 mid-flash-crowd (flash holds over t=3..4)
+    kill = "kill_replica@replica=0:after_s=3.5"
+    rep_k = plan(kill)
+    assert (rep_k["sweeps"]["2"]["frontier"]
+            != rep_a["sweeps"]["2"]["frontier"]), \
+        "chaos drill did not move the 2-replica frontier"
+    wins = [w for r in rep_k["sweeps"]["2"]["rungs"]
+            for w in r["failover_windows"]]
+    assert any(w["t_down"] == 3.5 and w["t_recovered"] is not None
+               for w in wins), f"failover window unnamed: {wins}"
+    evs = capacity.report_events(rep_k)
+    assert any(e["event"] == "capacity_frontier" and e["chaos"] == kill
+               for e in evs)
+
+    assert xray.metric_direction(_METRIC_NAMES["capacity"]) == \
+        "higher", "capacity metric must gate higher-is-better"
+    print("capacity selftest ok")
+    return 0
+
+
 def _ledger_selftest() -> int:
     """End-to-end gate check on synthetic trajectories (tier-1 smoke,
     tests/test_quality.py): an in-band series must pass, a regressed
@@ -998,7 +1230,7 @@ def main(argv=None) -> int:
                     choices=sorted(PER_CHIP_BATCH))
     ap.add_argument("--metric", default="throughput",
                     choices=("throughput", "bus_bw", "decode", "loader",
-                             "quality", "serve", "fleet"),
+                             "quality", "serve", "fleet", "capacity"),
                     help="bus_bw: BASELINE's grad-allreduce bus-bandwidth "
                          "metric (use with --preset bert_base_buckets); "
                          "decode: KV-cache generation tokens/s; loader: "
@@ -1006,11 +1238,31 @@ def main(argv=None) -> int:
                          "serve: continuous-batching engine tokens/s vs "
                          "a static-batch baseline under ragged load; "
                          "fleet: N-replica fleet tokens/s scaling vs one "
-                         "replica + p99 TTFT with/without a kill drill")
+                         "replica + p99 TTFT with/without a kill drill; "
+                         "capacity: Skyline frontier — sweep traffic "
+                         "rungs across replica counts, judge each with "
+                         "the watchtower burn-rate signal, emit max "
+                         "sustainable req/s")
     ap.add_argument("--serve", action="store_true",
                     help="shorthand for --metric serve")
     ap.add_argument("--fleet", action="store_true",
                     help="shorthand for --metric fleet")
+    ap.add_argument("--capacity", action="store_true",
+                    help="shorthand for --metric capacity (with "
+                         "--selftest: the no-backend determinism + "
+                         "chaos-drill gate)")
+    ap.add_argument("--capacity-spec", default=_CAPACITY_SPEC,
+                    help="capacity metric: TPUNN_TRAFFIC-grammar "
+                         "traffic shape to sweep")
+    ap.add_argument("--capacity-rates", default="0.5,1,2,4",
+                    help="capacity metric: comma list of rate scales "
+                         "applied to the spec's base rps per rung")
+    ap.add_argument("--capacity-replicas", default="1,2",
+                    help="capacity metric: comma list of fleet replica "
+                         "counts to sweep")
+    ap.add_argument("--capacity-out", default="",
+                    help="capacity metric: also write the report as "
+                         "JSONL events here (obs_report.py --capacity)")
     ap.add_argument("--fleet-replicas", type=int, default=3,
                     help="fleet metric: replica count for the scaling "
                          "and kill-drill runs")
@@ -1100,12 +1352,18 @@ def main(argv=None) -> int:
                          "near-zero MAD on short, quiet histories)")
     ap.add_argument("--selftest", action="store_true",
                     help="--ledger: run the synthetic-trajectory gate "
-                         "check instead of reading real records")
+                         "check instead of reading real records; "
+                         "--capacity: run the no-backend determinism + "
+                         "chaos-drill gate instead of a real fleet sweep")
     args = ap.parse_args(argv)
     if args.serve:
         args.metric = "serve"
     if args.fleet:
         args.metric = "fleet"
+    if args.capacity:
+        args.metric = "capacity"
+    if args.metric == "capacity" and args.selftest:
+        return _capacity_selftest()  # pure: no backend, no probe
     if args.ledger:
         return bench_ledger(args)
 
@@ -1131,6 +1389,8 @@ def main(argv=None) -> int:
         return bench_serve(args)
     if args.metric == "fleet":
         return bench_fleet(args)
+    if args.metric == "capacity":
+        return bench_capacity(args)
 
     import jax
 

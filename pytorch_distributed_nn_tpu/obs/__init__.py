@@ -24,6 +24,13 @@ heartbeats) with:
   detectors over the metric/flight streams raising structured alerts
   (step-time outliers, loss spikes, straggler drift, queue/KV pressure,
   multi-window SLO burn rate), inert unless ``TPUNN_WATCH`` is set;
+- :mod:`obs.capacity` — Skyline capacity frontier (ISSUE 11): sweep
+  :mod:`serve.traffic` offered-load rungs against a fleet (or the
+  deterministic service model), judge each rung with the watchtower's
+  burn-rate signal, and emit the max-sustainable-rate frontier, the
+  goodput-saturation knee, and the "replicas needed per SLO per
+  traffic shape" planning report (``bench.py --capacity``,
+  ``scripts/obs_report.py --capacity``);
 - :mod:`obs.xray` — anomaly-triggered device profiling (ISSUE 10):
   bounded, rate-limited ``jax.profiler`` captures (page/interval/
   on-demand triggers), per-op MFU/roofline attribution, compile
@@ -64,3 +71,15 @@ from pytorch_distributed_nn_tpu.obs.span import (  # noqa: F401
     tracing_enabled,
     write_trace,
 )
+
+
+def __getattr__(name):
+    # capacity pulls in serve/, whose engine imports back through
+    # inference.generate -> obs; an eager import here would leave
+    # generate partially initialized. Resolve it on first attribute
+    # access instead (PEP 562), when both packages are settled.
+    if name == "capacity":
+        import importlib
+        return importlib.import_module(
+            "pytorch_distributed_nn_tpu.obs.capacity")
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

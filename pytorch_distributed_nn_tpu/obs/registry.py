@@ -161,6 +161,33 @@ class Histogram(_Instrument):
         return {"count": state["count"], "sum": state["sum"],
                 "mean": state["sum"] / max(state["count"], 1)}
 
+    def quantile(self, q: float, **labels: object) -> float:
+        """Estimate the q-quantile from bucket state (the Prometheus
+        ``histogram_quantile`` rule): find the cumulative bucket the
+        target rank lands in and interpolate linearly inside it, from
+        its lower bound (0 for the first bucket). Samples in the +Inf
+        overflow bucket clamp to the last finite bound — a histogram
+        can't say more than "beyond my largest bucket". Returns 0.0
+        for an unobserved series, so report code can render a quiet
+        column instead of branching."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"{self.name}: quantile q={q} not in [0,1]")
+        with self._lock:
+            state = self._series.get(self._key(labels))
+            if state is None or state["count"] == 0:
+                return 0.0
+            counts = list(state["counts"])
+            total = state["count"]
+        target = q * total
+        cum, lo = 0, 0.0
+        for bound, n in zip(self.buckets, counts[:-1]):
+            if n and cum + n >= target:
+                frac = max(target - cum, 0.0) / n
+                return lo + frac * (bound - lo)
+            cum += n
+            lo = bound
+        return self.buckets[-1]  # rank falls in the +Inf tail: clamp
+
     def collect(self):
         """Yield exposition rows: (_bucket rows with le=), _sum, _count."""
         with self._lock:

@@ -22,8 +22,12 @@ def percentile(xs: Iterable[float], q: float) -> float:
     """Nearest-rank percentile of ``xs`` at quantile ``q`` in [0, 1]
     (q=0 → min, q=1 → max). Sorts a copy; 0.0 on an empty input (the
     report-table convention: an empty column renders as zero, it does
-    not throw mid-table)."""
-    vals = sorted(float(x) for x in xs)
+    not throw mid-table). NaN observations are dropped before ranking:
+    NaN compares false against everything, so a single contaminated
+    sample would otherwise scramble the sort order and poison every
+    rank — an all-NaN input therefore also renders as zero. ``median``
+    and ``mad`` route through here and inherit both conventions."""
+    vals = sorted(v for v in (float(x) for x in xs) if v == v)
     if not vals:
         return 0.0
     q = min(max(q, 0.0), 1.0)

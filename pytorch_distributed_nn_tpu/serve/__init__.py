@@ -11,6 +11,11 @@ Layering (each module's docstring carries its own contract):
   decode bit-identical to sequential ``inference.generate``;
 - :mod:`serve.server` — thread loopback front-end, SIGTERM drain,
   open/closed-loop synthetic clients;
+- :mod:`serve.traffic` — Skyline trace-driven load generator: seeded
+  diurnal/flash-crowd/heavy-tailed multi-tenant traffic shapes
+  (``TPUNN_TRAFFIC`` chaos-style spec grammar), byte-identical JSONL
+  traces, replay into a server or fleet; the capacity judge lives in
+  :mod:`obs.capacity`;
 - :mod:`serve.router` — fleet placement policy: score READY replicas
   by KV headroom minus queue pressure, one counted choke point;
 - :mod:`serve.fleet` — replica supervisor: N engines behind one
@@ -45,8 +50,19 @@ from pytorch_distributed_nn_tpu.serve.scheduler import (  # noqa: F401
 )
 from pytorch_distributed_nn_tpu.serve.server import (  # noqa: F401
     InferenceServer,
+    arrival_offsets,
     closed_loop_client,
     install_sigterm_drain,
     open_loop_client,
     ragged_prompt_sampler,
 )
+from pytorch_distributed_nn_tpu.serve.traffic import (  # noqa: F401
+    ENV_TRAFFIC,
+    TrafficSpec,
+    generate_trace,
+    load_trace,
+    replay_trace,
+    trace_to_jsonl,
+    write_trace,
+)
+from pytorch_distributed_nn_tpu.serve import traffic  # noqa: F401

@@ -19,9 +19,12 @@ agent classifies the shutdown exactly like a trainer preemption.
 
 Synthetic clients, both canonical load shapes:
 
-- :func:`open_loop_client` — requests arrive on a clock (Poisson-ish
-  fixed rate) regardless of completions: the model of external traffic,
+- :func:`open_loop_client` — requests arrive on their own schedule
+  (a fixed metronome, or seeded exponential gaps — a true Poisson
+  process) regardless of completions: the model of external traffic,
   the one that can actually overload the server (bench.py --serve);
+  richer shapes (diurnal, flash crowds, tenant mixes) live in
+  :mod:`serve.traffic`;
 - :func:`closed_loop_client` — N users, each submits, waits, repeats:
   arrival rate self-throttles to service rate (latency-measurement
   shape, cannot overload).
@@ -29,6 +32,7 @@ Synthetic clients, both canonical load shapes:
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 from typing import Callable, Optional, Sequence
@@ -144,25 +148,51 @@ def ragged_prompt_sampler(vocab_size: int, *, min_len: int = 4,
     return sample
 
 
+def arrival_offsets(num_requests: int, rate_hz: float, *,
+                    arrival: str = "fixed",
+                    seed: int = 0) -> list[float]:
+    """The open-loop submit schedule as offsets from t0 — split out so
+    a determinism test can assert the schedule itself (same seed →
+    identical offsets) without racing wall clocks. ``fixed``: a
+    metronome at ``1/rate_hz``. ``poisson``: seeded exponential
+    inter-arrival gaps (a true Poisson process of the same mean rate —
+    the burstiness real traffic has and the metronome hides)."""
+    if rate_hz <= 0:
+        raise ValueError(f"rate_hz must be > 0, got {rate_hz}")
+    if arrival not in ("fixed", "poisson"):
+        raise ValueError(f"arrival must be 'fixed' or 'poisson', "
+                         f"got {arrival!r}")
+    if arrival == "fixed":
+        return [i / rate_hz for i in range(num_requests)]
+    rng = random.Random(seed)
+    offsets, t = [], 0.0
+    for _ in range(num_requests):
+        offsets.append(t)
+        t += rng.expovariate(rate_hz)
+    return offsets
+
+
 def open_loop_client(server: InferenceServer, *, num_requests: int,
                      rate_hz: float, max_new_tokens: int,
                      prompt_sampler: Callable[[], np.ndarray],
-                     deadline_s: Optional[float] = None
-                     ) -> list[Request]:
-    """Submit ``num_requests`` on a fixed clock (open loop: arrivals do
-    not wait for completions). Returns every Request — including
-    rejected ones; the caller inspects states. Blocks until all
-    terminal."""
-    if rate_hz <= 0:
-        raise ValueError(f"rate_hz must be > 0, got {rate_hz}")
-    period = 1.0 / rate_hz
+                     deadline_s: Optional[float] = None,
+                     arrival: str = "fixed",
+                     seed: int = 0) -> list[Request]:
+    """Submit ``num_requests`` on an open loop (arrivals do not wait
+    for completions). ``arrival="fixed"`` keeps the historical
+    metronome clock; ``arrival="poisson"`` draws seeded exponential
+    inter-arrival gaps via :func:`arrival_offsets`, so the schedule is
+    Poisson in fact — not just "Poisson-ish" — and reproducible per
+    seed. Returns every Request — including rejected ones; the caller
+    inspects states. Blocks until all terminal."""
+    offsets = arrival_offsets(num_requests, rate_hz,
+                              arrival=arrival, seed=seed)
     reqs: list[Request] = []
-    t_next = time.monotonic()
-    for _ in range(num_requests):
-        wait = t_next - time.monotonic()
+    t0 = time.monotonic()
+    for off in offsets:
+        wait = t0 + off - time.monotonic()
         if wait > 0:
             time.sleep(wait)
-        t_next += period
         dl = (time.monotonic() + deadline_s
               ) if deadline_s is not None else None
         reqs.append(server.submit(prompt_sampler(), max_new_tokens,

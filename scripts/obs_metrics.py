@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Metric-name inventory: enumerate every instrument the codebase
+registers and diff against the documented table.
+
+Every counter/gauge/histogram the stack registers goes through the
+:mod:`obs.registry` get-or-create factories, so the full inventory is
+enumerable statically: walk the package AST for
+``.counter("name", ...)`` / ``.gauge("name", ...)`` /
+``.histogram("name", ...)`` calls with a literal name (importing the
+world would need an accelerator and only registers what that process
+touches; the AST sees every call site).
+
+``--check`` diffs that inventory against the "Metric inventory" table
+in ``docs/observability.md`` and exits non-zero on drift in either
+direction — an undocumented metric (someone added an instrument and
+skipped the docs) or a stale doc row (the instrument went away). Wired
+into tier-1 (tests/test_quality.py), so e.g. ``skyline_offered_rps``
+cannot land without its table row.
+
+Usage:
+    python scripts/obs_metrics.py --list
+    python scripts/obs_metrics.py --check
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import pathlib
+import re
+import sys
+
+sys.path.insert(0, ".")  # run from repo root without install
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PACKAGE = REPO / "pytorch_distributed_nn_tpu"
+DOC = REPO / "docs" / "observability.md"
+_FACTORIES = ("counter", "gauge", "histogram")
+
+
+def registered_metrics(package: pathlib.Path = PACKAGE) -> dict:
+    """name -> {kind, files} from every literal registration call
+    site; dynamic (non-literal) names land under the "" key so the
+    checker can say how many it could not follow."""
+    out: dict[str, dict] = {}
+    dynamic = 0
+    for path in sorted(package.rglob("*.py")):
+        try:
+            tree = ast.parse(path.read_text(), filename=str(path))
+        except SyntaxError as e:  # a broken file fails the lint loudly
+            raise SystemExit(f"obs_metrics: cannot parse {path}: {e}")
+        for node in ast.walk(tree):
+            if not (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr in _FACTORIES
+                    and node.args):
+                continue
+            first = node.args[0]
+            if not (isinstance(first, ast.Constant)
+                    and isinstance(first.value, str)):
+                dynamic += 1
+                continue
+            name = first.value
+            rel = str(path.relative_to(REPO))
+            entry = out.setdefault(
+                name, {"kind": node.func.attr, "files": []})
+            if rel not in entry["files"]:
+                entry["files"].append(rel)
+    if dynamic:
+        out[""] = {"kind": "dynamic", "files": [],
+                   "count": dynamic}
+    return out
+
+
+_ROW = re.compile(r"^\|\s*`([a-zA-Z_][a-zA-Z0-9_]*)(?:\{[^`]*\})?`")
+
+
+def documented_metrics(doc: pathlib.Path = DOC) -> set[str]:
+    """Metric names from the docs table: rows of the "Metric
+    inventory" section whose first cell is a backticked name
+    (an optional ``{label,...}`` suffix is part of the cell, not the
+    name)."""
+    names: set[str] = set()
+    in_section = False
+    for line in doc.read_text().splitlines():
+        if line.startswith("#"):
+            in_section = "metric inventory" in line.lower()
+            continue
+        if not in_section:
+            continue
+        m = _ROW.match(line.strip())
+        if m and m.group(1) not in ("metric",):
+            names.add(m.group(1))
+    return names
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--list", action="store_true",
+                    help="print the registered-metric inventory")
+    ap.add_argument("--check", action="store_true",
+                    help="diff inventory vs docs/observability.md "
+                         "'Metric inventory' table; rc=1 on drift")
+    args = ap.parse_args(argv)
+    reg = registered_metrics()
+    dynamic = reg.pop("", None)
+    if args.list or not args.check:
+        for name in sorted(reg):
+            entry = reg[name]
+            print(f"{entry['kind']:>9}  {name:<40} "
+                  f"{', '.join(entry['files'])}")
+        if dynamic:
+            print(f"(+{dynamic['count']} dynamic-name registration(s) "
+                  f"not statically enumerable)")
+        if not args.check:
+            return 0
+    documented = documented_metrics()
+    undocumented = sorted(set(reg) - documented)
+    stale = sorted(documented - set(reg))
+    ok = True
+    if undocumented:
+        ok = False
+        print("UNDOCUMENTED metrics (add rows to the 'Metric "
+              "inventory' table in docs/observability.md):")
+        for name in undocumented:
+            print(f"  {name}  ({', '.join(reg[name]['files'])})")
+    if stale:
+        ok = False
+        print("STALE doc rows (no such registration in the package):")
+        for name in stale:
+            print(f"  {name}")
+    if ok:
+        print(f"metric inventory ok: {len(reg)} registered, "
+              f"{len(documented)} documented")
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -275,6 +275,85 @@ def print_fleet_table(events: list[dict], last: int) -> bool:
     return True
 
 
+def print_capacity_table(events: list[dict], last: int,
+                         requested: bool = False) -> bool:
+    """Skyline capacity-planning section (obs/capacity.py): the
+    offered-load rung table per replica count with each SLO class's
+    verdict, the sustainable frontier + goodput knee, the "replicas
+    needed per SLO" plan line, and — under a chaos drill — the failover
+    windows that moved the frontier. Silently skipped when the file has
+    no ``capacity_*`` events unless ``--capacity`` asked for it."""
+    rungs = [e for e in events if e.get("event") == "capacity_rung"]
+    fronts = [e for e in events
+              if e.get("event") == "capacity_frontier"]
+    plan = next((e for e in reversed(events)
+                 if e.get("event") == "capacity_plan"), None)
+    if not (rungs or fronts or plan):
+        if requested:
+            print("\nno capacity events found (write them with "
+                  "bench.py --capacity --capacity-out FILE)")
+        return False
+
+    print("\n== capacity frontier (Skyline) ==")
+    if plan is not None:
+        line = (f"shape {plan.get('shape', '?')}  "
+                f"seed {int(_num(plan, 'seed'))}")
+        if plan.get("chaos"):
+            line += f"  chaos {plan['chaos']}"
+        print(line)
+        print(f"  spec: {plan.get('spec', '?')}")
+    slo_names = sorted({name for e in rungs
+                        for name in (e.get("slo") or {})})
+    if rungs:
+        print(f"{'replicas':>8} {'offered':>9} {'goodput':>9} "
+              f"{'rej':>5} "
+              + " ".join(f"{n:>16}" for n in slo_names))
+        for e in rungs:  # a sweep is small; truncation hides the knee
+            cells = []
+            for name in slo_names:
+                j = (e.get("slo") or {}).get(name) or {}
+                tag = "ok" if j.get("sustainable") else "BURN"
+                cells.append(f"{tag:>4} "
+                             f"{_fmt_pct(_num(j, 'attainment')).strip():>6}"
+                             f" p{int(_num(j, 'burn_pages')):<3}")
+            print(f"{int(_num(e, 'replicas')):>8} "
+                  f"{_num(e, 'offered_rps'):>9.2f} "
+                  f"{_num(e, 'goodput_tps'):>9.1f} "
+                  f"{int(_num(e, 'rejects')):>5} "
+                  + " ".join(cells))
+    for e in fronts:
+        front = e.get("frontier") or {}
+        parts = [f"{k} {v:.2f} req/s" if v is not None
+                 else f"{k} none" for k, v in sorted(front.items())]
+        knee = e.get("knee_rps")
+        print(f"frontier @{int(_num(e, 'replicas'))} replica(s): "
+              + ", ".join(parts)
+              + (f"  (goodput knee {knee:.2f} rps)"
+                 if knee is not None else "  (no saturation knee)"))
+    wins = [(int(_num(e, "replicas")), w) for e in rungs
+            for w in (e.get("failover_windows") or [])]
+    if wins:
+        print(f"failover windows (chaos drill): {len(wins)}")
+        for n, w in wins[-last:]:
+            rec = w.get("t_recovered")
+            print(f"  @{n} replica(s): replica "
+                  f"{int(_num(w, 'replica', -1))} down "
+                  f"t={_num(w, 't_down'):.2f}s, "
+                  f"{int(_num(w, 'readmitted'))} re-admitted, "
+                  + (f"recovered t={rec:.2f}s" if rec is not None
+                     else "no re-admissions to recover"))
+    if plan is not None:
+        needed = plan.get("replicas_needed") or {}
+        for name in sorted(needed):
+            d = needed[name] or {}
+            n = d.get("replicas")
+            print(f"replicas needed [{name}] for "
+                  f"{_num(d, 'target_rps'):.2f} req/s: "
+                  + (str(int(n)) if n is not None
+                     else "none swept suffices"))
+    return True
+
+
 def print_xray_table(xray_dir: str | None, last: int) -> bool:
     """Xray section: per-op attribution from anomaly-triggered
     ``obs.xray`` captures under ``--xray DIR``. Silently skipped when
@@ -315,6 +394,10 @@ def main(argv=None) -> int:
     ap.add_argument("--xray", default="",
                     help="directory holding obs.xray capture dirs "
                          "(xray_*/xray_summary.json) to render")
+    ap.add_argument("--capacity", action="store_true",
+                    help="insist on the Skyline capacity section "
+                         "(noisy when the file has no capacity_* "
+                         "events; auto-rendered when it does)")
     ap.add_argument("--last", type=int, default=5,
                     help="windows/rows to show per table")
     args = ap.parse_args(argv)
@@ -329,15 +412,19 @@ def main(argv=None) -> int:
     has_serve = any(e.get("event") in
                     ("serve_request", "serve_summary", "fleet_state",
                      "fleet_replica_down", "fleet_failover",
-                     "fleet_reload")
+                     "fleet_reload", "capacity_rung",
+                     "capacity_frontier", "capacity_plan")
                     for e in events)
     ok = print_goodput_table(events, args.last, quiet=has_serve)
     print_comms_table(events, args.trace or None)
     serve_ok = print_serving_table(events, args.last)
     fleet_ok = print_fleet_table(events, args.last)
+    cap_ok = print_capacity_table(events, args.last,
+                                  requested=args.capacity)
     xray_ok = print_xray_table(args.xray or None, args.last)
     print_metric_tail(events, args.last)
-    return 0 if (ok or serve_ok or fleet_ok or xray_ok) else 1
+    return 0 if (ok or serve_ok or fleet_ok or cap_ok
+                 or xray_ok) else 1
 
 
 if __name__ == "__main__":

@@ -348,6 +348,37 @@ def test_bench_ledger_selftest_smoke():
     assert "ledger selftest ok" in proc.stdout
 
 
+def test_bench_capacity_selftest_smoke():
+    """The Skyline determinism + chaos-drill gate, run exactly as CI
+    would (fresh interpreter, repo root, no backend needed): asserts
+    byte-identical traces, identical capacity reports twice, and a
+    kill_replica@ drill moving the frontier."""
+    repo = Path(__file__).parent.parent
+    proc = subprocess.run(
+        [sys.executable, str(repo / "bench.py"), "--capacity",
+         "--selftest"],
+        capture_output=True, text=True, timeout=300, cwd=repo,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr or proc.stdout
+    assert "capacity selftest ok" in proc.stdout
+
+
+def test_metric_inventory_matches_docs():
+    """Every registered metric name has a row in the 'Metric inventory'
+    table of docs/observability.md and vice versa — an instrument
+    cannot land (or vanish) without its documentation moving too."""
+    repo = Path(__file__).parent.parent
+    proc = subprocess.run(
+        [sys.executable, str(repo / "scripts" / "obs_metrics.py"),
+         "--check"],
+        capture_output=True, text=True, timeout=120, cwd=repo,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "metric inventory ok" in proc.stdout
+
+
 def test_obs_doctor_selftest_smoke():
     """The doctor's built-in synthetic-hang check, run exactly as an
     operator would (fresh interpreter, repo root)."""
