@@ -144,6 +144,9 @@ _METRIC_NAMES = {
     # higher-is-better on purpose: no latency/seconds substring, so the
     # ledger (obs.xray.metric_direction) gates a DROP in capacity
     "capacity": "capacity sustainable req/s (llama3_8b_zero)",
+    # likewise higher-is-better: the ledger gates a DROP in attainment
+    # under closed-loop control (serve/autoscale.py)
+    "autoscale": "autoscale slo-attainment (llama3_8b_zero)",
 }
 
 # Nominal GPU-class MFU for the BASELINE configs whose absolute rate
@@ -1157,6 +1160,298 @@ def _capacity_selftest() -> int:
     return 0
 
 
+# a longer diurnal than _CAPACITY_SPEC with a flash crowd mid-window:
+# Helm needs room for a full scale-up -> hold -> scale-down cycle
+_AUTOSCALE_SPEC = (
+    "diurnal@rps=6:duration_s=30:amplitude=0.3:period_s=30;"
+    "flash@at_s=8:peak=5:ramp_s=2:hold_s=6;"
+    "tenant@name=chat:weight=3:prompt_med=12:prompt_sigma=0.5"
+    ":prompt_max=40:out_med=8:out_sigma=0.4:out_max=16;"
+    "tenant@name=batch:weight=1:prompt=zipf:prompt_a=1.5"
+    ":prompt_max=40:out_med=12:out_max=16")
+
+# policy + burn windows scaled so a real-time replay of
+# _AUTOSCALE_SPEC exercises the whole loop in under a minute; both are
+# overridable (--autoscale-spec / TPUNN_AUTOSCALE, TPUNN_WATCH)
+_AUTOSCALE_POLICY = (
+    "min_replicas=1:max_replicas=4:up_consecutive=2:down_consecutive=3"
+    ":cooldown_up_s=2:cooldown_down_s=6:eval_interval_s=1")
+_AUTOSCALE_WATCH = ("ttft_slo_s=0.5:burn_fast_s=4:burn_slow_s=16"
+                    ":burn_min_events=5")
+
+
+def bench_autoscale(args) -> int:
+    """--autoscale: the Helm closed loop against a REAL fleet. Replays
+    one seeded diurnal+flash trace (serve/traffic.py) into a live
+    Fleet while serve/autoscale.py grows and shrinks it from the
+    watchtower burn signal + router pressure gauges, then emits SLO
+    attainment under closed-loop control as the benchmark metric so
+    the --ledger noise band gates it like any other series.
+    ``TPUNN_CHAOS`` composes: an armed ``kill_replica@`` fires
+    mid-trace and Helm has to replace the capacity."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_nn_tpu.config import get_config
+    from pytorch_distributed_nn_tpu.models import get_model
+    from pytorch_distributed_nn_tpu.obs import capacity, watchtower
+    from pytorch_distributed_nn_tpu.runtime import chaos
+    from pytorch_distributed_nn_tpu.serve import (
+        Fleet,
+        autoscale,
+        traffic,
+    )
+    from pytorch_distributed_nn_tpu.serve.engine import _bucket_len
+
+    cfg = get_config("llama3_8b_zero")
+    if args.serve_tiny:
+        cfg.model.extra = dict(num_layers=4, d_model=256, num_heads=8,
+                               num_kv_heads=4, mlp_dim=1024,
+                               vocab_size=1024)
+        cfg.model.compute_dtype = "float32"
+    else:
+        cfg.model.extra = dict(num_layers=8, d_model=1024, num_heads=8,
+                               num_kv_heads=4, mlp_dim=3584,
+                               vocab_size=32000)
+    cfg.model.remat = False
+    model = get_model(cfg.model)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32),
+                        train=False)["params"]
+
+    spec = traffic.parse_spec(args.autoscale_traffic)
+    trace = traffic.generate_trace(spec, seed=0)
+    slots = args.per_chip_batch or 4
+    max_seq = 64 if args.serve_tiny else 256
+    lens = {min(_bucket_len(int(r["prompt_len"])), max_seq)
+            for r in trace}
+    warm_lens = sorted(lens)
+
+    # Skyline forecast (deterministic service model): Helm's scale-down
+    # floor and the convergence reference the ledger record carries
+    plan = capacity.plan_capacity(
+        spec, replica_counts=(1, 2, 3, 4), rates=(0.5, 1.0, 1.5),
+        make_run_rung=lambda n: capacity.simulated_run_rung(
+            n, slots=slots),
+        seed=0)
+    needed = (plan["replicas_needed"].get("interactive")
+              or {}).get("replicas")
+
+    watch_spec = (os.environ.get(watchtower.ENV_WATCH, "")
+                  or _AUTOSCALE_WATCH)
+    watchtower.reset()
+    watchtower.maybe_init(watch_spec)
+    chaos.reset()
+    chaos.maybe_init()  # TPUNN_CHAOS composes mid-trace
+    chaos_spec = os.environ.get(chaos.ENV_CHAOS, "")
+
+    helm_spec = (args.autoscale_spec
+                 or os.environ.get(autoscale.ENV_AUTOSCALE, "")
+                 or _AUTOSCALE_POLICY)
+    acfg = autoscale.parse_spec(helm_spec)
+    fleet = Fleet(model, params, replicas=acfg.min_replicas,
+                  max_slots=slots, max_seq_len=max_seq,
+                  max_queue=max(len(trace), 8))
+    fleet.start(warmup_prompt_lens=warm_lens)
+    autoscale.reset()
+    armed = autoscale.maybe_init(helm_spec, fleet=fleet,
+                                 forecast_replicas=needed)
+    assert armed, "autoscale.maybe_init refused a non-empty spec"
+    helm = autoscale.helm()
+
+    tickets = traffic.replay_trace(
+        trace, lambda p, n: fleet.submit(p, n),
+        vocab_size=model.vocab_size, realtime=True,
+        on_tick=lambda t: helm.step())
+    for t in tickets:
+        t.wait(300.0)
+    # drain tail: keep evaluating with the load gone so the scale-down
+    # half of the loop runs before we stop the fleet
+    tail_s = min(
+        acfg.cooldown_down_s
+        + (acfg.down_consecutive + 2) * acfg.eval_interval_s, 60.0)
+    t_end = time.monotonic() + tail_s
+    while time.monotonic() < t_end:
+        helm.step()
+        time.sleep(max(min(acfg.eval_interval_s / 2, 0.25), 0.05))
+    final_target = fleet.target_replicas
+    decisions = list(helm.scaler.decisions)
+    summary = helm.scaler.summary()
+    journal = helm.scaler.journal_jsonl()
+    fleet.stop()
+    chaos.reset()
+    autoscale.reset()
+
+    if args.autoscale_out:
+        with open(args.autoscale_out, "w") as f:
+            for line in journal.splitlines():
+                rec = json.loads(line)
+                f.write(json.dumps({"event": "autoscale_decision",
+                                    **rec}, sort_keys=True) + "\n")
+
+    slo = capacity.DEFAULT_SLOS[0]  # interactive
+    by_id = {c["request_id"]: c for c in fleet.completed}
+    done = [by_id[t.request_id] for t in tickets
+            if t.ok and t.request_id in by_id]
+    rejects = sum(1 for t in tickets if not t.ok)
+    within = sum(1 for c in done
+                 if float(c["ttft_s"]) <= slo.ttft_s)
+    att = within / max(len(trace), 1)
+    ups = sum(1 for d in decisions
+              if d.action == autoscale.SCALE_UP)
+    downs = sum(1 for d in decisions
+                if d.action == autoscale.SCALE_DOWN)
+    backend = jax.default_backend()
+    from pytorch_distributed_nn_tpu.utils.metrics import MetricsLogger
+
+    MetricsLogger(stream=sys.stdout).emit_benchmark(
+        metric=_METRIC_NAMES["autoscale"],
+        value=round(att, 4), unit="frac_within_slo",
+        vs_baseline=None,
+        backend=backend,
+        policy=helm_spec, traffic=spec.describe(),
+        forecast_replicas=needed, final_target=final_target,
+        converged=(abs(final_target - needed) <= 1
+                   if needed else None),
+        decisions=summary["decisions"], scale_ups=ups,
+        scale_downs=downs, rejects=rejects,
+        completed=len(done), chaos=chaos_spec,
+        detail=f"closed loop over '{spec.describe()}', policy "
+               f"'{helm_spec}', SLO={slo.name}"
+               + (" [tiny dims]" if args.serve_tiny else "")
+               + (f" [chaos {chaos_spec}]" if chaos_spec else ""),
+    )
+    return 0
+
+
+def _autoscale_selftest() -> int:
+    """The Helm determinism + closed-loop gate (tier-1 smoke,
+    tests/test_quality.py). No backend: the trace replays through the
+    deterministic service model (obs.capacity.simulate_autoscaled_
+    fleet), the burn signal is the real watchtower, the decisions are
+    the real serve/autoscale.py policy. Asserts the acceptance
+    criteria directly: byte-identical decision journal twice, the
+    first scale-up names its pressure evidence and lands no later
+    than the sustained-burn page, every journal line replays
+    standalone to the same verdict, zero rejects, steady state within
+    ±1 of the Skyline forecast, a kill_replica@ mid-spike is absorbed
+    with the failover window named, and the autoscale metric gates
+    higher-is-better in the ledger."""
+    import logging as _logging
+
+    from pytorch_distributed_nn_tpu.obs import (
+        capacity,
+        watchtower,
+        xray,
+    )
+    from pytorch_distributed_nn_tpu.serve import autoscale, traffic
+
+    # the pager and the scaler both log loudly by design; the selftest
+    # only needs the verdicts
+    for name in ("pytorch_distributed_nn_tpu.obs.watchtower",
+                 "pytorch_distributed_nn_tpu.serve.autoscale"):
+        _logging.getLogger(name).setLevel(_logging.CRITICAL)
+
+    spec = traffic.parse_spec(_AUTOSCALE_SPEC)
+    trace = traffic.generate_trace(spec, seed=7)
+    # service model tight enough that the flash crowd actually burns
+    svc = dict(slots=2, prefill_tps=400.0, decode_tps=30.0,
+               max_wait_s=3.0)
+
+    plan = capacity.plan_capacity(
+        spec, replica_counts=(1, 2, 3, 4, 5, 6),
+        rates=(0.5, 1.0, 1.5, 2.0),
+        make_run_rung=lambda n: capacity.simulated_run_rung(n, **svc),
+        seed=7)
+    needed = (plan["replicas_needed"].get("interactive")
+              or {}).get("replicas")
+    assert needed, \
+        f"forecast found no sustainable count: {plan['replicas_needed']}"
+
+    policy = ("min_replicas=1:max_replicas=6:up_consecutive=2"
+              ":down_consecutive=4:cooldown_up_s=2:cooldown_down_s=6"
+              ":eval_interval_s=1")
+    wcfg = watchtower.WatchConfig(
+        ttft_slo_s=0.25, token_slo_s=0.1, burn_fast_s=4.0,
+        burn_slow_s=16.0, burn_threshold=2.0, burn_min_events=5)
+
+    def run(kill=None):
+        tower = watchtower.Watchtower(wcfg, dump_on_page=False)
+        scaler = autoscale.Autoscaler(
+            autoscale.parse_spec(policy), tower=tower,
+            feed_tower=True, forecast_replicas=needed, spec=policy)
+        ctl = autoscale.SimController(scaler, target=1)
+        rep = capacity.simulate_autoscaled_fleet(
+            trace, controller=ctl, replicas=1, warmup_s=0.25,
+            tick_s=0.5, duration_s=30.0, tail_s=30.0,
+            chaos_spec=kill, **svc)
+        return scaler, tower, rep
+
+    s1, tw1, r1 = run()
+    s2, _, r2 = run()
+    j1 = s1.journal_jsonl()
+    assert j1 and j1 == s2.journal_jsonl(), \
+        "decision journal not byte-identical twice in a row"
+    assert (json.dumps(r1, sort_keys=True)
+            == json.dumps(r2, sort_keys=True)), \
+        "autoscaled-fleet report not identical twice in a row"
+
+    ups = [d for d in s1.decisions
+           if d.action == autoscale.SCALE_UP]
+    downs = [d for d in s1.decisions
+             if d.action == autoscale.SCALE_DOWN]
+    assert ups and downs, \
+        f"no full cycle: ups={len(ups)} downs={len(downs)}"
+    assert any(tag in ups[0].reason
+               for tag in ("burn", "queue", "kv")), \
+        f"first scale-up names no pressure evidence: {ups[0].reason}"
+    assert ups[0].t < downs[0].t, "scale-down preceded scale-up"
+    # the loop must keep pace with the pager: Helm's burn_up (1.0x)
+    # undercuts the pager's threshold (2.0x), so the first scale-up
+    # lands within one fast window of the first page, and once the
+    # last scale-up settles the page condition is extinguished for
+    # good — the pager re-arms and stays quiet
+    pages = [a for a in tw1.alerts if a.kind == "slo_burn_rate"
+             and a.severity == watchtower.PAGE]
+    if pages:
+        assert ups[0].t <= pages[0].t + wcfg.burn_fast_s, \
+            f"Helm scaled at t={ups[0].t}, more than one fast window " \
+            f"after the page at t={pages[0].t}"
+        assert max(a.t for a in pages) <= ups[-1].t + wcfg.burn_slow_s, \
+            f"pages kept firing after Helm settled: " \
+            f"{[round(a.t, 3) for a in pages]} vs last scale-up " \
+            f"t={ups[-1].t}"
+    # every journal line replays standalone to the same verdict
+    for rec in (json.loads(line) for line in j1.splitlines()):
+        assert autoscale.replay_decision(rec) == (
+            rec["action"], rec["reason"], rec["to_replicas"]), \
+            f"journal line does not replay: {rec['seq']}"
+    assert r1["rejects"] == 0, \
+        f"rejects under closed-loop control: {r1['rejects']}"
+    assert abs(r1["final_target"] - needed) <= 1, \
+        f"steady state {r1['final_target']} vs forecast {needed}"
+
+    # kill a replica mid-flash-crowd (flash holds over t=8..16); Helm
+    # must absorb it: window named, still zero rejects, still converges
+    kill = "kill_replica@replica=0:after_s=10"
+    sk, _, rk = run(kill)
+    wins = rk["failover_windows"]
+    assert any(w["replica"] == 0 and w["t_down"] == 10.0
+               and w.get("t_recovered") is not None
+               for w in wins), f"failover window unnamed: {wins}"
+    assert rk["rejects"] == 0, \
+        f"rejects during the kill drill: {rk['rejects']}"
+    assert abs(rk["final_target"] - needed) <= 1, \
+        f"no reconvergence after kill: {rk['final_target']}"
+    assert sk.journal_jsonl() != j1, \
+        "kill drill left no trace in the decision journal"
+
+    assert xray.metric_direction(_METRIC_NAMES["autoscale"]) == \
+        "higher", "autoscale metric must gate higher-is-better"
+    print("autoscale selftest ok")
+    return 0
+
+
 def _ledger_selftest() -> int:
     """End-to-end gate check on synthetic trajectories (tier-1 smoke,
     tests/test_quality.py): an in-band series must pass, a regressed
@@ -1230,7 +1525,8 @@ def main(argv=None) -> int:
                     choices=sorted(PER_CHIP_BATCH))
     ap.add_argument("--metric", default="throughput",
                     choices=("throughput", "bus_bw", "decode", "loader",
-                             "quality", "serve", "fleet", "capacity"),
+                             "quality", "serve", "fleet", "capacity",
+                             "autoscale"),
                     help="bus_bw: BASELINE's grad-allreduce bus-bandwidth "
                          "metric (use with --preset bert_base_buckets); "
                          "decode: KV-cache generation tokens/s; loader: "
@@ -1242,7 +1538,10 @@ def main(argv=None) -> int:
                          "capacity: Skyline frontier — sweep traffic "
                          "rungs across replica counts, judge each with "
                          "the watchtower burn-rate signal, emit max "
-                         "sustainable req/s")
+                         "sustainable req/s; autoscale: Helm closed "
+                         "loop — replay a diurnal+flash trace into a "
+                         "live fleet under the burn-rate autoscaler, "
+                         "emit SLO attainment")
     ap.add_argument("--serve", action="store_true",
                     help="shorthand for --metric serve")
     ap.add_argument("--fleet", action="store_true",
@@ -1263,6 +1562,21 @@ def main(argv=None) -> int:
     ap.add_argument("--capacity-out", default="",
                     help="capacity metric: also write the report as "
                          "JSONL events here (obs_report.py --capacity)")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="shorthand for --metric autoscale (with "
+                         "--selftest: the no-backend Helm determinism "
+                         "+ closed-loop gate)")
+    ap.add_argument("--autoscale-spec", default="",
+                    help="autoscale metric: TPUNN_AUTOSCALE-grammar "
+                         "policy (falls back to the env var, then a "
+                         "bench-scaled default)")
+    ap.add_argument("--autoscale-traffic", default=_AUTOSCALE_SPEC,
+                    help="autoscale metric: TPUNN_TRAFFIC-grammar "
+                         "traffic shape to replay through the loop")
+    ap.add_argument("--autoscale-out", default="",
+                    help="autoscale metric: also write the decision "
+                         "journal as JSONL events here (obs_report.py "
+                         "--autoscale, obs_watch.py --autoscale)")
     ap.add_argument("--fleet-replicas", type=int, default=3,
                     help="fleet metric: replica count for the scaling "
                          "and kill-drill runs")
@@ -1354,7 +1668,9 @@ def main(argv=None) -> int:
                     help="--ledger: run the synthetic-trajectory gate "
                          "check instead of reading real records; "
                          "--capacity: run the no-backend determinism + "
-                         "chaos-drill gate instead of a real fleet sweep")
+                         "chaos-drill gate instead of a real fleet "
+                         "sweep; --autoscale: run the no-backend Helm "
+                         "closed-loop gate instead of a live replay")
     args = ap.parse_args(argv)
     if args.serve:
         args.metric = "serve"
@@ -1362,8 +1678,12 @@ def main(argv=None) -> int:
         args.metric = "fleet"
     if args.capacity:
         args.metric = "capacity"
+    if args.autoscale:
+        args.metric = "autoscale"
     if args.metric == "capacity" and args.selftest:
         return _capacity_selftest()  # pure: no backend, no probe
+    if args.metric == "autoscale" and args.selftest:
+        return _autoscale_selftest()  # pure: no backend, no probe
     if args.ledger:
         return bench_ledger(args)
 
@@ -1391,6 +1711,8 @@ def main(argv=None) -> int:
         return bench_fleet(args)
     if args.metric == "capacity":
         return bench_capacity(args)
+    if args.metric == "autoscale":
+        return bench_autoscale(args)
 
     import jax
 

@@ -88,6 +88,14 @@ class RankDump:
         return [e for e in self.events if e.get("kind") == "xray"]
 
     @property
+    def autoscale_events(self) -> list[dict]:
+        """Helm decisions (serve/autoscale.py) that landed before the
+        dump — emit-first means every scale_up/scale_down/hold is in
+        the ring, so a post-mortem sees what the autoscaler did (and
+        why) around the incident window."""
+        return [e for e in self.events if e.get("kind") == "autoscale"]
+
+    @property
     def fleet_events(self) -> list[dict]:
         """Replica-fleet lifecycle (serve/fleet.py): state changes,
         replica_down, re-admissions, reloads. A fleet failover dump is

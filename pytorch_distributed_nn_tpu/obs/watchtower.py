@@ -586,6 +586,27 @@ class Watchtower:
         elif slo in self._burn_active and fast < cfg.burn_threshold:
             self._burn_active.discard(slo)  # re-arm after recovery
 
+    def burn_rates(self, now: float) -> dict:
+        """Exported burn-rate accessor (the Helm autoscaler's input,
+        serve/autoscale.py): per-SLO fast/slow burn at event time
+        ``now``, computed by the very windows :meth:`_check_burn` pages
+        from — the autoscaler and the pager can never disagree about
+        how hard the error budget is burning. Pure in the observed
+        event stream (no wall clock), so replaying a recorded run
+        reproduces the exact evidence every decision journaled."""
+        cfg = self.cfg
+        return {
+            slo: {
+                "fast": round(bw.burn(cfg.burn_fast_s, now,
+                                      min_events=cfg.burn_min_events),
+                              6),
+                "slow": round(bw.burn(cfg.burn_slow_s, now,
+                                      min_events=cfg.burn_min_events),
+                              6),
+            }
+            for slo, bw in sorted(self._burns.items())
+        }
+
     # -- registry subscription -------------------------------------------
 
     def poll_registry(self, t: float, registry=None) -> None:

@@ -20,12 +20,26 @@ Layering (each module's docstring carries its own contract):
   by KV headroom minus queue pressure, one counted choke point;
 - :mod:`serve.fleet` — replica supervisor: N engines behind one
   admission point, heartbeat failure detection, chaos-tested failover
-  with in-flight re-admission, rolling zero-reject weight reload.
+  with in-flight re-admission, rolling zero-reject weight reload,
+  elastic ``scale_to`` with a warm-before-READY join gate;
+- :mod:`serve.autoscale` — Helm: the SLO burn-rate autoscaler closing
+  the watchtower → fleet loop (``TPUNN_AUTOSCALE`` spec grammar,
+  explainable ``autoscale_decision`` journal, hysteresis/cooldowns,
+  Skyline-forecast scale-down floor).
 
 CLI: ``scripts/serve.py``; load test: ``bench.py --serve`` /
 ``bench.py --fleet``; docs: ``docs/serving.md``.
 """
 
+from pytorch_distributed_nn_tpu.serve.autoscale import (  # noqa: F401
+    ENV_AUTOSCALE,
+    AutoscaleConfig,
+    Autoscaler,
+    Decision,
+    FleetAutoscaler,
+    SimController,
+)
+from pytorch_distributed_nn_tpu.serve import autoscale  # noqa: F401
 from pytorch_distributed_nn_tpu.serve.engine import (  # noqa: F401
     ServingEngine,
 )

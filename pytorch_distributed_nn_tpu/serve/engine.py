@@ -50,6 +50,7 @@ from pytorch_distributed_nn_tpu.inference.generate import (
 )
 from pytorch_distributed_nn_tpu.obs import flight, watchtower, xray
 from pytorch_distributed_nn_tpu.runtime import chaos
+from pytorch_distributed_nn_tpu.serve import autoscale
 from pytorch_distributed_nn_tpu.serve.kv_pool import KVPool
 from pytorch_distributed_nn_tpu.serve.scheduler import Request, Scheduler
 
@@ -233,6 +234,13 @@ class ServingEngine:
         # watchtower feed (token-latency SLO + queue/KV pressure):
         # here, NOT in _decode_round — its hot-loop lint bans extras
         watchtower.on_serve_round(
+            sched.round, dt, queue_depth=sched.queue_depth,
+            queue_max=sched.max_queue,
+            kv_free=sched.pool.free_blocks,
+            kv_total=sched.pool.num_blocks)
+        # helm feed (instantaneous queue/KV between control ticks);
+        # inert one-comparison no-op unless TPUNN_AUTOSCALE armed it
+        autoscale.on_serve_round(
             sched.round, dt, queue_depth=sched.queue_depth,
             queue_max=sched.max_queue,
             kv_free=sched.pool.free_blocks,

@@ -164,6 +164,11 @@ class _ReplicaWorker:
         self.exit_reason: Optional[str] = None  # ok | preempt | crash
         self.exit_code: Optional[int] = None
         self.error: Optional[BaseException] = None
+        # set on the first progress beat from inside the loop: the
+        # join gate's proof the driver thread is actually pumping (the
+        # reporter's constructor beat is synchronous in the spawning
+        # thread and proves nothing about this one)
+        self.progressed = threading.Event()
         self._stop = threading.Event()
         self._preempt = threading.Event()
         self._thread = threading.Thread(
@@ -203,6 +208,8 @@ class _ReplicaWorker:
                 if self._stop.is_set():
                     break  # declared dead while hung: hands off
                 self.reporter.notify_progress()
+                if not self.progressed.is_set():
+                    self.progressed.set()
                 if self._preempt.is_set() and not self.engine.has_work:
                     code, reason = failure.GRACEFUL_EXIT_CODE, "preempt"
                     break
@@ -232,6 +239,12 @@ class ReplicaHandle:
     incarnations: int = 0
     restart_at: Optional[float] = None
     stop_reason: str = ""
+    # join gate (live fleets): a replica entering mid-traffic stays
+    # STARTING — invisible to the router — until its warmup jits are
+    # compiled AND its worker has beaten progress from inside the loop
+    warm_done: bool = True
+    # scale-down: draining toward removal; reaped by poll() once empty
+    retiring: bool = False
 
 
 class Fleet:
@@ -262,6 +275,10 @@ class Fleet:
             block_size=block_size, max_queue=max_queue,
             max_prefills_per_round=max_prefills_per_round)
         self._hb_interval = heartbeat_interval_s
+        self._hb_timeout = heartbeat_timeout_s
+        self._policy_kw = dict(
+            max_restarts=max_restarts, window_s=restart_window_s,
+            backoff_base_s=backoff_base_s, backoff_max_s=backoff_max_s)
         self._progress_window = (progress_window_s
                                  if progress_window_s is not None
                                  else max(heartbeat_timeout_s / 2,
@@ -283,17 +300,13 @@ class Fleet:
             labels=("state",))
         self._replicas: list[ReplicaHandle] = []
         for i in range(replicas):
-            h = ReplicaHandle(
-                index=i, name=f"r{i}",
-                policy=RestartPolicy(
-                    max_restarts=max_restarts,
-                    window_s=restart_window_s,
-                    backoff_base_s=backoff_base_s,
-                    backoff_max_s=backoff_max_s, seed=i))
+            h = self._new_handle(i)
             self._replicas.append(h)
             self._set_state(h, STARTING, reason="init")
             self._spawn(h, params)
             self._set_state(h, READY, reason="up")
+        self._target_replicas = replicas
+        self._next_index = replicas
         self._started = False
         self._sup_stop = threading.Event()
         self._sup_thread: Optional[threading.Thread] = None
@@ -315,6 +328,20 @@ class Fleet:
 
     # -- replica lifecycle -------------------------------------------------
 
+    def _new_handle(self, index: int) -> ReplicaHandle:
+        return ReplicaHandle(
+            index=index, name=f"r{index}",
+            policy=RestartPolicy(seed=index, **self._policy_kw))
+
+    def _rebuild_detector(self) -> None:
+        """Point the failure detector at the current membership.
+        Replica indexes are never reused (``_next_index`` is monotonic)
+        so a retired slot's stale heartbeat keys can't alias a newer
+        replica's."""
+        self._detector = failure.FailureDetector(
+            self._store, ranks=[h.index for h in self._replicas],
+            incarnation=0, timeout_s=self._hb_timeout)
+
     def _spawn(self, h: ReplicaHandle, params) -> None:
         """Fresh engine + heartbeat + worker for one replica slot (first
         start, post-crash restart, or post-reload rejoin)."""
@@ -332,7 +359,46 @@ class Fleet:
         if getattr(self, "_started", False):
             h.worker.start()
 
-    def warmup(self, prompt_lens=(8,)) -> None:
+    def _admit_joining(self, h: ReplicaHandle, *,
+                       reason: str) -> None:
+        """Bring a freshly spawned replica into the routable set. On a
+        stopped fleet that is immediate (``run_until_idle`` drives the
+        engine directly; there is no cold compile to misread as a
+        hang). On a live fleet the replica stays STARTING — the router
+        never places on it — until the join gate opens: its warmup jits
+        compiled (a background warm thread; the jit cache is keyed on
+        the model, so an already-warm fleet passes in microseconds) AND
+        its worker has beaten progress from inside the driver loop.
+        :meth:`_promote_joining` flips it READY on a later poll."""
+        if not self._started:
+            h.warm_done = True
+            self._set_state(h, READY, reason=reason)
+            return
+        h.warm_done = False
+        engine = h.engine
+
+        def _warm() -> None:
+            try:
+                self.warmup(engine=engine)
+            except Exception:
+                # open the gate anyway: a genuinely broken replica
+                # surfaces through the normal crash/staleness paths
+                log.exception("fleet: warmup for %s failed", h.name)
+            h.warm_done = True
+
+        threading.Thread(target=_warm, name=f"fleet-warm-{h.name}",
+                         daemon=True).start()
+
+    def _promote_joining(self) -> None:
+        """Open the join gate: STARTING replicas whose warmup finished
+        and whose worker proved liveness become READY (routable)."""
+        for h in self._replicas:
+            if (h.state == STARTING and h.warm_done
+                    and not h.retiring and h.worker is not None
+                    and h.worker.progressed.is_set()):
+                self._set_state(h, READY, reason="join:warm+beat")
+
+    def warmup(self, prompt_lens=(8,), *, engine=None) -> None:
         """Compile the serve jits (prefill per prompt bucket, row
         insert, the batched decode step) before any worker thread
         runs them. Without this, the first decode on a cold process
@@ -349,7 +415,7 @@ class Fleet:
             _serve_step,
         )
         import jax.numpy as jnp
-        eng = self._replicas[0].engine
+        eng = engine if engine is not None else self._replicas[0].engine
         max_slots = eng.max_slots
         cache = _fresh_cache(self.model, max_slots, eng.max_seq_len)
         for plen in prompt_lens:
@@ -494,6 +560,8 @@ class Fleet:
             self._check_exits()
             self._check_stale()
             self._restart_due()
+            self._promote_joining()
+            self._reap_retiring()
             self._finalize_tickets()
 
     def _check_exits(self) -> None:
@@ -512,8 +580,9 @@ class Fleet:
                  and h.worker.alive and h.worker.exit_reason is None}
         if not alive:
             return
+        by_index = {h.index: h for h in self._replicas}
         for idx in self._detector.stale_ranks(alive=alive):
-            self._fail_replica(self._replicas[idx], kind="hang",
+            self._fail_replica(by_index[idx], kind="hang",
                                reason="hang:heartbeat_stale")
 
     def _fail_replica(self, h: ReplicaHandle, *, kind: str,
@@ -618,12 +687,13 @@ class Fleet:
     def _restart_due(self) -> None:
         now = time.monotonic()
         for h in self._replicas:
-            if (h.state == DEAD and h.restart_at is not None
+            if (h.state == DEAD and not h.retiring
+                    and h.restart_at is not None
                     and now >= h.restart_at):
                 self._set_state(h, STARTING,
                                 reason=f"restart #{h.incarnations}")
                 self._spawn(h, self.params)
-                self._set_state(h, READY, reason="up")
+                self._admit_joining(h, reason="up")
 
     def _finalize_tickets(self) -> None:
         for ticket in list(self._journal.values()):
@@ -720,6 +790,102 @@ class Fleet:
                               skipped_dead=skipped)
         return dict(replicas_rolled=rolled, skipped_dead=skipped)
 
+    # -- elastic scaling ---------------------------------------------------
+
+    def scale_to(self, n: int, *, reason: str = "") -> dict:
+        """Resize the replica set to ``n`` slots — the Helm
+        autoscaler's actuator (:mod:`serve.autoscale`), equally usable
+        by hand.
+
+        Scale **up** appends fresh slots (monotonic indexes, never
+        reused) and admits each through the join gate: on a live fleet
+        a joiner stays STARTING — unroutable — until its warmup jits
+        compile and its worker beats progress, so a cold compile can
+        never read as a hang or swallow a routed request. Scale
+        **down** retires the highest-index non-retiring slots through
+        the reload-style graceful drain: DRAINING (the router stops
+        placing immediately), the worker finishes everything the
+        engine holds and exits ``GRACEFUL_EXIT_CODE``, and a later
+        :meth:`poll` reaps the empty slot — this path never calls
+        ``scheduler.drain()``, so scaling down rejects nothing, ever.
+
+        Retiring slots no longer count toward the fleet's size intent,
+        so ``scale_to(2)`` on a 4-replica fleet followed by
+        ``scale_to(3)`` before the drains finish adds one fresh slot
+        rather than resurrecting a draining one (a drain in flight is
+        not cancellable without racing its worker's exit).
+
+        Returns ``{target, added, retiring}``."""
+        n = int(n)
+        if n < 1:
+            raise ValueError(f"scale_to: n must be >= 1, got {n}")
+        with self._lock:
+            current = [h for h in self._replicas if not h.retiring]
+            delta = n - len(current)
+            added, retiring = 0, 0
+            if delta > 0:
+                for _ in range(delta):
+                    h = self._new_handle(self._next_index)
+                    self._next_index += 1
+                    self._replicas.append(h)
+                    self._set_state(h, STARTING, reason="scale_up")
+                    self._spawn(h, self.params)
+                    self._admit_joining(h, reason="scale_up")
+                    added += 1
+                self._rebuild_detector()
+            elif delta < 0:
+                doomed = sorted(current, key=lambda r: -r.index)
+                for h in doomed[:-delta]:
+                    h.retiring = True
+                    h.restart_at = None  # a dead slot stays down
+                    if h.state != DEAD:
+                        self._set_state(h, DRAINING,
+                                        reason="scale_down")
+                    if h.worker is not None and h.worker.alive:
+                        h.worker.request_preempt()
+                    retiring += 1
+            self._target_replicas = n
+            flight.record(
+                "fleet", "scale_to",
+                note=f"target={n} added={added} retiring={retiring}"
+                     + (f" {reason}" if reason else ""))
+            if self.metrics is not None:
+                self.metrics.emit("fleet_scale", target=n, added=added,
+                                  retiring=retiring, reason=reason)
+            # idle retirees on a synchronous fleet reap right here
+            self._reap_retiring()
+        return dict(target=n, added=added, retiring=retiring)
+
+    def _reap_retiring(self) -> None:
+        """Release retired slots whose drain completed: worker exited
+        (gracefully — or, on a synchronous fleet, the engine emptied
+        under ``run_until_idle``), policy credited as a preemption,
+        heartbeat released, handle dropped from the books. Membership
+        changed ⇒ the failure detector is rebuilt."""
+        done = []
+        for h in self._replicas:
+            if not h.retiring:
+                continue
+            if h.state != DEAD:
+                if h.worker is not None and h.worker.alive:
+                    continue  # still draining
+                if h.engine is not None and h.engine.has_work:
+                    continue  # synchronous fleet: still being stepped
+            done.append(h)
+        if not done:
+            return
+        for h in done:
+            if h.worker is not None and h.state != DEAD:
+                h.policy.on_exit(
+                    reason="preempt", code=failure.GRACEFUL_EXIT_CODE,
+                    duration_s=time.monotonic() - h.worker.started_at,
+                    beat_seen=True)
+            if h.reporter is not None:
+                h.reporter.stop()
+            self._replicas.remove(h)
+            flight.record("fleet", "retired", note=h.name)
+        self._rebuild_detector()
+
     # -- introspection -----------------------------------------------------
 
     @property
@@ -729,6 +895,12 @@ class Fleet:
     @property
     def live_replicas(self) -> int:
         return sum(1 for h in self._replicas if h.state == READY)
+
+    @property
+    def target_replicas(self) -> int:
+        """The size intent (last ``scale_to`` target, or the
+        constructed size) — what the fleet is converging toward."""
+        return self._target_replicas
 
     def summary(self) -> dict:
         """Fleet-lifetime aggregates (bench + fleet_summary JSONL)."""

@@ -50,6 +50,38 @@ DEAD = "dead"
 REPLICA_STATES = (STARTING, READY, DRAINING, RELOADING, DEAD)
 
 
+def fleet_pressure(replicas) -> dict:
+    """Aggregate placement pressure over the READY replica set — the
+    Helm autoscaler's queue/KV evidence (:mod:`serve.autoscale`).
+
+    Returns ``{"queue_frac", "kv_free_frac", "ready"}`` where the
+    fractions are fleet-wide (summed depths over summed capacities),
+    not per-replica averages: one drowning replica in a fleet of idle
+    ones is real headroom for the router, and the aggregate reflects
+    that. Reads the same scheduler/pool gauges :meth:`Router._score`
+    does, but computes the raw fractions directly — it is evidence for
+    the decision journal, not a placement decision, so it stays outside
+    the ``place``-only scoring choke point."""
+    queue_depth = queue_cap = 0
+    kv_free = kv_total = 0
+    ready = 0
+    for handle in replicas:
+        if handle.state != READY:
+            continue
+        ready += 1
+        sched = handle.engine.scheduler
+        pool = sched.pool
+        queue_depth += sched.queue_depth
+        queue_cap += sched.max_queue
+        kv_free += pool.free_blocks
+        kv_total += pool.num_blocks
+    return {
+        "queue_frac": queue_depth / max(queue_cap, 1),
+        "kv_free_frac": kv_free / max(kv_total, 1) if ready else 0.0,
+        "ready": ready,
+    }
+
+
 class Router:
     """Scores replicas and picks one; one counted choke point."""
 

@@ -79,6 +79,14 @@ def _analyze(paths_or_dir, expect_ranks: int | None, last: int,
             # names the dead replica and the requests it stranded; None
             # for non-fleet runs so existing consumers see no new noise
             "fleet": forensics.fleet_summary(dumps),
+            # Helm decisions (serve/autoscale.py) in the ring before
+            # the dump — op is the action, the note carries reason +
+            # replica trajectory; {} for runs without TPUNN_AUTOSCALE
+            "autoscale": {
+                str(r): [{"action": e.get("op"),
+                          "note": e.get("note")}
+                         for e in d.autoscale_events]
+                for r, d in dumps.items() if d.autoscale_events},
             # profiler captures (obs/xray.py) that fired before the
             # dump — the landing dir per rank, so a post-mortem can go
             # straight from the incident to the device trace covering

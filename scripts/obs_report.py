@@ -354,6 +354,61 @@ def print_capacity_table(events: list[dict], last: int,
     return True
 
 
+def print_autoscale_table(events: list[dict], last: int,
+                          requested: bool = False) -> bool:
+    """Helm section (serve/autoscale.py): the replica trajectory as
+    the autoscaler steered it — every scale_up/scale_down with the
+    journaled evidence that drove it (per-window burns, queue/KV
+    fractions, forecast floor), plus the hold-reason tally. Silently
+    skipped when the file has no ``autoscale_decision`` events unless
+    ``--autoscale`` asked for it."""
+    decs = [e for e in events
+            if e.get("event") == "autoscale_decision"]
+    if not decs:
+        if requested:
+            print("\nno autoscale decisions found (write them with "
+                  "bench.py --autoscale --autoscale-out FILE)")
+        return False
+
+    print("\n== autoscale decisions (Helm) ==")
+    lastd = decs[-1]
+    ev = lastd.get("evidence") or {}
+    print(f"policy: {lastd.get('spec', '?')}")
+    fc = ev.get("forecast_replicas")
+    print(f"decisions {len(decs)}, final target "
+          f"{int(_num(lastd, 'to_replicas'))}"
+          + (f", Skyline forecast {int(fc)}" if fc is not None
+             else ", no Skyline forecast"))
+    holds: dict[str, int] = {}
+    actions = []
+    for d in decs:
+        if d.get("action") == "hold":
+            r = str(d.get("reason", "?"))
+            holds[r] = holds.get(r, 0) + 1
+        else:
+            actions.append(d)
+    if holds:
+        print("holds: " + ", ".join(
+            f"{k} x{v}" for k, v in sorted(holds.items())))
+    if actions:
+        print(f"{'t':>10} {'action':>10} {'replicas':>9} "
+              f"{'burn f/s':>11} {'queue':>6} {'kv':>5}  reason")
+        for d in actions:  # a trajectory is small; holds are tallied
+            e = d.get("evidence") or {}
+            burns = (e.get("burn") or {}).get("ttft") or {}
+            print(f"{_num(d, 't'):>10.2f} {d.get('action', '?'):>10} "
+                  f"{int(_num(d, 'from_replicas')):>4}->"
+                  f"{int(_num(d, 'to_replicas')):<4} "
+                  f"{_num(burns, 'fast'):>5.2f}/"
+                  f"{_num(burns, 'slow'):<5.2f} "
+                  f"{_fmt_pct(_num(e, 'queue_frac')).strip():>6} "
+                  f"{_fmt_pct(_num(e, 'kv_free_frac')).strip():>5}"
+                  f"  {d.get('reason', '?')}")
+    else:
+        print("no scale actions (steady)")
+    return True
+
+
 def print_xray_table(xray_dir: str | None, last: int) -> bool:
     """Xray section: per-op attribution from anomaly-triggered
     ``obs.xray`` captures under ``--xray DIR``. Silently skipped when
@@ -398,6 +453,11 @@ def main(argv=None) -> int:
                     help="insist on the Skyline capacity section "
                          "(noisy when the file has no capacity_* "
                          "events; auto-rendered when it does)")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="insist on the Helm autoscale section "
+                         "(noisy when the file has no "
+                         "autoscale_decision events; auto-rendered "
+                         "when it does)")
     ap.add_argument("--last", type=int, default=5,
                     help="windows/rows to show per table")
     args = ap.parse_args(argv)
@@ -413,7 +473,8 @@ def main(argv=None) -> int:
                     ("serve_request", "serve_summary", "fleet_state",
                      "fleet_replica_down", "fleet_failover",
                      "fleet_reload", "capacity_rung",
-                     "capacity_frontier", "capacity_plan")
+                     "capacity_frontier", "capacity_plan",
+                     "autoscale_decision")
                     for e in events)
     ok = print_goodput_table(events, args.last, quiet=has_serve)
     print_comms_table(events, args.trace or None)
@@ -421,9 +482,11 @@ def main(argv=None) -> int:
     fleet_ok = print_fleet_table(events, args.last)
     cap_ok = print_capacity_table(events, args.last,
                                   requested=args.capacity)
+    helm_ok = print_autoscale_table(events, args.last,
+                                    requested=args.autoscale)
     xray_ok = print_xray_table(args.xray or None, args.last)
     print_metric_tail(events, args.last)
-    return 0 if (ok or serve_ok or fleet_ok or cap_ok
+    return 0 if (ok or serve_ok or fleet_ok or cap_ok or helm_ok
                  or xray_ok) else 1
 
 

@@ -13,11 +13,21 @@ Two modes over the same engine (:mod:`obs.watchtower`):
   alerts as they fire — the "watch the run" view for a job writing
   ``--metrics-out`` on the same host.
 
+A third mode audits Helm instead of the detectors:
+
+- **--autoscale**: shadow-replay a recorded decision journal
+  (``bench.py --autoscale --autoscale-out``) through the REAL policy:
+  every ``autoscale_decision`` record carries its spec, evidence, and
+  pre-decision state, so :func:`serve.autoscale.replay_decision`
+  re-derives the verdict standalone and any divergence from what the
+  journal claims exits 1 — the "would Helm do that again?" audit.
+
 Usage:
     python scripts/obs_watch.py runs/metrics.jsonl
     python scripts/obs_watch.py runs/metrics.jsonl --follow
     python scripts/obs_watch.py runs/metrics.jsonl \
         --spec ttft_slo_s=0.25:burn_threshold=4 --json
+    python scripts/obs_watch.py runs/helm.jsonl --autoscale
 """
 
 from __future__ import annotations
@@ -91,6 +101,62 @@ def _feed(tower: "watchtower.Watchtower", line: str,
         print(alert.as_json() if as_json else _render_alert(alert))
 
 
+def _shadow_replay_autoscale(path: str, as_json: bool) -> int:
+    """--autoscale: re-run every journaled decision through the real
+    policy and diff the verdicts. Each record is self-contained (spec
+    + evidence + pre-decision state), so no fleet, tower, or ordering
+    is needed — a tampered or stale journal diverges record by record."""
+    from pytorch_distributed_nn_tpu.serve import autoscale
+
+    try:
+        f = open(path)
+    except OSError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    total = diverged = 0
+    with f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn tail line from a live writer
+            if rec.get("event", "autoscale_decision") \
+                    != "autoscale_decision":
+                continue
+            total += 1
+            want = (rec.get("action"), rec.get("reason"),
+                    rec.get("to_replicas"))
+            try:
+                got = autoscale.replay_decision(rec)
+            except (KeyError, TypeError, ValueError) as e:
+                got = ("unreplayable", str(e), None)
+            ok = got == want
+            diverged += not ok
+            if as_json:
+                print(json.dumps(
+                    {"seq": rec.get("seq"), "t": rec.get("t"),
+                     "journaled": list(want), "replayed": list(got),
+                     "ok": ok}, sort_keys=True))
+            elif not ok:
+                print(f"DIVERGED seq={rec.get('seq')} "
+                      f"t={rec.get('t')}: journal says "
+                      f"{want[0]}->{want[2]} ({want[1]}), policy "
+                      f"says {got[0]}->{got[2]} ({got[1]})")
+    verdict = {"decisions": total, "diverged": diverged,
+               "ok": diverged == 0 and total > 0}
+    if as_json:
+        print(json.dumps({"autoscale_shadow": verdict},
+                         sort_keys=True))
+    else:
+        print(f"\n== autoscale shadow replay ==\n  {total} decisions "
+              f"re-derived, {diverged} diverged"
+              + ("" if total else " (no autoscale_decision records)"))
+    return 0 if verdict["ok"] else 1
+
+
 def main() -> int:
     ap = argparse.ArgumentParser(
         description="replay/tail a metrics JSONL through the watchtower")
@@ -105,7 +171,14 @@ def main() -> int:
     ap.add_argument("--json", action="store_true",
                     help="machine-readable output (alert JSON lines + "
                          "one summary object)")
+    ap.add_argument("--autoscale", action="store_true",
+                    help="shadow-replay a recorded Helm decision "
+                         "journal through the real policy; exit 1 on "
+                         "any divergence")
     args = ap.parse_args()
+
+    if args.autoscale:
+        return _shadow_replay_autoscale(args.metrics, args.json)
 
     tower = watchtower.Watchtower(watchtower.parse_spec(args.spec),
                                   dump_on_page=False)
