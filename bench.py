@@ -141,6 +141,10 @@ _METRIC_NAMES = {
     "quality": "held-out NLL (llama3_8b_zero)",
     "serve": "serving tokens/sec (llama3_8b_zero)",
     "fleet": "fleet serving tokens/sec (llama3_8b_zero)",
+    # its own ledger series: subprocess replicas over the native store
+    # (serve/procfleet.py) at CI-scale dims — mixing it into the
+    # thread-fleet band would false-alarm whichever mode ran last
+    "fleet_procs": "process-fleet serving tokens/sec (tiny)",
     # higher-is-better on purpose: no latency/seconds substring, so the
     # ledger (obs.xray.metric_direction) gates a DROP in capacity
     "capacity": "capacity sustainable req/s (llama3_8b_zero)",
@@ -817,6 +821,97 @@ def bench_serve(args) -> int:
     return 0
 
 
+def _bench_fleet_procs(args) -> int:
+    """--fleet --fleet-procs N: the deployment-shaped fleet — every
+    replica a real subprocess running the CI-scale tiny engine
+    (serve/fleet_worker.py), supervised over the real native store by
+    serve/procfleet.py. Same shape as the thread-fleet record:
+    ``vs_baseline`` is N processes over 1, plus p99 TTFT with and
+    without a cross-process kill drill (stranded requests re-admitted
+    over the wire with their emitted prefix). Its own ledger series —
+    the store round-trips and process isolation are exactly what this
+    number must keep honest."""
+    import numpy as np
+
+    from pytorch_distributed_nn_tpu.serve import ragged_prompt_sampler
+    from pytorch_distributed_nn_tpu.serve.procfleet import ProcessFleet
+
+    slots = args.per_chip_batch or 4
+    n_rep = max(args.fleet_procs, 2)
+    n_req = max(args.serve_requests, slots * n_rep)
+    max_seq = 64
+    budget_cycle = (2, 8, 32)
+    budgets = [budget_cycle[i % len(budget_cycle)]
+               for i in range(n_req)]
+    sampler = ragged_prompt_sampler(
+        1024, min_len=4, max_len=max_seq - max(budget_cycle) - 1,
+        seed=0)
+    prompts = [sampler() for _ in range(n_req)]
+    period = 1.0 / args.serve_rate if args.serve_rate > 0 else 0.0
+
+    def run(replicas: int, kill: str | None):
+        extra = {"TPUNN_CHAOS": kill or ""}
+        fleet = ProcessFleet(
+            replicas=replicas, backend="tiny", max_slots=slots,
+            max_queue=n_req, max_seq_len=max_seq,
+            heartbeat_interval_s=0.1, heartbeat_timeout_s=10.0,
+            worker_extra_env=extra)
+        fleet.start()
+        fleet.wait_ready(replicas, timeout=300.0)
+        t0 = time.perf_counter()
+        t_next = t0
+        tickets = []
+        for p, n in zip(prompts, budgets):
+            wait = t_next - time.perf_counter()
+            if wait > 0:
+                time.sleep(wait)
+            t_next += period
+            tickets.append(fleet.submit(p, n))
+        for t in tickets:
+            t.wait(300.0)
+        wall = time.perf_counter() - t0
+        done = list(fleet.completed)
+        failovers = fleet.failovers
+        fleet.stop()
+        toks = sum(c["new_tokens"] for c in done)
+        ttfts = np.array([c["ttft_s"] for c in done
+                          if c["ttft_s"] >= 0.0])
+        return dict(tps=toks / wall, ttfts=ttfts,
+                    completed=len(done), failovers=failovers)
+
+    single = run(1, None)
+    steady = run(n_rep, None)
+    chaotic = run(n_rep, "kill_replica@replica=1:step=30")
+
+    def p99(xs):
+        return float(np.percentile(xs, 99)) if len(xs) else 0.0
+
+    import jax
+
+    from pytorch_distributed_nn_tpu.utils.metrics import MetricsLogger
+
+    MetricsLogger(stream=sys.stdout).emit_benchmark(
+        metric=_METRIC_NAMES["fleet_procs"],
+        value=round(steady["tps"], 1), unit="tokens/sec",
+        vs_baseline=round(steady["tps"] / single["tps"], 3),
+        vs_baseline_kind=f"procfleet_{n_rep}x_over_single_process",
+        backend=jax.default_backend(),
+        replicas=n_rep, requests=n_req,
+        completed=steady["completed"],
+        single_tokens_per_s=round(single["tps"], 1),
+        ttft_p99_ms=round(p99(steady["ttfts"]) * 1e3, 2),
+        ttft_p99_with_kill_ms=round(p99(chaotic["ttfts"]) * 1e3, 2),
+        kill_tokens_per_s=round(chaotic["tps"], 1),
+        kill_completed=chaotic["completed"],
+        kill_failovers=chaotic["failovers"],
+        detail=f"open-loop {args.serve_rate:g} req/s, {n_req} ragged "
+               f"requests, {slots} slots/replica, {n_rep} subprocess "
+               f"replicas vs 1 over the native store; kill drill: "
+               f"kill_replica@replica=1:step=30",
+    )
+    return 0
+
+
 def bench_fleet(args) -> int:
     """Replica-fleet serving (serve/fleet.py): the SAME open-loop
     ragged workload through 1 replica and through N replicas behind
@@ -827,6 +922,8 @@ def bench_fleet(args) -> int:
     their emitted prefix, and the record carries p99 TTFT with and
     without the kill — the failover tax the paper's robustness story
     must bound (acceptance: < 2x the steady-state p99)."""
+    if args.fleet_procs:
+        return _bench_fleet_procs(args)
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -1452,6 +1549,132 @@ def _autoscale_selftest() -> int:
     return 0
 
 
+def _fleet_selftest() -> int:
+    """--fleet --selftest: the coordinator crash-recovery drill. No
+    backend in THIS process — replicas are stub subprocesses
+    (serve/fleet_worker.py) over a REAL native store. Asserts the
+    process-fleet invariants end to end:
+
+    1. a chaos ``kill_coordinator`` leaves the workers serving;
+    2. the successor adopts them pid-for-pid — no cold restart;
+    3. every in-flight request finishes bit-identical to the stub
+       reference (stitched across the gap, zero duplicate tokens);
+    4. Helm's journal CONTINUES across the boundary — seq contiguous,
+       state chained through the deterministic policy (so the
+       successor converges to the same replicas_needed), the
+       ``coordinator_incarnation`` field marking where it fell — and
+       the concatenated journal shadow-replays clean through
+       ``scripts/obs_watch.py --autoscale``;
+    5. obs forensics names the supervision gap."""
+    import tempfile
+
+    from pytorch_distributed_nn_tpu.obs import flight, forensics
+    from pytorch_distributed_nn_tpu.runtime import chaos
+    from pytorch_distributed_nn_tpu.serve import autoscale
+    from pytorch_distributed_nn_tpu.serve.procfleet import ProcessFleet
+    from pytorch_distributed_nn_tpu.serve.stub import stub_decode
+
+    spec = ("eval_interval_s=0.1:up_consecutive=2:cooldown_up_s=0.3:"
+            "max_replicas=3:queue_up=0.25")
+    chaos.reset()
+    f1 = ProcessFleet(replicas=2, backend="stub",
+                      heartbeat_interval_s=0.05,
+                      heartbeat_timeout_s=2.0, token_ms=6.0,
+                      autoscale_spec=spec)
+    f1.start()
+    assert f1.wait_ready(2, timeout=120), "workers never joined"
+    prompts = [[31 + i, 7, 2] for i in range(10)]
+    tickets = [f1.submit(p, 64) for p in prompts]
+    deadline = time.monotonic() + 30
+    while len(f1.helm_journal) == 0 and time.monotonic() < deadline:
+        time.sleep(0.05)
+    assert len(f1.helm_journal) > 0, "no pre-kill Helm decision"
+    # kill the coordinator mid-flash-crowd (armed only now, so the
+    # workers' multi-second join can't outrun the fuse)
+    chaos.maybe_init("kill_coordinator@after_s=0.05", rank=0, seed=0)
+    deadline = time.monotonic() + 30
+    while not f1.dead and time.monotonic() < deadline:
+        time.sleep(0.02)
+    assert f1.dead, "chaos kill_coordinator never fired"
+    pids = {h.index: h.pid for h in f1.replicas
+            if h.state in ("ready", "draining")}
+    helm_pre = len(f1.helm_journal)
+    time.sleep(0.8)  # the unsupervised gap: workers keep decoding
+
+    f2 = ProcessFleet.recover_from(
+        store_endpoint=f1.store_endpoint,
+        heartbeat_interval_s=0.05, heartbeat_timeout_s=2.0,
+        token_ms=6.0, autoscale_spec=spec)
+    assert f2.incarnation == f1.incarnation + 1, \
+        (f1.incarnation, f2.incarnation)
+    assert f2.gap_s > 0, "no supervision gap measured"
+    adopted = {h.index: h.pid for h in f2.replicas if h.adopted}
+    assert adopted and all(pids.get(i) == p
+                           for i, p in adopted.items()), \
+        f"adoption restarted live workers: {pids} -> {adopted}"
+    f2.start()
+    assert f2.wait_all(list(f2.recovered_tickets.values()),
+                       timeout=120), "recovered requests never finished"
+    for p, t0 in zip(prompts, tickets):
+        t = f2.recovered_tickets[t0.request_id]
+        got = list(t.tokens) if t.tokens is not None else None
+        assert got == stub_decode(p, 64), \
+            f"stitched output diverged for {t.request_id}"
+        assert len(got) == 64, \
+            f"duplicate/missing tokens for {t.request_id}: {len(got)}"
+
+    deadline = time.monotonic() + 30
+    while (len(f2.helm_journal) <= helm_pre
+           and time.monotonic() < deadline):
+        time.sleep(0.05)
+    lines = f2.helm_journal.read_lines()
+    recs = [json.loads(ln) for ln in lines]
+    assert len(recs) > helm_pre, "recovered Helm never journaled"
+    assert [r["seq"] for r in recs] == list(range(len(recs))), \
+        "journal seq forked across the restart"
+    incs = [r["coordinator_incarnation"] for r in recs]
+    assert incs == sorted(incs) and \
+        sorted(set(incs)) == [f1.incarnation, f2.incarnation], incs
+    boundary = incs.index(f2.incarnation)
+    pre, post = recs[boundary - 1], recs[boundary]
+    _, _, _, want_state = autoscale.decide(
+        autoscale.parse_spec(pre["spec"]), pre["evidence"],
+        pre["state"], float(pre["t"]))
+    assert post["state"] == want_state, \
+        "successor's first decision does not chain off the " \
+        "predecessor's post-state"
+
+    with tempfile.TemporaryDirectory(prefix="tpunn-fleet-") as td:
+        jpath = os.path.join(td, "helm.jsonl")
+        with open(jpath, "w") as fh:
+            fh.write("\n".join(lines) + "\n")
+        watch = subprocess.run(
+            [sys.executable,
+             os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                          "scripts", "obs_watch.py"),
+             jpath, "--autoscale"],
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            capture_output=True, text=True, timeout=300)
+        assert watch.returncode == 0, \
+            f"obs_watch --autoscale rejected the concatenated " \
+            f"journal:\n{watch.stdout}\n{watch.stderr}"
+
+    att = forensics.attribute(flight.get_recorder().snapshot())
+    assert att.get("coordinator_gap_s", 0.0) > 0, \
+        f"forensics did not name the coordinator gap: {att}"
+
+    f2.stop()
+    try:
+        f1._client.close()
+    except OSError:
+        pass
+    if f1._server is not None:
+        f1._server.stop()
+    chaos.reset()
+    print("fleet selftest ok")
+    return 0
+
+
 def _ledger_selftest() -> int:
     """End-to-end gate check on synthetic trajectories (tier-1 smoke,
     tests/test_quality.py): an in-band series must pass, a regressed
@@ -1580,6 +1803,13 @@ def main(argv=None) -> int:
     ap.add_argument("--fleet-replicas", type=int, default=3,
                     help="fleet metric: replica count for the scaling "
                          "and kill-drill runs")
+    ap.add_argument("--fleet-procs", type=int, default=0,
+                    help="fleet metric: run the PROCESS-backed fleet "
+                         "instead — this many replica subprocesses "
+                         "(CI-scale tiny engine each) over the real "
+                         "native store, supervised by "
+                         "serve/procfleet.py; same record shape, its "
+                         "own ledger series")
     ap.add_argument("--serve-requests", type=int, default=24,
                     help="serve metric: synthetic requests in the timed "
                          "open-loop run")
@@ -1684,6 +1914,10 @@ def main(argv=None) -> int:
         return _capacity_selftest()  # pure: no backend, no probe
     if args.metric == "autoscale" and args.selftest:
         return _autoscale_selftest()  # pure: no backend, no probe
+    if args.metric == "fleet" and args.selftest:
+        # no backend in this process: stub subprocess workers over a
+        # real native store — the coordinator-restart drill
+        return _fleet_selftest()
     if args.ledger:
         return bench_ledger(args)
 

@@ -87,6 +87,53 @@ class LaunchConfig:
     env: dict[str, str] = dataclasses.field(default_factory=dict)
 
 
+def worker_env(*, rank: int, local_rank: int | None = None,
+               world_size: int = 1, master_addr: str = "127.0.0.1",
+               master_port: int | None = None, incarnation: int = 0,
+               heartbeat_interval_s: float | None = None,
+               progress_timeout_s: float | None = None,
+               store_host: str = "127.0.0.1",
+               store_port: int | None = None,
+               flight_dir: str | None = None,
+               extra: dict[str, str] | None = None) -> dict[str, str]:
+    """The agent↔worker environment contract, in ONE place: both the
+    JAX-native (``PROCESS_ID``/``NUM_PROCESSES``/``COORDINATOR_ADDRESS``)
+    and torch-style (``RANK``/``WORLD_SIZE``/``MASTER_*``) rank vars,
+    plus the ``TPUNN_*`` heartbeat/restart/flight contract
+    (:mod:`runtime.failure`). Used by :class:`ElasticAgent` for training
+    gangs and by :class:`serve.procfleet.ProcessFleet` for serving
+    replica workers — one contract, two supervisors."""
+    env = dict(os.environ)
+    if extra:
+        env.update(extra)
+    env.update(
+        RANK=str(rank),
+        LOCAL_RANK=str(rank if local_rank is None else local_rank),
+        WORLD_SIZE=str(world_size),
+        PROCESS_ID=str(rank),
+        NUM_PROCESSES=str(world_size),
+    )
+    if master_port is not None:
+        env.update(
+            MASTER_ADDR=master_addr,
+            MASTER_PORT=str(master_port),
+            COORDINATOR_ADDRESS=f"{master_addr}:{master_port}",
+        )
+    env[failure.ENV_RESTART] = str(incarnation)
+    if heartbeat_interval_s is not None:
+        env[failure.ENV_HB_INTERVAL] = str(heartbeat_interval_s)
+    if progress_timeout_s is not None:
+        env[failure.ENV_PROGRESS_WINDOW] = str(progress_timeout_s)
+    if flight_dir is not None:
+        from pytorch_distributed_nn_tpu.obs import flight as _fl
+
+        env[_fl.ENV_FLIGHT_DIR] = str(flight_dir)
+    if store_port is not None:
+        env[failure.ENV_STORE_PORT] = str(store_port)
+        env[failure.ENV_STORE_HOST] = store_host
+    return env
+
+
 @dataclasses.dataclass
 class IncarnationRecord:
     """One gang incarnation's outcome (LaunchResult.incarnations)."""
@@ -293,31 +340,18 @@ class ElasticAgent:
         base = cfg.nprocs * cfg.node_rank
         for local_rank in range(cfg.nprocs):
             rank = base + local_rank
-            env = dict(os.environ)
-            env.update(cfg.env)
-            env.update(
-                RANK=str(rank),
-                LOCAL_RANK=str(local_rank),
-                WORLD_SIZE=str(world),
-                MASTER_ADDR=cfg.master_addr,
-                MASTER_PORT=str(port),
-                PROCESS_ID=str(rank),
-                NUM_PROCESSES=str(world),
-                COORDINATOR_ADDRESS=f"{cfg.master_addr}:{port}",
-            )
-            env[failure.ENV_RESTART] = str(incarnation)
-            env[failure.ENV_HB_INTERVAL] = str(cfg.heartbeat_interval_s)
-            if cfg.flight_dir is not None:
-                from pytorch_distributed_nn_tpu.obs import flight as _fl
-
-                env[_fl.ENV_FLIGHT_DIR] = str(cfg.flight_dir)
-            if cfg.progress_timeout_s is not None:
-                env[failure.ENV_PROGRESS_WINDOW] = str(cfg.progress_timeout_s)
-            if store_port is not None:
+            env = worker_env(
+                rank=rank, local_rank=local_rank, world_size=world,
+                master_addr=cfg.master_addr, master_port=port,
+                incarnation=incarnation,
+                heartbeat_interval_s=cfg.heartbeat_interval_s,
+                progress_timeout_s=cfg.progress_timeout_s,
                 # Workers heartbeat into the store of the agent that
                 # spawned them (always this host) — node-local liveness.
-                env[failure.ENV_STORE_PORT] = str(store_port)
-                env[failure.ENV_STORE_HOST] = "127.0.0.1"
+                store_port=store_port,
+                flight_dir=cfg.flight_dir,
+                extra=cfg.env,
+            )
             self._procs.append(subprocess.Popen(
                 [sys.executable, *self.argv], env=env
             ))

@@ -51,7 +51,12 @@ def maybe_publish(registry: MetricRegistry | None = None) -> bool:
         publish_snapshot(rep.client, rank=rep.rank,
                          incarnation=rep.incarnation, registry=registry)
         return True
-    except OSError as e:
+    except (OSError, TimeoutError) as e:
+        # counted retry, not just a log line: a store partition during
+        # a publish window must be visible in the registry it failed
+        # to ship (store_errors_total{op="publish"}), and the next
+        # log-cadence tick retries naturally
+        failure.count_store_error("publish")
         log.warning("metric snapshot publish failed: %s", e)
         return False
 

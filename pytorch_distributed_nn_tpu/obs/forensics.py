@@ -255,6 +255,14 @@ def attribute(events: list[dict]) -> dict:
         replica, stranded = _parse_replica_down(downs[-1])
         out["dead_replica"] = replica
         out["stranded_requests"] = stranded
+    # coordinator lifecycle (serve/procfleet.py): a supervision gap is
+    # a suspect in its own right — replicas keep decoding through it,
+    # but nothing finalizes, restarts, or scales until a successor
+    # takes over. Same conditional-key contract as fleet above.
+    gaps = [e for e in events if e.get("kind") == "fleet"
+            and e.get("op") == "coordinator_gap"]
+    if gaps:
+        out["coordinator_gap_s"] = _parse_gap_s(gaps[-1])
     # xray capture (obs/xray.py): the device trace that covers the
     # incident window. Same conditional-key contract as fleet above.
     caps = [e for e in events if e.get("kind") == "xray"
@@ -263,6 +271,13 @@ def attribute(events: list[dict]) -> dict:
         note = str(caps[-1].get("note", ""))
         out["xray_capture"] = note.rsplit(" -> ", 1)[-1] if note else ""
     return out
+
+
+def _parse_gap_s(ev: dict) -> float:
+    """Supervision-gap seconds from a fleet coordinator_gap event note
+    (``gap_s=1.234 inc=2``)."""
+    m = re.search(r"gap_s=([0-9.]+)", str(ev.get("note", "")))
+    return float(m.group(1)) if m else 0.0
 
 
 def _parse_replica_down(ev: dict) -> tuple[str, list[str]]:
@@ -284,6 +299,8 @@ def fleet_summary(dumps: dict[int, RankDump]) -> dict | None:
     if not events:
         return None
     downs, readmits, reloads = [], 0, 0
+    coord_ups = coord_downs = 0
+    max_gap_s = 0.0
     states: dict[str, int] = {}
     for e in events:
         op = str(e.get("op", ""))
@@ -295,11 +312,24 @@ def fleet_summary(dumps: dict[int, RankDump]) -> dict | None:
             readmits += 1
         elif op == "reload":
             reloads += 1
+        elif op == "coordinator_up":
+            coord_ups += 1
+        elif op == "coordinator_down":
+            coord_downs += 1
+        elif op == "coordinator_gap":
+            max_gap_s = max(max_gap_s, _parse_gap_s(e))
         elif op.startswith("state:"):
             s = op.split(":", 1)[1]
             states[s] = states.get(s, 0) + 1
-    return {"replicas_down": downs, "readmits": readmits,
-            "reloads": reloads, "state_transitions": states}
+    summary = {"replicas_down": downs, "readmits": readmits,
+               "reloads": reloads, "state_transitions": states}
+    # conditional: thread-fleet dumps (no coordinator lifecycle) keep
+    # their summary dict unchanged
+    if coord_ups or coord_downs or max_gap_s:
+        summary["coordinator"] = {"ups": coord_ups,
+                                  "downs": coord_downs,
+                                  "max_gap_s": max_gap_s}
+    return summary
 
 
 # ---------------------------------------------------------------------------
@@ -567,6 +597,12 @@ def render_report(dumps: dict[int, RankDump],
         out(f"  re-admissions: {fleet['readmits']}, reloads: "
             f"{fleet['reloads']}, state transitions: "
             f"{fleet['state_transitions']}")
+        coord = fleet.get("coordinator")
+        if coord:
+            out(f"  coordinator: {coord['downs']} down / "
+                f"{coord['ups']} up, max supervision gap "
+                f"{coord['max_gap_s']:.3f}s — replicas kept decoding "
+                f"through the gap; the successor adopted them")
 
     hung = {r: d.incomplete() for r, d in dumps.items()
             if d.incomplete()}

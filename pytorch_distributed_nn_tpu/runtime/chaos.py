@@ -49,6 +49,23 @@ Spec grammar (faults joined by ``;``)::
                                          replica's heartbeat goes
                                          stale and the fleet's
                                          FailureDetector flags it
+    kill_coordinator@after_s=2[:rank=...]
+                                         raise CoordinatorKillError in
+                                         the fleet coordinator's poll
+                                         loop once after_s seconds have
+                                         passed since arming — the
+                                         coordinator crash-recovery
+                                         drill (serve/procfleet.py):
+                                         workers keep running, the
+                                         supervision loop dies
+    store_partition@ms=500[:rank=K][:after_s=...]
+                                         from the first store op on
+                                         (optionally gated by after_s),
+                                         EVERY store op raises OSError
+                                         for a deterministic ms window
+                                         — the transient-partition
+                                         drill the heartbeat/publisher
+                                         hardening must absorb
 
 ``rank`` / ``inc`` (incarnation, from ``TPUNN_RESTART``) are optional
 filters; a fault without them fires in every process / incarnation.
@@ -99,7 +116,7 @@ DEFAULT_HANG_MS = 3_600_000.0
 
 FAULT_KINDS = ("crash", "hang", "slow", "preempt", "corrupt_ckpt",
                "store_flaky", "serve_reject", "kill_replica",
-               "hang_replica")
+               "hang_replica", "kill_coordinator", "store_partition")
 
 _INT_KEYS = ("step", "rank", "inc", "replica")
 _FLOAT_KEYS = ("ms", "p", "after_s")
@@ -112,6 +129,15 @@ class ReplicaKillError(RuntimeError):
     take the whole fleet down instead of one replica); the fleet
     supervisor catches this — like any other worker exception — and
     runs the failover path."""
+
+
+class CoordinatorKillError(RuntimeError):
+    """Raised by an injected ``kill_coordinator`` fault inside the
+    process-fleet coordinator's poll loop. The coordinator's
+    supervision thread dies on it — beats stop, polling stops — while
+    the replica worker *processes* keep serving, which is exactly the
+    crash shape the recovery path (``ProcessFleet.recover``) must
+    re-adopt from."""
 
 
 @dataclasses.dataclass
@@ -180,10 +206,11 @@ def _validate(fault: Fault) -> None:
         "slow": ("ms",), "store_flaky": ("p",),
         "serve_reject": ("p",),
         "kill_replica": ("replica",), "hang_replica": ("replica",),
+        "kill_coordinator": ("after_s",), "store_partition": ("ms",),
     }[fault.kind]
     for key in need:
         missing = (getattr(fault, key) in (None, "", 0.0)
-                   if key in ("collective", "ms", "p")
+                   if key in ("collective", "ms", "p", "after_s")
                    else getattr(fault, key) is None)
         if missing:
             raise ValueError(
@@ -215,7 +242,10 @@ class ChaosEngine:
         self._rng = random.Random((seed << 8) ^ rank)
         self._fired: set[int] = set()  # fault ids that fire once
         self._step = 0  # last step seen via on_step
-        self._t0 = time.monotonic()  # armed-at (kill_replica after_s=)
+        self._t0 = time.monotonic()  # armed-at (after_s= gates)
+        # store_partition: fault id -> window-close time (monotonic);
+        # the window opens on the first matching store op
+        self._partition_until: dict[int, float] = {}
 
     def _matches(self, fault: Fault, *, step: int | None = None) -> bool:
         if fault.rank is not None and fault.rank != self.rank:
@@ -277,11 +307,37 @@ class ChaosEngine:
             self._inject_corrupt_ckpt(fault, manager, step)
 
     def store_op(self, op: str, key: str = "") -> None:
-        for fault in self.faults:
-            if fault.kind != "store_flaky" or not self._matches(fault):
+        for i, fault in enumerate(self.faults):
+            if not self._matches(fault):
                 continue
-            if self._rng.random() < fault.p:
-                self._inject_store_flaky(fault, op, key)
+            if fault.kind == "store_flaky":
+                if self._rng.random() < fault.p:
+                    self._inject_store_flaky(fault, op, key)
+            elif fault.kind == "store_partition":
+                now = time.monotonic()
+                if fault.after_s and now - self._t0 < fault.after_s:
+                    continue
+                if i not in self._fired:
+                    # window opens on the first eligible store op and
+                    # closes ms later — deterministic, clock-driven
+                    self._fired.add(i)
+                    self._partition_until[i] = now + fault.ms / 1000.0
+                if now < self._partition_until[i]:
+                    self._inject_store_partition(fault, op, key)
+
+    def coordinator_poll(self) -> None:
+        """Fleet-coordinator poll hook: kill the coordinator (once)
+        after ``after_s`` seconds of armed wall time. Raises
+        :class:`CoordinatorKillError` out of the poll loop — workers
+        are separate processes and never see it."""
+        for i, fault in enumerate(self.faults):
+            if (fault.kind != "kill_coordinator" or i in self._fired
+                    or not self._matches(fault)):
+                continue
+            if time.monotonic() - self._t0 < fault.after_s:
+                continue
+            self._fired.add(i)
+            self._inject_kill_coordinator(fault)
 
     def admit(self, request_id: str = "") -> bool:
         """Serving admission hook: True = shed this request."""
@@ -355,6 +411,20 @@ class ChaosEngine:
         self._emit(fault, note=f"{fault.spec} [replica {replica}]")
         raise ReplicaKillError(
             f"chaos: injected kill on replica {replica}")
+
+    def _inject_kill_coordinator(self, fault: Fault) -> None:
+        self._emit(fault)
+        # the ring must reach disk NOW: the recovered coordinator's
+        # obs_doctor pass names the gap from this dump
+        flight.dump_now(f"chaos:{fault.spec}", force=True)
+        raise CoordinatorKillError(
+            "chaos: injected coordinator kill")
+
+    def _inject_store_partition(self, fault: Fault, op: str,
+                                key: str) -> None:
+        self._emit(fault, note=f"{fault.spec} [{op} {key}]")
+        raise OSError(
+            f"chaos: store partitioned, {op}({key!r}) unreachable")
 
     def _inject_hang_replica(self, fault: Fault, replica: int) -> None:
         self._emit(fault, note=f"{fault.spec} [replica {replica}]")
@@ -470,6 +540,16 @@ def on_admit(request_id: str = "") -> bool:
     if _engine is None:
         return False
     return _engine.admit(request_id)
+
+
+def on_coordinator_poll() -> None:
+    """``serve.procfleet`` coordinator poll-loop hook
+    (kill_coordinator). May raise :class:`CoordinatorKillError` — the
+    coordinator's supervision thread dies on it while worker processes
+    keep serving; recovery is ``ProcessFleet.recover``'s job."""
+    if _engine is None:
+        return
+    _engine.coordinator_poll()
 
 
 def on_replica_round(replica: int, round_: int) -> None:

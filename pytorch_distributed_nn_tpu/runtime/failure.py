@@ -46,8 +46,21 @@ import time
 
 from . import native
 from pytorch_distributed_nn_tpu.obs import flight
+from pytorch_distributed_nn_tpu.obs.registry import get_registry
 
 log = logging.getLogger(__name__)
+
+
+def count_store_error(op: str) -> None:
+    """One transient store-op failure absorbed as a counted retry
+    (``store_errors_total{op}``) instead of a dead daemon thread or a
+    silent drop — the heartbeat/publisher hardening contract. ``op``
+    names the caller's operation (``beat``, ``publish``, ``dump_poll``),
+    not the wire verb."""
+    get_registry().counter(
+        "store_errors_total",
+        "transient store failures absorbed as counted retries",
+        labels=("op",)).inc(op=op)
 
 # Environment contract between the elastic agent and its workers.
 ENV_STORE_PORT = "TPUNN_STORE_PORT"
@@ -100,6 +113,7 @@ class HeartbeatReporter:
         # beats written, beats withheld by the watchdog, last beat time
         self._beats = 0
         self._suppressed = 0
+        self.store_errors = 0  # beats absorbed as counted retries
         self._last_beat: float | None = None
         # None until the first notify_progress: the watchdog only arms
         # once a step has completed, so an arbitrarily long first-step
@@ -134,6 +148,7 @@ class HeartbeatReporter:
                       if self._last_beat is not None else -1.0),
             "beats": self._beats,
             "suppressed": self._suppressed,
+            "store_errors": self.store_errors,
         }
 
     def notify_progress(self) -> None:
@@ -159,6 +174,7 @@ class HeartbeatReporter:
             reason = self._client.get(
                 self._dump_key, timeout_ms=1000).decode("utf-8", "replace")
         except (OSError, TimeoutError):
+            count_store_error("dump_poll")
             return
         self._dump_served = True
         flight.dump_now(f"supervisor:{reason}", force=True)
@@ -183,8 +199,17 @@ class HeartbeatReporter:
             self._was_suppressed = False
             try:
                 self.beat()
-            except OSError:  # store gone: supervisor is tearing us down
-                return
+            except (OSError, TimeoutError):
+                # Transient store failure (partition, flake, a
+                # supervisor mid-teardown): a missed beat must degrade
+                # to a counted retry, never kill this thread — a beat
+                # thread that died during a 500 ms partition would
+                # leave a perfectly healthy worker reading as hung
+                # forever after. A store that is truly gone keeps the
+                # counter climbing while the supervisor-side staleness
+                # math does its job.
+                self.store_errors += 1
+                count_store_error("beat")
 
     def stop(self) -> None:
         self._stop.set()

@@ -180,6 +180,7 @@ class StoreClient:
         return rc == 1
 
     def delete(self, key: str) -> None:
+        chaos.on_store_op("delete", key)  # store_flaky injection point
         if self._lib.tpustore_delete(self._h, key.encode()) != 0:
             raise OSError(f"store delete({key!r}) failed")
 

@@ -25,10 +25,19 @@ Layering (each module's docstring carries its own contract):
 - :mod:`serve.autoscale` — Helm: the SLO burn-rate autoscaler closing
   the watchtower → fleet loop (``TPUNN_AUTOSCALE`` spec grammar,
   explainable ``autoscale_decision`` journal, hysteresis/cooldowns,
-  Skyline-forecast scale-down floor).
+  Skyline-forecast scale-down floor);
+- :mod:`serve.store` — the fleet's coordination substrate:
+  ``MemStore`` (in-process, parity-tested against the native wire
+  client), ``PrefixStore`` namespacing, append-only ``StoreJournal``,
+  ``make_store`` endpoint factory;
+- :mod:`serve.procfleet` — the deployment shape (ISSUE 13): replica
+  subprocesses (:mod:`serve.fleet_worker`) supervised over the real
+  native store, with a crash-recoverable coordinator (adoption, not
+  restart; journal continuity across incarnations).
 
-CLI: ``scripts/serve.py``; load test: ``bench.py --serve`` /
-``bench.py --fleet``; docs: ``docs/serving.md``.
+CLI: ``scripts/serve.py``, ``scripts/fleet_deploy.py``; load test:
+``bench.py --serve`` / ``bench.py --fleet [--fleet-procs N]``;
+docs: ``docs/serving.md``.
 """
 
 from pytorch_distributed_nn_tpu.serve.autoscale import (  # noqa: F401
@@ -49,6 +58,10 @@ from pytorch_distributed_nn_tpu.serve.fleet import (  # noqa: F401
     ReplicaHandle,
 )
 from pytorch_distributed_nn_tpu.serve.kv_pool import KVPool  # noqa: F401
+from pytorch_distributed_nn_tpu.serve.procfleet import (  # noqa: F401
+    ProcessFleet,
+    ProcTicket,
+)
 from pytorch_distributed_nn_tpu.serve.router import (  # noqa: F401
     DEAD,
     DRAINING,
@@ -61,6 +74,12 @@ from pytorch_distributed_nn_tpu.serve.router import (  # noqa: F401
 from pytorch_distributed_nn_tpu.serve.scheduler import (  # noqa: F401
     Request,
     Scheduler,
+)
+from pytorch_distributed_nn_tpu.serve.store import (  # noqa: F401
+    MemStore,
+    PrefixStore,
+    StoreJournal,
+    make_store,
 )
 from pytorch_distributed_nn_tpu.serve.server import (  # noqa: F401
     InferenceServer,

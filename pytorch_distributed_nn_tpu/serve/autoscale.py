@@ -156,6 +156,10 @@ class Decision:
     evidence: dict
     state: dict
     spec: str
+    # which coordinator life wrote the record: a recovered coordinator
+    # CONTINUES the journal (seq keeps counting, state chains) rather
+    # than forking it, and this field marks where the boundary fell
+    coordinator_incarnation: int = 0
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -279,6 +283,11 @@ class Autoscaler:
         self._feed_tower = feed_tower
         self.decisions: list[Decision] = []
         self.state = _fresh_state()
+        # journal-continuity anchors: a recovered coordinator resumes
+        # seq numbering past the persisted journal (resume_from) and
+        # stamps its own incarnation into every new record
+        self.seq_offset = 0
+        self.coordinator_incarnation = 0
         self._last_eval_t: Optional[float] = None
         self._queue_frac = 0.0
         self._kv_free_frac = 1.0
@@ -346,13 +355,39 @@ class Autoscaler:
             self.cfg, evidence, self.state, t)
         self.state = new_state
         d = Decision(
-            seq=len(self.decisions), t=round(float(t), 6),
+            seq=self.seq_offset + len(self.decisions),
+            t=round(float(t), 6),
             action=action, reason=reason, from_replicas=int(ready),
             to_replicas=int(to), evidence=evidence, state=pre_state,
-            spec=self.spec)
+            spec=self.spec,
+            coordinator_incarnation=self.coordinator_incarnation)
         self.decisions.append(d)
         self._emit(d)
         return d
+
+    def resume_from(self, records: list) -> None:
+        """Continue a persisted decision journal instead of forking it.
+
+        ``records`` are the parsed ``autoscale_decision`` dicts a prior
+        coordinator journaled (same shape :func:`replay_decision`
+        takes). The journaled ``state`` is PRE-decision, so the resumed
+        hysteresis state is re-derived by running the last record back
+        through :func:`decide` — exactly the post-state an
+        uninterrupted Autoscaler would carry. Sequence numbers continue
+        from the journal's tail and the debounce anchor is the last
+        journaled event time, so the concatenated journal (old lines +
+        new lines) is indistinguishable from one life's: seq contiguous
+        and every record's ``state`` equal to its predecessor's
+        post-state across the restart boundary."""
+        if not records:
+            return
+        last = records[-1]
+        cfg = parse_spec(last.get("spec", ""))
+        _, _, _, post = decide(cfg, last["evidence"], last["state"],
+                               float(last["t"]))
+        self.state = post
+        self.seq_offset = int(last["seq"]) + 1
+        self._last_eval_t = float(last["t"])
 
     def _emit(self, d: Decision) -> None:
         """Every decision lands in the flight ring FIRST (lint-

@@ -73,36 +73,16 @@ from pytorch_distributed_nn_tpu.serve.router import (
 )
 from pytorch_distributed_nn_tpu.serve.scheduler import DONE, REJECTED
 
+from pytorch_distributed_nn_tpu.serve.store import MemStore, PrefixStore
+
 log = logging.getLogger(__name__)
 
 _ids = itertools.count()
 
-
-class _MemStore:
-    """In-process stand-in for the native store client, satisfying the
-    slice of its surface the heartbeat protocol uses (``set`` / ``get``
-    / ``check`` / ``close``) — so the fleet reuses the REAL
-    ``HeartbeatReporter`` and ``FailureDetector`` unmodified, same
-    keys, same staleness math, no sockets."""
-
-    def __init__(self) -> None:
-        self._d: dict[str, bytes] = {}
-        self._lock = threading.Lock()
-
-    def set(self, key: str, value: bytes) -> None:
-        with self._lock:
-            self._d[key] = bytes(value)
-
-    def get(self, key: str, timeout_ms: int = 0) -> bytes:
-        with self._lock:
-            return self._d[key]
-
-    def check(self, key: str) -> bool:
-        with self._lock:
-            return key in self._d
-
-    def close(self) -> None:
-        pass
+# Back-compat alias: the in-process store grew full StoreClient surface
+# parity and moved to serve/store.py (tests/test_store_parity.py pins
+# it to the real transport op-for-op).
+_MemStore = MemStore
 
 
 class FleetTicket:
@@ -263,7 +243,8 @@ class Fleet:
                  heartbeat_timeout_s: float = 10.0,
                  progress_window_s: Optional[float] = None,
                  idle_wait_s: float = 0.002,
-                 poll_interval_s: float = 0.01) -> None:
+                 poll_interval_s: float = 0.01,
+                 store=None, namespace: str = "") -> None:
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas}")
         self.model = model
@@ -286,7 +267,16 @@ class Fleet:
         self._idle_wait = idle_wait_s
         self._poll_interval = poll_interval_s
         self.router = Router()
-        self._store = _MemStore()
+        # Heartbeat transport: in-process by default; pass ``store=``
+        # (e.g. a runtime.native.StoreClient) to beat through the real
+        # wire instead — the protocol is identical either way (the
+        # store-parity suite guarantees it). ``namespace`` scopes every
+        # key under ``<namespace>/`` so one physical store can host
+        # many fleets (and the process-backed fleet's coordinator
+        # state) without collisions.
+        base_store = store if store is not None else MemStore()
+        self._store = (PrefixStore(base_store, namespace)
+                       if namespace else base_store)
         self._detector = failure.FailureDetector(
             self._store, ranks=list(range(replicas)), incarnation=0,
             timeout_s=heartbeat_timeout_s)

@@ -1,0 +1,348 @@
+"""Replica worker subprocess — the process-backed fleet's data plane.
+
+One process = one replica = one crash domain for real. The coordinator
+(:mod:`serve.procfleet`) spawns this module with ``python -m``, hands it
+a store endpoint + namespace + replica index, and from then on every
+word between them travels through the store:
+
+- ``req/<idx>/<k>``   — the k-th request dispatched to this replica
+  (``k`` allocated by the coordinator's atomic counter; the worker
+  consumes strictly in order, so a dispatch is never lost or doubled);
+- ``prog/<rid>``      — tokens emitted so far for a running request,
+  republished every decode round; this is what a coordinator (original
+  or recovered) stitches from when this replica dies mid-stream;
+- ``done/<rid>``      — the final token list; written exactly once per
+  request life;
+- ``gauge/<idx>``     — queue depth / KV headroom, the remote mirror of
+  the scheduler+pool gauges :meth:`serve.router.Router._score` reads;
+- ``ctl/<idx>``       — coordinator control: ``drain`` (finish what you
+  hold, exit ``GRACEFUL_EXIT_CODE``) or ``stop`` (fleet shutdown);
+- ``hb/0/<idx>``      — the REAL :class:`runtime.failure.HeartbeatReporter`
+  beating through the same store (progress-watchdog mode, so a wedged
+  decode loop reads as a hang even while the beat thread lives).
+
+Exit codes are the elastic-agent contract: ``0`` on ``stop``,
+``failure.GRACEFUL_EXIT_CODE`` (83) on drain/SIGTERM,
+``chaos.CRASH_EXIT_CODE`` (43) on an injected or real crash — the
+coordinator's per-replica :class:`launch.RestartPolicy` classifies them
+exactly like the training agent does.
+
+Backends: ``stub`` decodes with :func:`serve.stub.stub_next_token`
+(deterministic, model-free — restart drills and tier-1); ``tiny``
+builds the same deterministic tiny model ``bench.py --serve-tiny``
+uses and drives a real :class:`serve.engine.ServingEngine`.
+
+Store failures (``store_partition`` / ``store_flaky`` chaos, a real
+blip) degrade to counted retries (``store_errors_total{op}``) — the
+worker keeps decoding through a partition and republishes state when
+the store comes back; only the detector's staleness math may declare
+it dead.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import sys
+import time
+
+from pytorch_distributed_nn_tpu.runtime import chaos, failure
+from pytorch_distributed_nn_tpu.runtime.platform import (
+    apply_platform_overrides,
+)
+from pytorch_distributed_nn_tpu.serve.store import PrefixStore, make_store
+from pytorch_distributed_nn_tpu.serve.stub import stub_next_token
+
+# entrypoint contract: honor JAX_PLATFORMS before first backend use —
+# a fleet of tiny-backend workers must not pile onto the one real chip
+apply_platform_overrides()
+
+log = logging.getLogger(__name__)
+
+
+class _StubBackend:
+    """Model-free decode: one deterministic stub token per active
+    request per round. ``token_ms`` paces the round so drills see a
+    realistic service rate (queues actually build under flash crowds)."""
+
+    def __init__(self, *, max_slots: int, token_ms: float) -> None:
+        self.max_slots = int(max_slots)
+        self.token_ms = float(token_ms)
+        self._active: list[dict] = []
+
+    @property
+    def slots_free(self) -> int:
+        return self.max_slots - len(self._active)
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self._active)
+
+    def admit(self, rec: dict) -> None:
+        self._active.append({"rec": rec, "tokens": []})
+
+    def step(self) -> tuple[list, list]:
+        """One decode round → ``(progress, completed)`` where progress
+        is ``[(rec, tokens_so_far)]`` and completed
+        ``[(rec, tokens, status)]``."""
+        if not self._active:
+            return [], []
+        if self.token_ms:
+            time.sleep(self.token_ms / 1000.0)
+        progress, completed, still = [], [], []
+        for ent in self._active:
+            rec, toks = ent["rec"], ent["tokens"]
+            toks.append(stub_next_token(list(rec["prompt"]) + toks))
+            if len(toks) >= int(rec["max_new_tokens"]):
+                completed.append((rec, toks, "done"))
+            else:
+                progress.append((rec, toks))
+                still.append(ent)
+        self._active = still
+        return progress, completed
+
+    def gauges(self) -> dict:
+        # slot-granular "KV": free slots over total, the same headroom
+        # shape the router scores on real pools
+        return {"free_blocks": self.slots_free,
+                "num_blocks": self.max_slots, "block_size": 1}
+
+
+class _EngineBackend:
+    """A real :class:`serve.engine.ServingEngine` over the
+    deterministic tiny model (``bench.py``'s ``--serve-tiny`` shape):
+    same config, same seed-0 params in every process, so greedy decode
+    is bit-identical across replicas and coordinator lives."""
+
+    def __init__(self, *, max_slots: int, max_seq_len: int,
+                 block_size: int, max_queue: int, tag: str) -> None:
+        import numpy as np
+
+        from pytorch_distributed_nn_tpu.serve.engine import ServingEngine
+
+        self._np = np
+        model, params = build_tiny_model()
+        self.engine = ServingEngine(
+            model, params, max_slots=max_slots, max_seq_len=max_seq_len,
+            block_size=block_size, max_queue=max_queue, tag=tag)
+        self._reqs: list[tuple[dict, object]] = []
+
+    @property
+    def slots_free(self) -> int:
+        return max(self.engine.max_slots - len(self._reqs), 0)
+
+    @property
+    def has_work(self) -> bool:
+        return bool(self._reqs) or self.engine.has_work
+
+    def admit(self, rec: dict) -> None:
+        req = self.engine.submit(
+            self._np.asarray(rec["prompt"], self._np.int32),
+            int(rec["max_new_tokens"]),
+            request_id=rec["request_id"],
+            resubmit=bool(rec.get("life", 0)))
+        self._reqs.append((rec, req))
+
+    def step(self) -> tuple[list, list]:
+        if self.engine.has_work:
+            self.engine.step()
+        progress, completed, still = [], [], []
+        for rec, req in self._reqs:
+            if req.done.is_set():
+                toks = ([int(t) for t in req.tokens]
+                        if req.tokens is not None else [])
+                status = "done" if req.state == "done" else "rejected"
+                completed.append((rec, toks, status))
+                continue
+            toks = []
+            for slot in self.engine._slots:
+                if slot is not None and slot.req is req:
+                    toks = [int(t) for t in slot.tokens]
+                    break
+            progress.append((rec, toks))
+            still.append((rec, req))
+        self._reqs = still
+        return progress, completed
+
+    def gauges(self) -> dict:
+        pool = self.engine.scheduler.pool
+        return {"free_blocks": pool.free_blocks,
+                "num_blocks": pool.num_blocks,
+                "block_size": pool.block_size}
+
+
+def build_tiny_model():
+    """The deterministic tiny decoder every process-backed replica
+    serves: the exact ``bench.py --serve-tiny`` shape with seed-0
+    init — identical params in every process by construction, so the
+    process fleet's greedy streams are bit-comparable to the threaded
+    fleet's."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_nn_tpu.config import get_config
+    from pytorch_distributed_nn_tpu.models import get_model
+
+    cfg = get_config("llama3_8b_zero")
+    cfg.model.extra = dict(num_layers=4, d_model=256, num_heads=8,
+                           num_kv_heads=4, mlp_dim=1024,
+                           vocab_size=1024)
+    cfg.model.compute_dtype = "float32"
+    cfg.model.remat = False
+    model = get_model(cfg.model)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32),
+                        train=False)["params"]
+    return model, params
+
+
+def _publish(ps, key: str, rec: dict, *, op: str) -> bool:
+    """Counted-retry store write: a partition degrades the publish to
+    a ``store_errors_total{op}`` bump, never a dead worker."""
+    try:
+        ps.set(key, json.dumps(rec, sort_keys=True).encode())
+        return True
+    except (OSError, TimeoutError):
+        failure.count_store_error(op)
+        return False
+
+
+def _publish_done(ps, rec: dict, tokens: list, status: str,
+                  *, retries: int = 100) -> None:
+    """The one write that must not be silently dropped: retry through
+    a partition window. If the store stays gone the coordinator's
+    staleness math re-admits the request elsewhere and greedy decode
+    regenerates the identical stream — correctness never rests on this
+    write landing, only latency does."""
+    payload = {"life": int(rec.get("life", 0)), "status": status,
+               "tokens": [int(t) for t in tokens]}
+    key = f"done/{rec['request_id']}"
+    for _ in range(retries):
+        if _publish(ps, key, payload, op="worker_done"):
+            return
+        time.sleep(0.05)
+    log.warning("giving up publishing %s after %d retries", key, retries)
+
+
+def _serve_loop(args, ps, idx: int, reporter, backend) -> int:
+    queue: list[dict] = []
+    next_k = args.start_k
+    draining = False
+    rounds = 0
+    idle_s = max(args.poll_ms, 0.5) / 1000.0
+    while True:
+        rounds += 1
+        # chaos kill/hang drill — may raise ReplicaKillError (caught in
+        # main → exit CRASH_EXIT_CODE) or block (heartbeat goes stale)
+        chaos.on_replica_round(idx, rounds)
+        reporter.notify_progress()
+        if failure.preempt_requested():
+            draining = True  # SIGTERM → finish what we hold, exit 83
+        try:
+            if ps.check(f"ctl/{idx}"):
+                cmd = ps.get(f"ctl/{idx}", timeout_ms=1000).decode()
+                if cmd == "stop":
+                    return 0
+                if cmd == "drain":
+                    draining = True
+        except (OSError, TimeoutError):
+            failure.count_store_error("worker_ctl")
+        try:
+            while ps.check(f"req/{idx}/{next_k}"):
+                queue.append(json.loads(ps.get(
+                    f"req/{idx}/{next_k}", timeout_ms=1000).decode()))
+                next_k += 1
+        except (OSError, TimeoutError):
+            failure.count_store_error("worker_pull")
+        while queue and backend.slots_free > 0:
+            backend.admit(queue.pop(0))
+        progress, completed = backend.step()
+        for rec, toks in progress:
+            if toks:
+                _publish(ps, f"prog/{rec['request_id']}",
+                         {"life": int(rec.get("life", 0)),
+                          "tokens": [int(t) for t in toks]},
+                         op="worker_prog")
+        for rec, toks, status in completed:
+            _publish_done(ps, rec, toks, status)
+        _publish(ps, f"gauge/{idx}", dict(
+            queue_depth=len(queue), max_queue=args.max_queue,
+            pid=os.getpid(), round=rounds, draining=draining,
+            **backend.gauges()), op="worker_gauge")
+        if draining and not backend.has_work and not queue:
+            return failure.GRACEFUL_EXIT_CODE
+        if not backend.has_work:
+            time.sleep(idle_s)
+
+
+def _parse(argv=None) -> argparse.Namespace:
+    p = argparse.ArgumentParser(
+        description="process-fleet replica worker (serve/procfleet.py "
+                    "spawns this; not a user-facing CLI)")
+    p.add_argument("--store", required=True,
+                   help="store endpoint, host:port")
+    p.add_argument("--namespace", default="fleet")
+    p.add_argument("--replica-index", type=int, required=True)
+    p.add_argument("--backend", choices=("stub", "tiny"), default="stub")
+    p.add_argument("--max-slots", type=int, default=4)
+    p.add_argument("--max-queue", type=int, default=64)
+    p.add_argument("--max-seq-len", type=int, default=256)
+    p.add_argument("--block-size", type=int, default=16)
+    p.add_argument("--token-ms", type=float, default=2.0,
+                   help="stub decode pacing per round")
+    p.add_argument("--hb-interval", type=float, default=0.1)
+    p.add_argument("--progress-window", type=float, default=None)
+    p.add_argument("--poll-ms", type=float, default=2.0)
+    p.add_argument("--start-k", type=int, default=0,
+                   help="first dispatch seq to consume — a restarted "
+                        "index resumes the stream where the store "
+                        "counter left it, skipping requests the dead "
+                        "life already owned (the coordinator re-admits "
+                        "those under a new life)")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = _parse(argv)
+    logging.basicConfig(
+        level=logging.INFO,
+        format=f"[fleet-worker r{args.replica_index}] %(message)s")
+    chaos.maybe_init()
+    failure.install_preemption_handler(force=True)
+    client = make_store(args.store)
+    ps = PrefixStore(client, args.namespace) if args.namespace else client
+    idx = int(args.replica_index)
+    reporter = failure.HeartbeatReporter(
+        ps, rank=idx, incarnation=0,
+        interval_s=args.hb_interval,
+        progress_window_s=args.progress_window)
+    if args.backend == "stub":
+        backend = _StubBackend(max_slots=args.max_slots,
+                               token_ms=args.token_ms)
+    else:
+        backend = _EngineBackend(
+            max_slots=args.max_slots, max_seq_len=args.max_seq_len,
+            block_size=args.block_size, max_queue=args.max_queue,
+            tag=f"r{idx}")
+    code = chaos.CRASH_EXIT_CODE
+    try:
+        code = _serve_loop(args, ps, idx, reporter, backend)
+    except chaos.ReplicaKillError:
+        log.warning("replica %d: injected kill", idx)
+        code = chaos.CRASH_EXIT_CODE
+    except Exception:
+        log.exception("replica %d crashed", idx)
+        code = chaos.CRASH_EXIT_CODE
+    finally:
+        reporter.stop()
+        try:
+            client.close()
+        except OSError:
+            pass
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,1043 @@
+"""Process-backed fleet: real subprocess replicas, crash-recoverable
+coordinator, journal continuity through the native store.
+
+The thread-backed :class:`serve.fleet.Fleet` proved the failover
+*policy* (router, stranded re-admission, restart governor) with threads
+standing in for processes and a :class:`serve.store.MemStore` standing
+in for the wire. This module is the deployment shape: each replica is a
+real subprocess (:mod:`serve.fleet_worker`, spawned with the same
+:func:`launch.worker_env` contract the training agent uses), every word
+between coordinator and workers travels through the REAL
+:class:`runtime.native.StoreClient`, and — the new failure domain —
+the *coordinator itself* may die and be replaced without cold-restarting
+the fleet:
+
+- **supervision over the wire** — the REAL
+  :class:`runtime.failure.FailureDetector` reads worker heartbeats from
+  the store; exits are classified by the per-replica
+  :class:`launch.RestartPolicy` exactly like training workers
+  (``GRACEFUL_EXIT_CODE`` free, crashes charged);
+- **durable request journal** — every ``submit``/``place``/``final``
+  is appended to a :class:`serve.store.StoreJournal` *before* the
+  action takes effect, so a coordinator's death loses no request: the
+  successor replays the journal, finds what was in flight, and stitches;
+- **adoption, not restart** — :meth:`ProcessFleet.recover` bumps the
+  ``coord/inc`` counter, measures the supervision gap from the dead
+  coordinator's last ``coord/beat``, re-reads ``members``, and adopts
+  every worker that is still heartbeating — their processes, KV state
+  and queues untouched. Only requests stranded on replicas that died
+  *during* the gap are re-admitted (prompt + ``prog/<rid>`` prefix;
+  greedy decode makes the stitched stream bit-identical);
+- **journal continuity** — Helm's decision journal persists through the
+  same store (``Decision.as_json()`` bytes, appended verbatim); the
+  successor's :class:`serve.autoscale.Autoscaler` resumes via
+  ``resume_from`` — seq contiguous, hysteresis state chained, a new
+  ``coordinator_incarnation`` stamped — so the concatenated journal
+  replays standalone (``scripts/obs_watch.py --autoscale``) with no
+  fork;
+- **chaos-drilled** — ``kill_coordinator@after_s=`` raises
+  :class:`runtime.chaos.CoordinatorKillError` in the poll loop (workers
+  keep serving); ``store_partition@ms=`` blacks out every store op for
+  a window, which both sides absorb as counted retries
+  (``store_errors_total{op}``).
+
+Same lint-enforced contracts as the thread fleet: every replica state
+change goes through :meth:`ProcessFleet._set_state` (counted +
+flight-visible), every placement through the shared
+:class:`serve.router.Router` choke point.
+"""
+
+from __future__ import annotations
+
+import itertools
+import json
+import logging
+import os
+import subprocess
+import sys
+import threading
+import time
+from typing import Optional
+
+import numpy as np
+
+from pytorch_distributed_nn_tpu.launch import RestartPolicy, worker_env
+from pytorch_distributed_nn_tpu.obs import flight, watchtower
+from pytorch_distributed_nn_tpu.obs.registry import get_registry
+from pytorch_distributed_nn_tpu.runtime import chaos, failure
+from pytorch_distributed_nn_tpu.serve import autoscale as _autoscale
+from pytorch_distributed_nn_tpu.serve.router import (
+    DEAD,
+    DRAINING,
+    READY,
+    STARTING,
+    Router,
+)
+from pytorch_distributed_nn_tpu.serve.store import (
+    PrefixStore,
+    StoreJournal,
+    make_store,
+)
+
+log = logging.getLogger(__name__)
+
+_ids = itertools.count()
+
+
+class ProcTicket:
+    """The client's handle on one process-fleet request. Survives both
+    replica failover AND coordinator replacement: everything needed to
+    rebuild it lives in the store journal."""
+
+    def __init__(self, request_id: str, prompt: list, max_new_tokens: int
+                 ) -> None:
+        self.request_id = request_id
+        self.prompt = [int(t) for t in prompt]
+        self.max_new_tokens = int(max_new_tokens)
+        self.t_submit = time.monotonic()
+        self.t_first_token = 0.0
+        self.t_done = 0.0
+        # tokens recovered from dead lives, re-fed as prompt suffix
+        self.prefix: list[int] = []
+        self.failovers: list[dict] = []
+        self.life = 0  # placement generation; workers echo it back
+        self.status = "pending"  # pending | done | rejected | failed
+        self.reject_reason = ""
+        self.tokens: Optional[np.ndarray] = None
+        self.assigned: Optional[int] = None  # replica index, None=unplaced
+        self.done = threading.Event()
+
+    @property
+    def ok(self) -> bool:
+        return self.status == "done"
+
+    @property
+    def ttft_s(self) -> float:
+        return (self.t_first_token - self.t_submit
+                if self.t_first_token else -1.0)
+
+    def wait(self, timeout: Optional[float] = None) -> bool:
+        return self.done.wait(timeout)
+
+    def result(self, timeout: Optional[float] = None):
+        if not self.done.wait(timeout):
+            return None
+        return self.tokens if self.ok else None
+
+
+class _RemotePool:
+    """Duck-type of :class:`serve.kv_pool.BlockPool`'s gauge surface —
+    refreshed from the worker's ``gauge/<idx>`` key so the UNMODIFIED
+    :class:`serve.router.Router` scores remote replicas."""
+
+    def __init__(self, num_blocks: int) -> None:
+        self.free_blocks = num_blocks
+        self.num_blocks = num_blocks
+        self.block_size = 1
+
+
+class _RemoteScheduler:
+    def __init__(self, max_queue: int, num_blocks: int) -> None:
+        self.queue_depth = 0
+        self.max_queue = max_queue
+        self.pool = _RemotePool(num_blocks)
+
+
+class _RemoteEngine:
+    def __init__(self, max_queue: int, num_blocks: int) -> None:
+        self.scheduler = _RemoteScheduler(max_queue, num_blocks)
+
+
+class ProcReplica:
+    """Book entry for one replica subprocess. ``state`` is written ONLY
+    by :meth:`ProcessFleet._set_state` (the fleet.py lint contract)."""
+
+    def __init__(self, index: int, policy: RestartPolicy,
+                 max_queue: int, max_slots: int) -> None:
+        self.index = index
+        self.name = f"p{index}"
+        self.policy = policy
+        self.engine = _RemoteEngine(max_queue, max_slots)
+        self.proc: Optional[subprocess.Popen] = None
+        self.pid: Optional[int] = None
+        self.state = ""
+        self.incarnations = 0
+        self.restart_at: Optional[float] = None
+        self.stop_reason = ""
+        self.retiring = False
+        self.adopted = False  # inherited live from a dead coordinator
+        self.spawned_at = time.monotonic()
+        self.gauge_round = -1
+
+
+class ProcessFleet:
+    """N replica subprocesses behind one (replaceable) coordinator."""
+
+    def __init__(self, *, replicas: int = 2, backend: str = "stub",
+                 namespace: str = "fleet",
+                 store_endpoint: Optional[str] = None,
+                 server=None,
+                 max_slots: int = 4, max_queue: int = 64,
+                 max_seq_len: int = 256, block_size: int = 16,
+                 token_ms: float = 2.0,
+                 heartbeat_interval_s: float = 0.05,
+                 heartbeat_timeout_s: float = 2.0,
+                 progress_window_s: Optional[float] = None,
+                 poll_interval_s: float = 0.02,
+                 join_timeout_s: float = 60.0,
+                 max_restarts: int = 3,
+                 restart_window_s: Optional[float] = None,
+                 backoff_base_s: float = 0.05,
+                 backoff_max_s: float = 1.0,
+                 autoscale_spec: str = "",
+                 forecast_replicas: Optional[int] = None,
+                 metrics=None,
+                 worker_extra_env: Optional[dict] = None,
+                 flight_dir: Optional[str] = None,
+                 python: str = sys.executable,
+                 recover: bool = False) -> None:
+        if replicas < 1:
+            raise ValueError(f"replicas must be >= 1, got {replicas}")
+        self.backend = backend
+        self.namespace = namespace
+        self.metrics = metrics
+        self._max_slots = max_slots
+        self._max_queue = max_queue
+        self._max_seq_len = max_seq_len
+        self._block_size = block_size
+        self._token_ms = token_ms
+        self._hb_interval = heartbeat_interval_s
+        self._hb_timeout = heartbeat_timeout_s
+        self._progress_window = progress_window_s
+        self._poll_interval = poll_interval_s
+        self._join_timeout = join_timeout_s
+        self._policy_kw = dict(
+            max_restarts=max_restarts, window_s=restart_window_s,
+            backoff_base_s=backoff_base_s, backoff_max_s=backoff_max_s)
+        self._worker_extra_env = dict(worker_extra_env or {})
+        self._flight_dir = flight_dir
+        self._python = python
+        self.router = Router()
+
+        # -- store: own server by default, never an in-process stub ---
+        self._owns_server = False
+        if server is None and store_endpoint is None:
+            from pytorch_distributed_nn_tpu.runtime import native
+
+            server = native.StoreServer(0)
+            self._owns_server = True
+        self._server = server
+        if store_endpoint is None:
+            store_endpoint = f"127.0.0.1:{server.port}"
+        if store_endpoint == "mem":
+            raise ValueError(
+                "ProcessFleet workers are subprocesses; the store must "
+                "be a real endpoint (host:port), not 'mem'")
+        self.store_endpoint = store_endpoint
+        self._client = make_store(store_endpoint)
+        self._ns = PrefixStore(self._client, namespace)
+        self.journal = StoreJournal(self._ns, "journal")
+        self.helm_journal = StoreJournal(self._ns, "helm")
+
+        # -- coordinator identity + instruments ------------------------
+        reg = get_registry()
+        self._c_replica_state = reg.counter(
+            "serve_replica_state_total", "replica state transitions",
+            labels=("state",))
+        self._c_coord_starts = reg.counter(
+            "fleet_coordinator_starts_total",
+            "coordinator lives by start mode", labels=("mode",))
+        self._g_coord_inc = reg.gauge(
+            "fleet_coordinator_incarnation",
+            "this coordinator's incarnation (store-allocated)")
+        self._g_coord_gap = reg.gauge(
+            "fleet_coordinator_gap_seconds",
+            "supervision gap a recovering coordinator measured from "
+            "its predecessor's last beat")
+        self._c_recovered = reg.counter(
+            "fleet_coordinator_recovered_total",
+            "recovery dispositions (replicas adopted/respawned, "
+            "requests finalized/readmitted)", labels=("outcome",))
+        mode = "recover" if recover else "fresh"
+        self.incarnation = self._ns.add("coord/inc", 1) - 1
+        self.gap_s = 0.0
+        if recover and self._ns.check("coord/beat"):
+            self.gap_s = max(time.time() - float(
+                self._ns.get("coord/beat", timeout_ms=2000)), 0.0)
+        self._c_coord_starts.inc(mode=mode)
+        self._g_coord_inc.set(float(self.incarnation))
+        self._g_coord_gap.set(self.gap_s)
+        if recover:
+            # the one event obs_doctor names the outage from: how long
+            # the fleet ran unsupervised, and which life took over
+            flight.record(
+                "fleet", "coordinator_gap",
+                note=f"gap_s={self.gap_s:.3f} inc={self.incarnation}")
+        flight.record("fleet", "coordinator_up",
+                      note=f"inc={self.incarnation} mode={mode}")
+        self.journal.append({
+            "event": "coordinator_up", "incarnation": self.incarnation,
+            "mode": mode, "gap_s": round(self.gap_s, 3)})
+
+        self._lock = threading.RLock()
+        self._replicas: list[ProcReplica] = []
+        self._tickets: dict[str, ProcTicket] = {}
+        self.completed: list[dict] = []
+        self.failovers = 0
+        self.dead = False  # supervision loop died (chaos / abandon)
+        self._detector: Optional[failure.FailureDetector] = None
+        self._started = False
+        self._sup_stop = threading.Event()
+        self._sup_thread: Optional[threading.Thread] = None
+        self.recovery: dict = {}
+
+        # -- Helm (resumed across coordinator lives) -------------------
+        self._helm = None
+        if autoscale_spec:
+            cfg = _autoscale.parse_spec(autoscale_spec)
+            tower = (watchtower.tower()
+                     if watchtower.enabled() else None)
+            scaler = _autoscale.Autoscaler(
+                cfg, tower=tower, forecast_replicas=forecast_replicas,
+                metrics=metrics, spec=autoscale_spec)
+            scaler.coordinator_incarnation = self.incarnation
+            if recover:
+                scaler.resume_from(self.helm_journal.read_all())
+            self._helm = _autoscale.FleetAutoscaler(self, scaler)
+
+        if recover:
+            self._recover_members()
+            self._refresh_gauges()  # promotes adopted live replicas
+            self._recover_tickets()
+            self._target_replicas = len(
+                [h for h in self._replicas if not h.retiring]) or 1
+        else:
+            for _ in range(replicas):
+                self._spawn_new(reason="init")
+            self._target_replicas = replicas
+        self._write_members()
+        self._rebuild_detector()
+
+    @classmethod
+    def recover_from(cls, *, store_endpoint: str,
+                     namespace: str = "fleet", **kw) -> "ProcessFleet":
+        """Take over a fleet whose coordinator died: adopt surviving
+        workers, finalize/re-admit what the journal says was in flight,
+        resume the Helm journal. Workers are never restarted just
+        because the coordinator was."""
+        return cls(store_endpoint=store_endpoint, namespace=namespace,
+                   recover=True, **kw)
+
+    # -- the single replica-state choke point --------------------------
+
+    def _set_state(self, h: ProcReplica, state: str,
+                   reason: str = "") -> None:
+        """EVERY replica state change funnels through here (the
+        fleet.py lint contract): counted + flight-visible."""
+        h.state = state
+        self._c_replica_state.inc(state=state)
+        flight.record("fleet", f"state:{state}",
+                      note=f"{h.name} {reason}".strip())
+        if self.metrics is not None:
+            self.metrics.emit("fleet_state", replica=h.index,
+                              state=state, reason=reason)
+
+    # -- replica lifecycle ----------------------------------------------
+
+    def _alloc_index(self) -> int:
+        """Monotonic store-allocated replica index: never reused across
+        restarts, scale events, or coordinator lives, so a retired
+        slot's keys can't alias a newer replica's."""
+        return self._ns.add("ridx", 1) - 1
+
+    def _new_handle(self, index: int) -> ProcReplica:
+        return ProcReplica(index,
+                           RestartPolicy(seed=index, **self._policy_kw),
+                           self._max_queue, self._max_slots)
+
+    def _spawn_new(self, *, reason: str) -> ProcReplica:
+        h = self._new_handle(self._alloc_index())
+        self._replicas.append(h)
+        self._set_state(h, STARTING, reason=reason)
+        self._launch(h)
+        return h
+
+    def _launch(self, h: ProcReplica) -> None:
+        cmd = [self._python, "-m",
+               "pytorch_distributed_nn_tpu.serve.fleet_worker",
+               "--store", self.store_endpoint,
+               "--namespace", self.namespace,
+               "--replica-index", str(h.index),
+               "--backend", self.backend,
+               "--max-slots", str(self._max_slots),
+               "--max-queue", str(self._max_queue),
+               "--max-seq-len", str(self._max_seq_len),
+               "--block-size", str(self._block_size),
+               "--token-ms", str(self._token_ms),
+               "--hb-interval", str(self._hb_interval),
+               # a restarted index resumes the dispatch stream where
+               # the store counter left it, not at zero
+               "--start-k", str(self._ns.add(f"reqn/{h.index}", 0))]
+        if self._progress_window is not None:
+            cmd += ["--progress-window", str(self._progress_window)]
+        env = worker_env(
+            rank=h.index, world_size=1, incarnation=0,
+            heartbeat_interval_s=self._hb_interval,
+            progress_timeout_s=self._progress_window,
+            flight_dir=self._flight_dir,
+            extra=self._worker_extra_env)
+        h.proc = subprocess.Popen(cmd, env=env)
+        h.pid = h.proc.pid
+        h.incarnations += 1
+        h.restart_at = None
+        h.spawned_at = time.monotonic()
+        h.gauge_round = -1
+
+    def _write_members(self) -> None:
+        members = [{"index": h.index, "pid": h.pid,
+                    "retiring": h.retiring}
+                   for h in self._replicas if h.state != DEAD]
+        try:
+            self._ns.set("members",
+                         json.dumps(members, sort_keys=True).encode())
+        except (OSError, TimeoutError):
+            failure.count_store_error("coord_members")
+
+    def _rebuild_detector(self) -> None:
+        self._detector = failure.FailureDetector(
+            self._ns, ranks=[h.index for h in self._replicas],
+            incarnation=0, timeout_s=self._hb_timeout)
+
+    def _proc_exit_code(self, h: ProcReplica) -> Optional[int]:
+        """None while running. Spawned children report their real exit
+        code; adopted workers (another coordinator's children — unless
+        recovery ran in the same process, where waitpid still works)
+        fall back to an existence probe."""
+        if h.proc is not None:
+            return h.proc.poll()
+        if h.pid is None:
+            return chaos.CRASH_EXIT_CODE
+        try:
+            pid, status = os.waitpid(h.pid, os.WNOHANG)
+            if pid == 0:
+                return None
+            return os.waitstatus_to_exitcode(status)
+        except ChildProcessError:
+            try:
+                os.kill(h.pid, 0)
+                return None
+            except ProcessLookupError:
+                return chaos.CRASH_EXIT_CODE
+        except OSError:
+            return None
+
+    # -- recovery --------------------------------------------------------
+
+    def _recover_members(self) -> None:
+        members = []
+        try:
+            if self._ns.check("members"):
+                members = json.loads(
+                    self._ns.get("members", timeout_ms=2000).decode())
+        except (OSError, TimeoutError, ValueError):
+            failure.count_store_error("coord_members")
+        adopted = respawned = 0
+        probe = failure.FailureDetector(
+            self._ns, ranks=[int(m["index"]) for m in members],
+            incarnation=0, timeout_s=self._hb_timeout)
+        ages = probe.last_beat_ages()
+        for m in members:
+            idx = int(m["index"])
+            h = self._new_handle(idx)
+            h.pid = int(m["pid"]) if m.get("pid") else None
+            h.retiring = bool(m.get("retiring"))
+            age = ages.get(idx)
+            beating = age is not None and age <= self._hb_timeout
+            if beating and self._proc_exit_code(h) is None:
+                h.adopted = True
+                h.incarnations = 1
+                self._replicas.append(h)
+                # STARTING only until the next gauge read proves it
+                # serving — adoption never cold-restarts a live worker
+                self._set_state(h, STARTING, reason="adopt")
+                self._c_recovered.inc(outcome="adopted")
+                self.journal.append({"event": "adopt", "replica": idx,
+                                     "pid": h.pid})
+                adopted += 1
+            elif not h.retiring:
+                self._c_recovered.inc(outcome="respawned")
+                self._spawn_new(reason="recover_respawn")
+                respawned += 1
+        self.recovery.update(adopted=adopted, respawned=respawned)
+        log.info("procfleet recover: adopted %d, respawned %d "
+                 "(gap %.3fs)", adopted, respawned, self.gap_s)
+
+    def _recover_tickets(self) -> None:
+        tickets: dict[str, ProcTicket] = {}
+        for rec in self.journal.read_all():
+            ev = rec.get("event")
+            if ev == "submit":
+                t = ProcTicket(rec["request_id"], rec["prompt"],
+                               rec["max_new_tokens"])
+                tickets[t.request_id] = t
+            elif ev == "place":
+                t = tickets.get(rec["request_id"])
+                if t is not None:
+                    t.assigned = int(rec["replica"])
+                    t.life = int(rec.get("life", 0))
+                    t.prefix = [int(x) for x in rec.get("prefix", [])]
+            elif ev == "final":
+                tickets.pop(rec["request_id"], None)
+        self._tickets = tickets
+        # drill/runbook surface: every ticket rebuilt from the journal,
+        # kept addressable even after finalization pops it in-flight
+        self.recovered_tickets = dict(tickets)
+        alive = {h.index for h in self._replicas if h.state != DEAD}
+        finalized = readmitted = 0
+        for t in list(tickets.values()):
+            payload = self._read_done(t)
+            if payload is not None:
+                # finished during the gap: stitch from the store, no
+                # token ever re-decoded
+                self._finalize_from_payload(t, payload)
+                self._c_recovered.inc(outcome="finalized")
+                finalized += 1
+                continue
+            if t.assigned is not None and t.assigned in alive:
+                continue  # its adopted replica still owns it
+            emitted = self._read_prog(t)
+            self._readmit(t, emitted,
+                          from_replica=(-1 if t.assigned is None
+                                        else t.assigned),
+                          t_detect=time.monotonic(),
+                          reason="coordinator_recover")
+            self._c_recovered.inc(outcome="readmitted")
+            readmitted += 1
+        self.recovery.update(finalized=finalized,
+                             readmitted=readmitted,
+                             in_flight=len(self._tickets))
+        self.journal.append({
+            "event": "recover_summary",
+            "incarnation": self.incarnation,
+            "gap_s": round(self.gap_s, 3), **{
+                k: self.recovery[k] for k in
+                ("adopted", "respawned", "finalized", "readmitted")}})
+
+    # -- client surface --------------------------------------------------
+
+    def submit(self, prompt, max_new_tokens: int, *,
+               request_id: Optional[str] = None) -> ProcTicket:
+        """Admit once fleet-wide; journaled BEFORE dispatch so no
+        coordinator death can lose it. Unplaceable requests (no READY
+        replica yet, store blip) stay pending and are re-placed by the
+        next poll — the process fleet queues, it does not reject."""
+        prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
+        ticket = ProcTicket(
+            request_id
+            or f"preq-{self.incarnation}-{next(_ids)}",
+            prompt, int(max_new_tokens))
+        with self._lock:
+            self._tickets[ticket.request_id] = ticket
+            try:
+                self.journal.append({
+                    "event": "submit",
+                    "request_id": ticket.request_id,
+                    "prompt": ticket.prompt,
+                    "max_new_tokens": ticket.max_new_tokens})
+            except (OSError, TimeoutError):
+                failure.count_store_error("coord_journal")
+            self._place(ticket)
+        return ticket
+
+    def _place(self, ticket: ProcTicket) -> Optional[int]:
+        """One placement attempt through the shared router choke
+        point; journal-then-dispatch. Returns the replica index, None
+        when nothing is READY (ticket stays pending)."""
+        remaining = ticket.max_new_tokens - len(ticket.prefix)
+        total = len(ticket.prompt) + len(ticket.prefix) + remaining
+        h = self.router.place(self._replicas, total)
+        if h is None:
+            ticket.assigned = None
+            return None
+        rec = {"request_id": ticket.request_id,
+               "prompt": ticket.prompt + ticket.prefix,
+               "max_new_tokens": remaining,
+               "life": ticket.life}
+        try:
+            self.journal.append({
+                "event": "place", "request_id": ticket.request_id,
+                "replica": h.index, "life": ticket.life,
+                "prefix": ticket.prefix})
+            k = self._ns.add(f"reqn/{h.index}", 1) - 1
+            self._ns.set(f"req/{h.index}/{k}",
+                         json.dumps(rec, sort_keys=True).encode())
+        except (OSError, TimeoutError):
+            failure.count_store_error("coord_place")
+            ticket.assigned = None
+            return None
+        ticket.assigned = h.index
+        # optimistic queue-depth bump so a burst of placements between
+        # gauge refreshes doesn't pile onto one replica
+        h.engine.scheduler.queue_depth += 1
+        return h.index
+
+    def generate(self, prompt, max_new_tokens: int,
+                 timeout: Optional[float] = None):
+        ticket = self.submit(prompt, max_new_tokens)
+        if not self._started:
+            deadline = (None if timeout is None
+                        else time.monotonic() + timeout)
+            while not ticket.done.is_set():
+                self.poll()
+                if deadline is not None and time.monotonic() > deadline:
+                    break
+                time.sleep(self._poll_interval)
+        return ticket.result(timeout)
+
+    # -- supervision -----------------------------------------------------
+
+    def start(self) -> "ProcessFleet":
+        if self._started:
+            return self
+        self._started = True
+        self._sup_thread = threading.Thread(
+            target=self._supervise, name="procfleet-supervisor",
+            daemon=True)
+        self._sup_thread.start()
+        return self
+
+    def _supervise(self) -> None:
+        while not self._sup_stop.wait(self._poll_interval):
+            try:
+                self.poll()
+            except chaos.CoordinatorKillError:
+                self._die("chaos:kill_coordinator")
+                return
+            except Exception:
+                log.exception("procfleet poll failed")
+
+    def _die(self, reason: str) -> None:
+        """Coordinator death (chaos drill / :meth:`abandon`): beats and
+        supervision stop, worker PROCESSES are left running — exactly
+        the wreckage :meth:`recover_from` must take over."""
+        self.dead = True
+        flight.record("fleet", "coordinator_down",
+                      note=f"inc={self.incarnation} {reason}")
+        log.warning("procfleet coordinator %d down: %s",
+                    self.incarnation, reason)
+
+    def abandon(self) -> None:
+        """Drill helper: die like a crashed coordinator (no worker
+        teardown, no store cleanup, journals left mid-sentence)."""
+        self._sup_stop.set()
+        if self._sup_thread is not None:
+            self._sup_thread.join(timeout=5.0)
+            self._sup_thread = None
+        self._die("abandoned")
+
+    def poll(self) -> None:
+        """One supervision pass. The chaos hook runs OUTSIDE the store
+        try-block: an injected coordinator kill must escape; a store
+        partition must not."""
+        with self._lock:
+            chaos.on_coordinator_poll()
+            if self.dead:
+                return
+            try:
+                self._ns.set("coord/beat", repr(time.time()).encode())
+                self._refresh_gauges()
+                self._check_exits()
+                self._check_stale()
+                self._restart_due()
+                self._retry_unplaced()
+                self._check_progress()
+                self._reap_retiring()
+                if self._helm is not None:
+                    d = self._helm.step()
+                    if d is not None:
+                        self.helm_journal.append_line(d.as_json())
+            except (OSError, TimeoutError):
+                # partition window: absorb, retry next tick
+                failure.count_store_error("coord_poll")
+
+    def _refresh_gauges(self) -> None:
+        for h in self._replicas:
+            if h.state == DEAD:
+                continue
+            try:
+                if not self._ns.check(f"gauge/{h.index}"):
+                    continue
+                g = json.loads(self._ns.get(
+                    f"gauge/{h.index}", timeout_ms=500).decode())
+            except (OSError, TimeoutError, ValueError):
+                failure.count_store_error("coord_gauge")
+                continue
+            sched = h.engine.scheduler
+            sched.queue_depth = int(g.get("queue_depth", 0))
+            sched.max_queue = max(int(g.get("max_queue", 1)), 1)
+            sched.pool.free_blocks = int(g.get("free_blocks", 0))
+            sched.pool.num_blocks = max(int(g.get("num_blocks", 1)), 1)
+            sched.pool.block_size = max(int(g.get("block_size", 1)), 1)
+            h.gauge_round = int(g.get("round", 0))
+            if h.state == STARTING and not h.retiring:
+                # join gate: a worker publishing gauges is live and
+                # serving — routable from here on
+                self._set_state(h, READY, reason="join:gauge")
+
+    def _check_exits(self) -> None:
+        for h in self._replicas:
+            if h.state == DEAD:
+                continue
+            code = self._proc_exit_code(h)
+            if code is None:
+                if (h.state == STARTING and time.monotonic()
+                        - h.spawned_at > self._join_timeout):
+                    self._fail_replica(h, kind="hang",
+                                       reason="join_timeout")
+                continue
+            if h.retiring:
+                continue  # _reap_retiring credits the drain
+            if code in (0, failure.GRACEFUL_EXIT_CODE):
+                # drained outside a retire (SIGTERM from outside):
+                # free restart, like any preemption
+                self._fail_replica(h, kind="preempt",
+                                   reason="preempt:graceful_exit",
+                                   code=code)
+            else:
+                self._fail_replica(h, kind="crash",
+                                   reason=f"crash:exit={code}",
+                                   code=code)
+
+    def _check_stale(self) -> None:
+        # READY/DRAINING replicas whose process runs but whose beat
+        # went stale (wedged decode loop, suppressed watchdog).
+        # STARTING replicas are join_timeout's business — their stale
+        # pre-restart beat must not re-kill a booting worker.
+        alive = {h.index for h in self._replicas
+                 if h.state in (READY, DRAINING)
+                 and self._proc_exit_code(h) is None}
+        if not alive or self._detector is None:
+            return
+        by_index = {h.index: h for h in self._replicas}
+        for idx in self._detector.stale_ranks(alive=alive):
+            self._fail_replica(by_index[idx], kind="hang",
+                               reason="hang:heartbeat_stale")
+
+    def _fail_replica(self, h: ProcReplica, *, kind: str, reason: str,
+                      code: Optional[int] = None) -> None:
+        stranded = [t for t in self._tickets.values()
+                    if not t.done.is_set() and t.assigned == h.index]
+        ids = [t.request_id for t in stranded]
+        self._set_state(h, DEAD, reason=reason)
+        if h.proc is not None and h.proc.poll() is None:
+            h.proc.kill()  # a declared-dead wedged worker gets no vote
+        elif h.proc is None and h.pid is not None and kind == "hang":
+            try:
+                os.kill(h.pid, 9)
+            except (OSError, ProcessLookupError):
+                pass
+        flight.record("fleet", "replica_down",
+                      note=f"{h.name} reason={reason} "
+                           f"stranded={','.join(ids)}")
+        flight.dump_now(f"replica_down:{h.name}", force=True)
+        watchtower.on_replica_down(h.index, reason, ids)
+        if self.metrics is not None:
+            self.metrics.emit("fleet_replica_down", replica=h.index,
+                              reason=reason, stranded=ids)
+        t_detect = time.monotonic()
+        for t in stranded:
+            payload = self._read_done(t)
+            if payload is not None:  # it actually finished first
+                self._finalize_from_payload(t, payload)
+                continue
+            self._readmit(t, self._read_prog(t), from_replica=h.index,
+                          t_detect=t_detect, reason=reason)
+        duration = time.monotonic() - h.spawned_at
+        decision = h.policy.on_exit(
+            reason=kind, code=(code if code is not None
+                               else chaos.CRASH_EXIT_CODE),
+            duration_s=duration, beat_seen=True)
+        if decision.action == "restart" and not h.retiring:
+            h.restart_at = time.monotonic() + decision.delay_s
+        else:
+            h.restart_at = None
+            h.stop_reason = decision.why
+        self._write_members()
+
+    def _read_prog(self, t: ProcTicket) -> list[int]:
+        """Life-checked progress read: tokens a dead life emitted. A
+        record from an OLDER life must be ignored — its tokens are
+        already inside ``t.prefix`` and counting them twice is exactly
+        the duplicate-emission bug the life field exists to stop."""
+        try:
+            if not self._ns.check(f"prog/{t.request_id}"):
+                return []
+            p = json.loads(self._ns.get(
+                f"prog/{t.request_id}", timeout_ms=500).decode())
+        except (OSError, TimeoutError, ValueError):
+            failure.count_store_error("coord_prog")
+            return []
+        if int(p.get("life", -1)) != t.life:
+            return []
+        return [int(x) for x in p.get("tokens", [])]
+
+    def _read_done(self, t: ProcTicket) -> Optional[dict]:
+        try:
+            if not self._ns.check(f"done/{t.request_id}"):
+                return None
+            p = json.loads(self._ns.get(
+                f"done/{t.request_id}", timeout_ms=500).decode())
+        except (OSError, TimeoutError, ValueError):
+            failure.count_store_error("coord_done")
+            return None
+        return p if int(p.get("life", -1)) == t.life else None
+
+    def _readmit(self, t: ProcTicket, emitted: list[int], *,
+                 from_replica: int, t_detect: float,
+                 reason: str) -> None:
+        t.prefix.extend(emitted)
+        t.life += 1
+        if len(t.prefix) >= t.max_new_tokens:
+            self._finalize_from_payload(
+                t, {"life": t.life, "status": "done", "tokens": []})
+            return
+        self.failovers += 1
+        placed = self._place(t)
+        fo = dict(from_replica=from_replica,
+                  to_replica=(-1 if placed is None else placed),
+                  reason=reason,
+                  readmit_s=round(time.monotonic() - t_detect, 6),
+                  prefix_tokens=len(t.prefix))
+        t.failovers.append(fo)
+        flight.record("fleet", "readmit",
+                      note=f"{t.request_id} r{from_replica}->"
+                           f"r{fo['to_replica']} "
+                           f"prefix={len(t.prefix)}")
+        if self.metrics is not None:
+            self.metrics.emit("fleet_failover",
+                              request_id=t.request_id, **fo)
+
+    def _restart_due(self) -> None:
+        now = time.monotonic()
+        for h in self._replicas:
+            if (h.state == DEAD and not h.retiring
+                    and h.restart_at is not None
+                    and now >= h.restart_at):
+                self._set_state(h, STARTING,
+                                reason=f"restart #{h.incarnations}")
+                h.proc = None
+                h.pid = None
+                h.adopted = False
+                self._launch(h)
+                self._write_members()
+
+    def _retry_unplaced(self) -> None:
+        for t in self._tickets.values():
+            if not t.done.is_set() and t.assigned is None:
+                self._place(t)
+
+    def _check_progress(self) -> None:
+        """Finalize finished requests; stamp first-token times."""
+        for t in list(self._tickets.values()):
+            if t.done.is_set() or t.assigned is None:
+                continue
+            payload = self._read_done(t)
+            if payload is not None:
+                self._finalize_from_payload(t, payload)
+                continue
+            if t.t_first_token == 0.0 and (t.prefix
+                                           or self._read_prog(t)):
+                t.t_first_token = time.monotonic()
+
+    def _finalize_from_payload(self, t: ProcTicket,
+                               payload: dict) -> None:
+        status = payload.get("status", "done")
+        tail = [int(x) for x in payload.get("tokens", [])]
+        if status == "done":
+            t.tokens = np.asarray(t.prefix + tail, np.int32)
+            t.status = "done"
+            if t.t_first_token == 0.0:
+                t.t_first_token = time.monotonic()
+        else:
+            t.status = "rejected"
+            t.reject_reason = payload.get("reason", status)
+        t.t_done = time.monotonic()
+        rec = dict(request_id=t.request_id,
+                   prompt_len=len(t.prompt),
+                   new_tokens=(len(t.tokens)
+                               if t.tokens is not None else 0),
+                   status=t.status,
+                   ttft_s=round(t.ttft_s, 6),
+                   total_s=round(t.t_done - t.t_submit, 6),
+                   replica=(f"p{t.assigned}"
+                            if t.assigned is not None else ""),
+                   failovers=t.failovers)
+        if t.status == "done":
+            self.completed.append(rec)
+        try:
+            self.journal.append({"event": "final",
+                                 "request_id": t.request_id,
+                                 "status": t.status,
+                                 "new_tokens": rec["new_tokens"],
+                                 "life": t.life})
+        except (OSError, TimeoutError):
+            failure.count_store_error("coord_journal")
+        self._tickets.pop(t.request_id, None)
+        t.done.set()
+
+    # -- elastic scaling -------------------------------------------------
+
+    def scale_to(self, n: int, *, reason: str = "") -> dict:
+        """Helm's actuator, process edition: up spawns fresh indexes
+        (join gate: STARTING until the first gauge lands), down drains
+        the highest non-retiring slots through ``ctl/<idx>=drain`` —
+        the worker finishes everything it holds, exits
+        ``GRACEFUL_EXIT_CODE``, and a later poll reaps it."""
+        n = int(n)
+        if n < 1:
+            raise ValueError(f"scale_to: n must be >= 1, got {n}")
+        with self._lock:
+            current = [h for h in self._replicas
+                       if not h.retiring and h.state != DEAD]
+            delta = n - len(current)
+            added, retiring = 0, 0
+            if delta > 0:
+                for _ in range(delta):
+                    self._spawn_new(reason="scale_up")
+                    added += 1
+            elif delta < 0:
+                doomed = sorted(current, key=lambda r: -r.index)
+                for h in doomed[:-delta]:
+                    h.retiring = True
+                    h.restart_at = None
+                    self._set_state(h, DRAINING, reason="scale_down")
+                    try:
+                        self._ns.set(f"ctl/{h.index}", b"drain")
+                    except (OSError, TimeoutError):
+                        failure.count_store_error("coord_ctl")
+                    retiring += 1
+            self._target_replicas = n
+            flight.record(
+                "fleet", "scale_to",
+                note=f"target={n} added={added} retiring={retiring}"
+                     + (f" {reason}" if reason else ""))
+            if self.metrics is not None:
+                self.metrics.emit("fleet_scale", target=n, added=added,
+                                  retiring=retiring, reason=reason)
+            self._write_members()
+            self._rebuild_detector()
+        return dict(target=n, added=added, retiring=retiring)
+
+    def _reap_retiring(self) -> None:
+        done = [h for h in self._replicas if h.retiring
+                and (h.state == DEAD
+                     or self._proc_exit_code(h) is not None)]
+        if not done:
+            return
+        for h in done:
+            if h.state != DEAD:
+                h.policy.on_exit(
+                    reason="preempt", code=failure.GRACEFUL_EXIT_CODE,
+                    duration_s=time.monotonic() - h.spawned_at,
+                    beat_seen=True)
+            self._replicas.remove(h)
+            flight.record("fleet", "retired", note=h.name)
+        self._write_members()
+        self._rebuild_detector()
+
+    # -- shutdown --------------------------------------------------------
+
+    def stop(self, timeout: float = 10.0) -> None:
+        if self._sup_thread is not None:
+            self._sup_stop.set()
+            self._sup_thread.join(timeout=5.0)
+            self._sup_thread = None
+        self._started = False
+        for h in self._replicas:
+            try:
+                self._ns.set(f"ctl/{h.index}", b"stop")
+            except (OSError, TimeoutError):
+                failure.count_store_error("coord_ctl")
+        deadline = time.monotonic() + timeout
+        for h in self._replicas:
+            if h.proc is None:
+                continue
+            try:
+                h.proc.wait(max(deadline - time.monotonic(), 0.1))
+            except subprocess.TimeoutExpired:
+                h.proc.kill()
+                h.proc.wait(timeout=5.0)
+        for h in self._replicas:
+            if h.proc is None and h.pid is not None:
+                try:
+                    os.kill(h.pid, 15)
+                except (OSError, ProcessLookupError):
+                    pass
+        try:
+            self._client.close()
+        except OSError:
+            pass
+        if self._owns_server and self._server is not None:
+            self._server.stop()
+
+    def __enter__(self) -> "ProcessFleet":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- introspection ---------------------------------------------------
+
+    @property
+    def replicas(self) -> list[ProcReplica]:
+        return list(self._replicas)
+
+    @property
+    def live_replicas(self) -> int:
+        return sum(1 for h in self._replicas if h.state == READY)
+
+    @property
+    def target_replicas(self) -> int:
+        return self._target_replicas
+
+    def wait_ready(self, n: int, timeout: float = 60.0) -> bool:
+        """Block until ``n`` replicas are READY (driving poll() itself
+        when the supervisor thread isn't running)."""
+        deadline = time.monotonic() + timeout
+        while time.monotonic() < deadline:
+            if not self._started:
+                self.poll()
+            if self.live_replicas >= n:
+                return True
+            time.sleep(self._poll_interval)
+        return self.live_replicas >= n
+
+    def wait_all(self, tickets, timeout: float = 60.0) -> bool:
+        deadline = time.monotonic() + timeout
+        for t in tickets:
+            if not t.wait(max(deadline - time.monotonic(), 0.01)):
+                return False
+        return True
+
+    def summary(self) -> dict:
+        per_replica = []
+        for h in self._replicas:
+            per_replica.append(dict(
+                replica=h.name, state=h.state, pid=h.pid,
+                adopted=h.adopted, incarnations=h.incarnations,
+                budget_restarts=h.policy.budget_restarts,
+                preempt_restarts=h.policy.preempt_restarts,
+                stop_reason=h.stop_reason))
+        return dict(
+            coordinator_incarnation=self.incarnation,
+            gap_s=round(self.gap_s, 3),
+            replicas=len(self._replicas),
+            live=self.live_replicas,
+            requests_done=len(self.completed),
+            in_flight=len(self._tickets),
+            failovers=self.failovers,
+            tokens_out=int(sum(r["new_tokens"]
+                               for r in self.completed)),
+            recovery=dict(self.recovery),
+            per_replica=per_replica,
+        )

@@ -1,0 +1,224 @@
+"""Fleet store layer: one key-value surface, three transports.
+
+The serving fleet's control plane — heartbeats, request dispatch,
+progress, the coordinator's journals — speaks one tiny store protocol
+(the slice of c10d TCPStore the stack already standardized on for
+rendezvous):
+
+``set(key, bytes)`` / ``get(key, timeout_ms=...)`` (blocking wait) /
+``add(key, delta) -> int`` (atomic counter) / ``check(key)`` /
+``delete(key)`` / ``close()``.
+
+Three implementations satisfy it:
+
+- :class:`runtime.native.StoreClient` — the real C++ store
+  (native/store.cpp), one TCP connection per client. Production and
+  the process-backed fleet (:mod:`serve.procfleet`) use this.
+- :class:`MemStore` — the in-process stand-in the thread-backed
+  :class:`serve.fleet.Fleet` runs on. Full surface parity with the
+  real client is CONTRACTUAL (tests/test_store_parity.py drives both
+  through identical sequences), including the
+  :func:`runtime.chaos.on_store_op` passthrough — ``store_flaky`` /
+  ``store_partition`` chaos hits the stub exactly like the wire.
+- :class:`PrefixStore` — the c10d ``PrefixStore`` idiom: a namespacing
+  wrapper over either of the above, so one physical store hosts many
+  logical ones (``fleet0/hb/0/3``, ``fleet0/journal/7``) and the REAL
+  ``HeartbeatReporter`` / ``FailureDetector`` run unmodified against a
+  namespaced view. The namespace is fixed per deployment — replica and
+  coordinator incarnation bumps happen *inside* it, so recovery never
+  has to guess a key prefix.
+
+:class:`StoreJournal` layers the append-only journal the coordinator's
+crash story rests on: entries at ``<name>/<seq>`` with ``<seq>``
+allocated by the store's atomic counter, values canonical
+``sort_keys`` JSON (or pre-serialized lines, for byte-continuity with
+``serve.autoscale.Decision.as_json``). Append-only by construction —
+recovery replays it; nothing ever rewrites it.
+
+Stdlib-only on purpose (no jax, no numpy): worker subprocesses import
+this before deciding whether to touch a backend at all.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+from pytorch_distributed_nn_tpu.runtime import chaos
+
+
+class MemStore:
+    """In-process store with FULL :class:`runtime.native.StoreClient`
+    surface parity — blocking ``get`` with timeout, atomic ``add``,
+    ``delete`` — and the same chaos injection point on every op, so
+    the thread-backed fleet and the store-parity suite exercise the
+    exact protocol the wire speaks."""
+
+    def __init__(self) -> None:
+        self._d: dict[str, bytes] = {}
+        self._counters: dict[str, int] = {}
+        self._cond = threading.Condition()
+
+    def set(self, key: str, value: bytes) -> None:
+        chaos.on_store_op("set", key)  # store_flaky injection point
+        with self._cond:
+            self._d[key] = bytes(value)
+            self._cond.notify_all()
+
+    def get(self, key: str, *, timeout_ms: int = -1,
+            max_bytes: int = 1 << 20) -> bytes:
+        """Blocking wait for ``key`` (timeout_ms < 0 waits forever) —
+        the real client's wait semantics, not a dict lookup."""
+        chaos.on_store_op("get", key)  # store_flaky injection point
+        deadline = (None if timeout_ms < 0
+                    else time.monotonic() + timeout_ms / 1000.0)
+        with self._cond:
+            while key not in self._d:
+                if deadline is None:
+                    self._cond.wait()
+                else:
+                    left = deadline - time.monotonic()
+                    if left <= 0 or not self._cond.wait(left):
+                        if key in self._d:
+                            break
+                        raise TimeoutError(
+                            f"store get({key!r}) timed out")
+            return self._d[key]
+
+    def add(self, key: str, delta: int = 1) -> int:
+        chaos.on_store_op("add", key)  # store_flaky injection point
+        with self._cond:
+            # counter keys mirror the native store: numeric state kept
+            # apart from blobs, readable back through get() as ASCII
+            value = self._counters.get(key, 0) + int(delta)
+            self._counters[key] = value
+            self._d[key] = str(value).encode()
+            self._cond.notify_all()
+            return value
+
+    def check(self, key: str) -> bool:
+        chaos.on_store_op("check", key)  # store_flaky injection point
+        with self._cond:
+            return key in self._d
+
+    def delete(self, key: str) -> None:
+        chaos.on_store_op("delete", key)  # store_flaky injection point
+        with self._cond:
+            self._d.pop(key, None)
+            self._counters.pop(key, None)
+
+    def close(self) -> None:
+        pass
+
+
+class PrefixStore:
+    """Key-namespacing view over any store (the c10d ``PrefixStore``
+    idiom): every key gets ``<prefix>/`` prepended on the way down.
+    Store users (heartbeats, journals) stay namespace-blind.
+
+    ``close()`` is a no-op unless this wrapper ``owns`` the underlying
+    client: the common shape is many logical views over ONE shared
+    connection (the coordinator), and a reporter stopping must not
+    yank the socket out from under its siblings.
+    """
+
+    def __init__(self, store, prefix: str, *, owns: bool = False) -> None:
+        self._store = store
+        self.prefix = prefix.rstrip("/")
+        self._owns = owns
+
+    def _k(self, key: str) -> str:
+        return f"{self.prefix}/{key}"
+
+    def set(self, key: str, value: bytes) -> None:
+        self._store.set(self._k(key), value)
+
+    def get(self, key: str, *, timeout_ms: int = -1,
+            max_bytes: int = 1 << 20) -> bytes:
+        return self._store.get(self._k(key), timeout_ms=timeout_ms,
+                               max_bytes=max_bytes)
+
+    def add(self, key: str, delta: int = 1) -> int:
+        return self._store.add(self._k(key), delta)
+
+    def check(self, key: str) -> bool:
+        return self._store.check(self._k(key))
+
+    def delete(self, key: str) -> None:
+        self._store.delete(self._k(key))
+
+    def close(self) -> None:
+        if self._owns:
+            self._store.close()
+
+
+def make_store(endpoint: str = "mem"):
+    """Store factory behind one endpoint string: ``"mem"`` → a fresh
+    :class:`MemStore`; ``"host:port"`` → a
+    :class:`runtime.native.StoreClient` connection. The fleet CLI and
+    worker entrypoint both take exactly this string."""
+    endpoint = (endpoint or "mem").strip()
+    if endpoint == "mem":
+        return MemStore()
+    host, _, port = endpoint.rpartition(":")
+    if not host or not port.isdigit():
+        raise ValueError(
+            f"store endpoint must be 'mem' or 'host:port', "
+            f"got {endpoint!r}")
+    from pytorch_distributed_nn_tpu.runtime import native
+
+    return native.StoreClient(host, int(port))
+
+
+class StoreJournal:
+    """Append-only journal through the store: ``<name>/<seq>`` entries,
+    ``<seq>`` from the store's atomic counter at ``<name>/next`` —
+    writers on any host append without coordination, and a recovering
+    coordinator replays the whole stream in order.
+
+    A writer that dies between the counter bump and the entry write
+    leaves a hole; :meth:`read_lines` skips it (bounded wait) and
+    reports it, so recovery is never wedged by exactly the crash it
+    exists to survive."""
+
+    def __init__(self, store, name: str) -> None:
+        self._store = store
+        self.name = name
+        self.holes = 0
+
+    def append(self, rec: dict) -> int:
+        """Canonical-JSON append (sort_keys — the byte-determinism
+        contract every journal in this codebase follows)."""
+        return self.append_line(json.dumps(rec, sort_keys=True))
+
+    def append_line(self, line: str) -> int:
+        """Pre-serialized append — :class:`serve.autoscale.Decision`
+        journals its own ``as_json()`` bytes so the persisted stream
+        is byte-identical to the in-memory one."""
+        seq = self._store.add(f"{self.name}/next", 1) - 1
+        self._store.set(f"{self.name}/{seq}", line.encode())
+        return seq
+
+    def __len__(self) -> int:
+        return self._store.add(f"{self.name}/next", 0)
+
+    def read_lines(self, *, entry_timeout_ms: int = 2000) -> list[str]:
+        """Every journal line, in append order. A missing entry under
+        an advanced counter (writer died mid-append) is skipped after
+        ``entry_timeout_ms`` and counted in :attr:`holes`."""
+        n = len(self)
+        out: list[str] = []
+        self.holes = 0
+        for seq in range(n):
+            try:
+                out.append(self._store.get(
+                    f"{self.name}/{seq}",
+                    timeout_ms=entry_timeout_ms).decode())
+            except (TimeoutError, KeyError):
+                self.holes += 1
+        return out
+
+    def read_all(self, *, entry_timeout_ms: int = 2000) -> list[dict]:
+        return [json.loads(line) for line in
+                self.read_lines(entry_timeout_ms=entry_timeout_ms)]

@@ -1,0 +1,47 @@
+"""Deterministic stub decoder for no-backend fleet drills.
+
+The coordinator-restart and failover drills need a "model" with one
+property and one property only: greedy decode must be a **pure function
+of the token prefix**, exactly like the real engine's greedy path —
+because that is the invariant the fleet's stitched re-admission leans
+on (prompt + emitted-so-far re-fed as the new prompt reproduces the
+continuation bit-for-bit). A rolling-hash next-token rule gives us that
+with zero backend: any worker, any process, any incarnation decodes the
+identical stream for the same prefix.
+
+Used by ``serve/fleet_worker.py --backend stub`` (the tier-1
+coordinator-restart drill) and by the drills' uninterrupted-reference
+computation. Stdlib-only.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable
+
+STUB_VOCAB = 4099  # prime: every hash bit lands in the token stream
+
+
+def stub_next_token(prefix: Iterable[int],
+                    vocab: int = STUB_VOCAB) -> int:
+    """Next greedy token for a sequence prefix: an LCG-style rolling
+    hash over the WHOLE prefix — suffix-sensitive, so a wrong stitch
+    (dropped/duplicated token anywhere) derails every later token and
+    the bit-identical assertions actually bite."""
+    h = 0x811C9DC5
+    for t in prefix:
+        h = (h * 1103515245 + int(t) + 12345) & 0x7FFFFFFF
+    return h % vocab
+
+
+def stub_decode(prompt: Iterable[int], max_new_tokens: int,
+                vocab: int = STUB_VOCAB) -> list[int]:
+    """The uninterrupted reference: decode ``max_new_tokens`` from
+    ``prompt`` in one life. Drills diff stitched fleet output against
+    exactly this."""
+    seq = [int(t) for t in prompt]
+    out: list[int] = []
+    for _ in range(int(max_new_tokens)):
+        t = stub_next_token(seq, vocab)
+        out.append(t)
+        seq.append(t)
+    return out

@@ -1,0 +1,180 @@
+#!/usr/bin/env python
+"""Operate a process-backed serving fleet (serve/procfleet.py).
+
+The multi-host deployment loop (docs/serving.md has the full runbook):
+
+    # host A: the store every fleet word travels through
+    python scripts/fleet_deploy.py store --port 7777
+
+    # host B: coordinator + replica subprocesses
+    python scripts/fleet_deploy.py start --store hostA:7777 \
+        --replicas 3 --backend tiny --autoscale 1
+
+    # host B died? any host: take over WITHOUT restarting workers —
+    # live replicas are adopted pid-for-pid, stranded requests are
+    # re-admitted with their emitted prefix, Helm's journal continues
+    python scripts/fleet_deploy.py recover --store hostA:7777
+
+    # anywhere: what does the store say the fleet looks like?
+    python scripts/fleet_deploy.py status --store hostA:7777
+
+``start``/``recover`` run until SIGINT/SIGTERM, then drain and stop.
+``status`` is read-only: one JSON object from the store's own state
+(membership, coordinator beat age, journal depths) — exactly what a
+recovering coordinator would see.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import time
+
+sys.path.insert(0, ".")  # run from repo root without install
+
+from pytorch_distributed_nn_tpu.runtime.platform import (
+    apply_platform_overrides,
+)
+
+apply_platform_overrides()
+
+
+def _cmd_store(args) -> int:
+    from pytorch_distributed_nn_tpu.runtime import native
+
+    server = native.StoreServer(args.port)
+    print(json.dumps({"event": "store_up", "port": server.port}),
+          flush=True)
+    stop = []
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *a: stop.append(1))
+    try:
+        while not stop:
+            time.sleep(0.5)
+    finally:
+        server.stop()
+    return 0
+
+
+def _run_fleet(fleet) -> int:
+    stop = []
+    for sig in (signal.SIGINT, signal.SIGTERM):
+        signal.signal(sig, lambda *a: stop.append(1))
+    fleet.start()
+    try:
+        while not stop and not fleet.dead:
+            time.sleep(0.5)
+    finally:
+        summary = fleet.summary()
+        if not fleet.dead:
+            fleet.stop()
+        print(json.dumps({"event": "fleet_exit",
+                          "coordinator_dead": fleet.dead,
+                          **summary}, sort_keys=True), flush=True)
+    # a dead coordinator is an incident, not a clean exit — the
+    # operator (or a supervisor) should run `recover` next
+    return 1 if fleet.dead else 0
+
+
+def _cmd_start(args) -> int:
+    from pytorch_distributed_nn_tpu.serve.procfleet import ProcessFleet
+
+    fleet = ProcessFleet(
+        replicas=args.replicas, backend=args.backend,
+        namespace=args.namespace, store_endpoint=args.store or None,
+        autoscale_spec=args.autoscale,
+        heartbeat_timeout_s=args.heartbeat_timeout)
+    print(json.dumps({"event": "coordinator_up", "mode": "fresh",
+                      "incarnation": fleet.incarnation,
+                      "store": fleet.store_endpoint,
+                      "namespace": args.namespace}), flush=True)
+    return _run_fleet(fleet)
+
+
+def _cmd_recover(args) -> int:
+    from pytorch_distributed_nn_tpu.serve.procfleet import ProcessFleet
+
+    if not args.store:
+        print("error: recover needs --store (the fleet's state lives "
+              "there, not here)", file=sys.stderr)
+        return 2
+    fleet = ProcessFleet.recover_from(
+        store_endpoint=args.store, namespace=args.namespace,
+        backend=args.backend, autoscale_spec=args.autoscale,
+        heartbeat_timeout_s=args.heartbeat_timeout)
+    print(json.dumps({"event": "coordinator_up", "mode": "recover",
+                      "incarnation": fleet.incarnation,
+                      "gap_s": round(fleet.gap_s, 3),
+                      "recovery": fleet.recovery,
+                      "store": fleet.store_endpoint,
+                      "namespace": args.namespace},
+                     sort_keys=True), flush=True)
+    return _run_fleet(fleet)
+
+
+def _cmd_status(args) -> int:
+    from pytorch_distributed_nn_tpu.serve.store import (
+        PrefixStore, StoreJournal, make_store,
+    )
+
+    if not args.store:
+        print("error: status needs --store", file=sys.stderr)
+        return 2
+    client = make_store(args.store)
+    ns = PrefixStore(client, args.namespace)
+    out: dict = {"store": args.store, "namespace": args.namespace}
+    members = []
+    if ns.check("members"):
+        members = json.loads(ns.get("members", timeout_ms=2000).decode())
+    out["members"] = members
+    out["coordinator_incarnations"] = ns.add("coord/inc", 0)
+    if ns.check("coord/beat"):
+        out["coordinator_beat_age_s"] = round(
+            time.time() - float(ns.get("coord/beat", timeout_ms=2000)),
+            3)
+    out["journal_len"] = len(StoreJournal(ns, "journal"))
+    out["helm_journal_len"] = len(StoreJournal(ns, "helm"))
+    beats = {}
+    for m in members:
+        key = f"hb/0/{m['index']}"
+        if ns.check(key):
+            beats[str(m["index"])] = round(
+                time.time() - float(ns.get(key, timeout_ms=2000)), 3)
+    out["beat_age_s"] = beats
+    client.close()
+    print(json.dumps(out, sort_keys=True))
+    return 0
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    sub = ap.add_subparsers(dest="cmd", required=True)
+    p_store = sub.add_parser("store", help="run a standalone native "
+                                           "store server")
+    p_store.add_argument("--port", type=int, default=0,
+                         help="listen port (0 = ephemeral, printed)")
+    for name in ("start", "recover", "status"):
+        p = sub.add_parser(name)
+        p.add_argument("--store", default="",
+                       help="store endpoint host:port (start only: "
+                            "empty = own an in-process server)")
+        p.add_argument("--namespace", default="fleet")
+        if name != "status":
+            p.add_argument("--backend", choices=("stub", "tiny"),
+                           default="tiny")
+            p.add_argument("--autoscale", default="",
+                           help="TPUNN_AUTOSCALE-grammar Helm spec "
+                                "(empty = no autoscaler)")
+            p.add_argument("--heartbeat-timeout", type=float,
+                           default=5.0)
+        if name == "start":
+            p.add_argument("--replicas", type=int, default=2)
+    args = ap.parse_args()
+    return {"store": _cmd_store, "start": _cmd_start,
+            "recover": _cmd_recover, "status": _cmd_status}[args.cmd](args)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

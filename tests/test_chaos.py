@@ -431,3 +431,66 @@ def test_hang_replica_sleeps_and_emits_first():
     assert len(events) == 1 and events[0]["op"] == "hang_replica"
     eng.replica_round(0, 2)  # fire-once
     assert len(_chaos_ring_events()) == 1
+
+
+# ---------------------------------------------------------------------------
+# kill_coordinator / store_partition (ISSUE 13: process-fleet faults)
+# ---------------------------------------------------------------------------
+
+
+def test_kill_coordinator_fires_once_after_fuse():
+    eng = chaos.ChaosEngine(
+        chaos.parse_spec("kill_coordinator@after_s=30"), rank=0)
+    eng.coordinator_poll()  # fuse not burned: inert
+    assert _chaos_ring_events() == []
+    eng._t0 -= 31.0  # pretend the engine armed 31s ago
+    with pytest.raises(chaos.CoordinatorKillError):
+        eng.coordinator_poll()
+    events = _chaos_ring_events()
+    assert len(events) == 1 and events[0]["op"] == "kill_coordinator"
+    eng.coordinator_poll()  # fire-once: the successor polls in peace
+    assert len(_chaos_ring_events()) == 1
+    counter = obs.get_registry().counter("chaos_injected_total")
+    assert counter.value(kind="kill_coordinator") == 1
+
+
+def test_kill_coordinator_requires_after_s():
+    with pytest.raises(ValueError):
+        chaos.parse_spec("kill_coordinator")
+
+
+def test_store_partition_window_opens_and_closes():
+    eng = chaos.ChaosEngine(
+        chaos.parse_spec("store_partition@ms=40"), rank=0)
+    # the window opens on the FIRST eligible op; every op inside the
+    # window raises, ops after it succeed again
+    with pytest.raises(OSError):
+        eng.store_op("set", "hb/0/0")
+    with pytest.raises(OSError):
+        eng.store_op("get", "gauge/1")
+    time.sleep(0.06)
+    eng.store_op("set", "hb/0/0")  # window closed: store is back
+    events = _chaos_ring_events()
+    assert all(e["op"] == "store_partition" for e in events)
+    assert len(events) == 2
+
+
+def test_store_partition_rank_filter_and_after_s():
+    # rank filter: this engine is rank 0, the fault targets rank 1
+    eng = chaos.ChaosEngine(
+        chaos.parse_spec("store_partition@rank=1:ms=40"), rank=0)
+    eng.store_op("set", "k")  # not our rank: inert
+    assert _chaos_ring_events() == []
+    # after_s gates the window opening on wall time since arm
+    eng2 = chaos.ChaosEngine(
+        chaos.parse_spec("store_partition@ms=40:after_s=30"), rank=0)
+    eng2.store_op("set", "k")  # fuse not burned: inert
+    assert _chaos_ring_events() == []
+    eng2._t0 -= 31.0
+    with pytest.raises(OSError):
+        eng2.store_op("set", "k")
+
+
+def test_store_partition_requires_ms():
+    with pytest.raises(ValueError):
+        chaos.parse_spec("store_partition")

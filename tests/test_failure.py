@@ -185,3 +185,42 @@ def test_progress_watchdog_trip_dumps_ring(tmp_path, monkeypatch):
     finally:
         rep.stop()
         flight.reset_recorder()
+
+
+# ---------------------------------------------------------------------------
+# Beat-thread hardening (ISSUE 13): a store outage must not kill the
+# daemon thread — errors are counted, beats resume when the store heals
+# ---------------------------------------------------------------------------
+
+
+def test_beat_thread_survives_store_outage_and_counts_errors():
+    from pytorch_distributed_nn_tpu import obs
+    from pytorch_distributed_nn_tpu.runtime import chaos
+    from pytorch_distributed_nn_tpu.serve.store import MemStore
+
+    obs.reset_registry()
+    chaos.reset()
+    store = MemStore()
+    # arm chaos ONLY after construction: the constructor's synchronous
+    # first beat must land (that is the join gate, and it may raise)
+    rep = failure.HeartbeatReporter(store, rank=0, interval_s=0.01)
+    try:
+        chaos.maybe_init("store_flaky@p=1", rank=0, seed=1)
+        time.sleep(0.15)  # every beat in this window fails
+        assert rep._thread.is_alive(), \
+            "beat thread died on a store error instead of retrying"
+        assert rep.store_errors > 0
+        counted = obs.get_registry().counter(
+            "store_errors_total").value(op="beat")
+        assert counted > 0, "failed beats must be counted, not silent"
+        chaos.reset()
+        before = float(store.get("hb/0/0", timeout_ms=200))
+        deadline = time.time() + 2.0
+        resumed = False
+        while time.time() < deadline and not resumed:
+            time.sleep(0.03)
+            resumed = float(store.get("hb/0/0", timeout_ms=200)) > before
+        assert resumed, "beats did not resume after the store healed"
+    finally:
+        chaos.reset()
+        rep.stop()

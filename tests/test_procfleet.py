@@ -1,0 +1,162 @@
+"""Process-backed fleet (serve/procfleet.py).
+
+Cheap tests cover the pieces that need no subprocess: ticket
+semantics, the gauge duck-types the UNMODIFIED Router scores, and
+constructor validation. The real drills — stub workers over a live
+native store, worker kill + stitched re-admission, coordinator
+abandon + adoption — spawn interpreters and are ``slow`` (tier-1
+already runs the full coordinator-kill drill through the
+``bench.py --fleet --selftest`` smoke in test_quality.py).
+"""
+
+import time
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_nn_tpu.runtime import chaos
+from pytorch_distributed_nn_tpu.serve.procfleet import (
+    ProcReplica,
+    ProcTicket,
+    ProcessFleet,
+)
+from pytorch_distributed_nn_tpu.serve.router import (
+    DRAINING,
+    READY,
+    STARTING,
+    Router,
+)
+from pytorch_distributed_nn_tpu.serve.stub import stub_decode
+
+
+@pytest.fixture(autouse=True)
+def _no_chaos():
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+# -- no-subprocess units ---------------------------------------------------
+
+
+def test_ticket_lifecycle():
+    t = ProcTicket("r0", [3, 1, 4], 8)
+    assert t.prompt == [3, 1, 4] and t.max_new_tokens == 8
+    assert t.status == "pending" and not t.ok
+    assert t.ttft_s == -1.0  # no first token yet
+    assert t.result(timeout=0.01) is None  # pending -> no tokens
+    t.t_first_token = t.t_submit + 0.5
+    assert abs(t.ttft_s - 0.5) < 1e-9
+    t.tokens = np.array([7, 7], dtype=np.int32)
+    t.status = "done"
+    t.done.set()
+    assert t.ok and list(t.result()) == [7, 7]
+
+
+def _handle(index: int, *, state: str, queue_depth: int = 0,
+            free_blocks: int = 4) -> ProcReplica:
+    h = ProcReplica(index, policy=None, max_queue=8, max_slots=4)
+    h.state = state
+    h.engine.scheduler.queue_depth = queue_depth
+    h.engine.scheduler.pool.free_blocks = free_blocks
+    return h
+
+
+def test_router_scores_remote_gauges():
+    """The gauge duck-types (_RemoteEngine et al.) satisfy the exact
+    surface Router._score reads, so the unmodified thread-fleet router
+    places process-fleet requests too."""
+    idle = _handle(0, state=READY, queue_depth=0)
+    busy = _handle(1, state=READY, queue_depth=8)
+    r = Router()
+    assert r.place([busy, idle], total_tokens=2) is idle
+    # non-READY replicas are never candidates
+    assert r.place([_handle(0, state=STARTING),
+                    _handle(1, state=DRAINING)], total_tokens=2) is None
+
+
+def test_constructor_validation():
+    with pytest.raises(ValueError, match="replicas"):
+        ProcessFleet(replicas=0)
+    # workers are subprocesses: an in-process MemStore can't reach them
+    with pytest.raises(ValueError, match="mem"):
+        ProcessFleet(store_endpoint="mem")
+
+
+# -- subprocess drills (slow: spawn real interpreters) ---------------------
+
+
+def _prompts(n):
+    return [[31 + i, 7, 2] for i in range(n)]
+
+
+@pytest.mark.slow
+def test_e2e_stub_bit_identical():
+    with ProcessFleet(replicas=2, backend="stub",
+                      heartbeat_interval_s=0.05,
+                      heartbeat_timeout_s=5.0) as fleet:
+        fleet.start()
+        assert fleet.wait_ready(2, timeout=120)
+        tickets = [fleet.submit(p, 32) for p in _prompts(4)]
+        assert fleet.wait_all(tickets, timeout=60)
+        for p, t in zip(_prompts(4), tickets):
+            assert t.ok and list(t.tokens) == stub_decode(p, 32)
+
+
+@pytest.mark.slow
+def test_worker_kill_failover_stitches():
+    """kill_replica fires inside a worker subprocess mid-request; the
+    coordinator re-admits the stranded work with its emitted prefix and
+    greedy decode keeps the stitched stream bit-identical."""
+    with ProcessFleet(
+            replicas=2, backend="stub",
+            heartbeat_interval_s=0.05, heartbeat_timeout_s=2.0,
+            worker_extra_env={
+                "TPUNN_CHAOS": "kill_replica@replica=1:step=30"},
+    ) as fleet:
+        fleet.start()
+        assert fleet.wait_ready(2, timeout=120)
+        tickets = [fleet.submit(p, 64) for p in _prompts(4)]
+        assert fleet.wait_all(tickets, timeout=120)
+        for p, t in zip(_prompts(4), tickets):
+            assert t.ok and list(t.tokens) == stub_decode(p, 64)
+        assert fleet.failovers >= 1
+
+
+@pytest.mark.slow
+def test_coordinator_abandon_adopt_readmit():
+    """Coordinator replacement without a cold restart: the successor
+    adopts still-beating workers pid-for-pid, re-admits what the
+    journal says was stranded, and the stitched output stays
+    bit-identical."""
+    f1 = ProcessFleet(replicas=2, backend="stub", token_ms=10.0,
+                      heartbeat_interval_s=0.05,
+                      heartbeat_timeout_s=2.0)
+    f2 = None
+    try:
+        f1.start()
+        assert f1.wait_ready(2, timeout=120)
+        for p in _prompts(4):
+            f1.submit(p, 48)
+        time.sleep(0.3)  # let some tokens land before the "crash"
+        pids = sorted(h.pid for h in f1.replicas if h.proc)
+        f1.abandon()  # supervision stops; worker processes live on
+        assert f1.dead
+
+        f2 = ProcessFleet.recover_from(
+            store_endpoint=f1.store_endpoint,
+            heartbeat_interval_s=0.05, heartbeat_timeout_s=2.0)
+        assert f2.incarnation == f1.incarnation + 1
+        adopted = sorted(h.pid for h in f2.replicas if h.adopted)
+        assert adopted == pids  # adoption, not restart
+        f2.start()
+        assert f2.wait_all(f2.recovered_tickets.values(), timeout=120)
+        for p, t in zip(_prompts(4),
+                        f2.recovered_tickets.values()):
+            assert t.ok and list(t.tokens) == stub_decode(p, 48)
+    finally:
+        if f2 is not None:
+            f2.stop()
+        f1._client.close()
+        if f1._server is not None:
+            f1._server.stop()

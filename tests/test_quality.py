@@ -364,6 +364,23 @@ def test_bench_capacity_selftest_smoke():
     assert "capacity selftest ok" in proc.stdout
 
 
+def test_bench_fleet_selftest_smoke():
+    """The coordinator crash-recovery drill (ISSUE 13 tentpole), run
+    exactly as CI would: stub subprocess replicas over a REAL native
+    store, a chaos kill_coordinator mid-flash-crowd, adoption without
+    restart, bit-identical stitched output, and Helm journal
+    continuity across the restart boundary."""
+    repo = Path(__file__).parent.parent
+    proc = subprocess.run(
+        [sys.executable, str(repo / "bench.py"), "--fleet",
+         "--selftest"],
+        capture_output=True, text=True, timeout=300, cwd=repo,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr or proc.stdout
+    assert "fleet selftest ok" in proc.stdout
+
+
 _AUTOSCALE = (Path(__file__).parent.parent
               / "pytorch_distributed_nn_tpu" / "serve" / "autoscale.py")
 

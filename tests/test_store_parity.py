@@ -1,0 +1,154 @@
+"""MemStore <-> native StoreClient parity (ISSUE 13 satellite): one
+parametrized suite drives BOTH backends through the same op sequences,
+so the in-process stand-in can never drift from the wire protocol the
+process fleet actually deploys on. Covers set/get/blocking-wait/
+timeout/add/check/delete, the heartbeat key sequence the supervision
+stack runs (HeartbeatReporter -> FailureDetector), and the chaos
+``store_flaky`` passthrough both backends must honor."""
+
+import threading
+import time
+
+import pytest
+
+from pytorch_distributed_nn_tpu.runtime import chaos, failure
+from pytorch_distributed_nn_tpu.serve.store import (
+    MemStore,
+    PrefixStore,
+    StoreJournal,
+)
+
+
+@pytest.fixture(autouse=True)
+def _no_chaos():
+    chaos.reset()
+    yield
+    chaos.reset()
+
+
+@pytest.fixture(params=["mem", "native"])
+def store_factory(request):
+    """Callable returning a connection to ONE shared store. MemStore
+    is its own 'connection'; the native backend opens a fresh client
+    per call — a blocking get occupies its connection, so concurrent
+    actors each bring their own, exactly like the fleet's processes."""
+    if request.param == "mem":
+        s = MemStore()
+        yield lambda: s
+        return
+    from pytorch_distributed_nn_tpu.runtime import native
+
+    server = native.StoreServer(0)
+    clients = []
+
+    def make():
+        c = native.StoreClient("127.0.0.1", server.port)
+        clients.append(c)
+        return c
+
+    try:
+        yield make
+    finally:
+        for c in clients:
+            c.close()
+        server.stop()
+
+
+@pytest.fixture
+def store(store_factory):
+    return store_factory()
+
+
+def test_set_get_check_delete(store):
+    assert not store.check("k")
+    store.set("k", b"v1")
+    assert store.check("k")
+    assert store.get("k", timeout_ms=1000) == b"v1"
+    store.set("k", b"v2")  # last-writer-wins overwrite
+    assert store.get("k", timeout_ms=1000) == b"v2"
+    store.delete("k")
+    assert not store.check("k")
+
+
+def test_get_blocks_until_set(store, store_factory):
+    writer = store_factory()  # own connection: the get below blocks ours
+
+    def later():
+        time.sleep(0.05)
+        writer.set("slow", b"arrived")
+
+    t = threading.Thread(target=later)
+    t.start()
+    # blocking get: returns the value another writer lands mid-wait
+    assert store.get("slow", timeout_ms=5000) == b"arrived"
+    t.join()
+
+
+def test_get_timeout_raises(store):
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError):
+        store.get("never", timeout_ms=50)
+    assert time.monotonic() - t0 < 2.0
+
+
+def test_add_counter_semantics(store):
+    assert store.add("n", 1) == 1
+    assert store.add("n", 1) == 2
+    assert store.add("n", 0) == 2  # read without bumping
+    assert store.add("n", -2) == 0
+
+
+def test_prefix_namespacing(store):
+    a = PrefixStore(store, "fleetA")
+    b = PrefixStore(store, "fleetB")
+    a.set("k", b"A")
+    b.set("k", b"B")
+    assert a.get("k", timeout_ms=1000) == b"A"
+    assert b.get("k", timeout_ms=1000) == b"B"
+    assert a.add("n", 1) == 1 and b.add("n", 5) == 5
+    a.delete("k")
+    assert not a.check("k") and b.check("k")
+
+
+def test_journal_roundtrip(store):
+    j = StoreJournal(PrefixStore(store, "ns"), "journal")
+    assert len(j) == 0
+    j.append({"event": "submit", "request_id": "r0"})
+    j.append_line('{"event": "final", "request_id": "r0"}')
+    assert len(j) == 2
+    recs = j.read_all(entry_timeout_ms=500)
+    assert [r["event"] for r in recs] == ["submit", "final"]
+
+
+def test_heartbeat_sequence_through_store(store):
+    """The exact key protocol the supervision stack runs: reporter
+    beats ``hb/<inc>/<rank>``, detector ages them — over BOTH
+    backends."""
+    rep = failure.HeartbeatReporter(store, rank=3, incarnation=0,
+                                    interval_s=0.02)
+    try:
+        det = failure.FailureDetector(store, ranks=[3, 4],
+                                      incarnation=0, timeout_s=1.0)
+        ages = det.last_beat_ages()
+        assert ages[3] is not None and ages[3] < 1.0
+        assert ages[4] is None  # never beaten
+        assert det.any_beats()
+        assert det.stale_ranks(alive={3}) == []
+    finally:
+        rep.stop()
+
+
+def test_store_flaky_chaos_passthrough(store):
+    """Both backends route every op through chaos.on_store_op, so an
+    armed ``store_flaky@p=1`` makes ANY op raise OSError — the signal
+    the hardened beat/publish loops must absorb as counted retries."""
+    store.set("pre", b"x")  # chaos disarmed: op lands
+    chaos.maybe_init("store_flaky@p=1", rank=0, seed=7)
+    with pytest.raises(OSError):
+        store.set("k", b"v")
+    with pytest.raises(OSError):
+        store.check("pre")
+    with pytest.raises(OSError):
+        store.add("n", 1)
+    chaos.reset()
+    assert store.get("pre", timeout_ms=1000) == b"x"  # healed
