@@ -1,0 +1,119 @@
+"""Per-request LoRA adapters for multi-tenant serving.
+
+One resident base model serves many fine-tunes: each adapter is a pair
+of rank-``r`` factors per layer for the attention q/v projections
+(the classic LoRA placement), stored STACKED across adapters so the
+whole bank is four arrays and per-request selection is one gather —
+``a_q[ids]`` — inside the jitted forward, not a params swap. A batch
+row's delta is ``(x @ A) @ B`` added to the projection output before
+rotary, so rows with different adapters coexist in one decode batch
+(the serving engine keys the gather on a per-slot adapter-id mirror).
+
+Bank layout (``num_adapters`` leading, layer axis second)::
+
+    a_q: (n, L, d_model, r)      b_q: (n, L, r, H,   head_dim)
+    a_v: (n, L, d_model, r)      b_v: (n, L, r, Hkv, head_dim)
+
+Adapter 0 is the **base model**: its B factors are zeros, so its delta
+is exactly ``x @ A @ 0 == 0`` and a request that selects no adapter
+adds structural zeros — greedy output is token-identical to running
+without a bank (tests/test_serve.py). Real deployments load trained
+factors into this layout; :func:`init_lora_bank` mints a bank with
+random small deltas for adapters >= 1 (bench / test traffic) and
+:func:`merge_lora` folds one adapter into the base params — the
+offline oracle that the dynamic gather path must match.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def lora_delta(x, a, b):
+    """x: (B, T, d); a: (B, d, r); b: (B, r, H, Dh) -> (B, T, H, Dh).
+
+    Two small matmuls with f32 accumulation (matching the projection
+    einsums' ``preferred_element_type`` discipline); the result is cast
+    back to x.dtype by the caller's add."""
+    h = jnp.einsum("btd,bdr->btr", x, a,
+                   preferred_element_type=jnp.float32)
+    return jnp.einsum("btr,brhk->bthk", h, b,
+                      preferred_element_type=jnp.float32).astype(x.dtype)
+
+
+def init_lora_bank(model, *, num_adapters: int, rank: int, rng=None,
+                   scale: float = 0.02):
+    """Mint a stacked adapter bank shaped for ``model`` (Llama family:
+    needs num_layers / d_model / num_heads / num_kv_heads attributes).
+
+    Adapter 0's B factors are zeros (the base model); adapters >= 1 get
+    N(0, scale) factors in both A and B — distinguishable outputs for
+    bench traffic and routing tests. Trained fine-tunes overwrite the
+    per-adapter slices."""
+    if num_adapters < 1:
+        raise ValueError(
+            f"num_adapters must be >= 1, got {num_adapters}")
+    if rank < 1:
+        raise ValueError(f"rank must be >= 1, got {rank}")
+    L = model.num_layers
+    d = model.d_model
+    H = model.num_heads
+    Hkv = model.num_kv_heads or H
+    Dh = d // H
+    rng = rng if rng is not None else jax.random.key(0)
+    ks = jax.random.split(rng, 4)
+    n, r = num_adapters, rank
+
+    def factor(k, shape):
+        return (scale * jax.random.normal(k, shape)).astype(jnp.float32)
+
+    bank = dict(
+        a_q=factor(ks[0], (n, L, d, r)),
+        b_q=factor(ks[1], (n, L, r, H, Dh)),
+        a_v=factor(ks[2], (n, L, d, r)),
+        b_v=factor(ks[3], (n, L, r, Hkv, Dh)),
+    )
+    # adapter 0 = base model: zero B => delta is exactly zero
+    bank["b_q"] = bank["b_q"].at[0].set(0.0)
+    bank["b_v"] = bank["b_v"].at[0].set(0.0)
+    return bank
+
+
+def num_adapters(bank) -> int:
+    return 0 if bank is None else int(np.shape(bank["a_q"])[0])
+
+
+def layer_slice(bank, layer: int):
+    """The per-layer factor tuple the attention module consumes:
+    ``(a_q, b_q, a_v, b_v)`` each with the layer axis removed."""
+    return tuple(bank[k][:, layer]
+                 for k in ("a_q", "b_q", "a_v", "b_v"))
+
+
+def merge_lora(params, bank, adapter: int):
+    """Fold one adapter's deltas into a COPY of the base params
+    (Llama param naming: ``layer{i}/attn/{query,value}/kernel``).
+    The oracle for the dynamic path: generate() with merged params
+    must match the serving engine running adapter ``adapter``."""
+    n = num_adapters(bank)
+    if not 0 <= adapter < n:
+        raise ValueError(f"adapter must be in [0, {n}), got {adapter}")
+    merged = jax.tree.map(lambda x: x, params)
+    L = np.shape(bank["a_q"])[1]
+    for i in range(L):
+        attn = dict(merged[f"layer{i}"]["attn"])
+        for proj, ak, bk in (("query", "a_q", "b_q"),
+                             ("value", "a_v", "b_v")):
+            a = bank[ak][adapter, i]  # (d, r)
+            b = bank[bk][adapter, i]  # (r, H, Dh)
+            delta = jnp.einsum("dr,rhk->dhk", a, b,
+                               preferred_element_type=jnp.float32)
+            kern = attn[proj]["kernel"]
+            attn[proj] = dict(attn[proj],
+                              kernel=(kern + delta.astype(kern.dtype)))
+        layer = dict(merged[f"layer{i}"], attn=attn)
+        merged = dict(merged)
+        merged[f"layer{i}"] = layer
+    return merged

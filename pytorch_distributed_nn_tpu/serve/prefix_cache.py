@@ -1,0 +1,356 @@
+"""Content-addressed radix prefix cache over the paged KV pool.
+
+Thousands of requests share system prompts and few-shot prefixes; the
+Gemma-on-TPU serving analysis (PAPERS.md) attributes most of the
+serving gap to batching policy and KV **residency** — this module is
+the residency half. The paged :class:`serve.kv_pool.KVPool` was built
+so that sharing a block across sequences is one refcount; this module
+decides *which* blocks to share.
+
+Design:
+
+- **content addressing** — a block covering token ids ``t`` whose
+  parent block hashed to ``d`` is keyed ``sha1(d + t.tobytes())``. The
+  chained digest makes the key a function of the entire prefix, so two
+  requests agree on a block id iff they agree on every token up to and
+  including it. The index is a radix tree flattened to one dict keyed
+  by digest (the chain IS the tree path); explicit parent/children
+  links exist only to enforce leaf-only eviction;
+- **admission matching** — :meth:`PrefixCache.admit` walks the
+  request's full blocks through the index; every resident block is
+  shared by reference (refcount++ via ``pool.reserve(shared=)``), so
+  the engine restores those rows from the device block store and
+  prefills only the suffix. A partial-tail match (the request diverges
+  mid-block) is **copy-on-write**: the matched block's content is
+  restored but the request's table gets a fresh private block, so the
+  donor's block is never written past. At most ``len(prompt) - 1``
+  tokens match — at least one token always prefills so the request's
+  first-token logits exist;
+- **eviction** — a finished sequence donates its full blocks to the
+  index (:meth:`release`), which parks refcount-0 blocks in the pool's
+  cached LRU ring instead of freeing them. Under allocation pressure,
+  admission sheds unpinned LRU **leaf** blocks (children would be
+  orphaned by an interior eviction: matching requires a contiguous
+  chain from block 0). The COW tail is pinned across the
+  match->restore window so a same-round admission cannot evict content
+  another admission is about to copy;
+- **accounting** — every index mutation funnels through
+  :meth:`PrefixCache._account` (lint-enforced by tests/test_quality
+  .py, mirroring the scheduler's ``_transition``): the
+  ``serve_kv_prefix_{hits,misses,evictions}_total`` counters, the
+  ``serve_kv_prefix_hit_rate`` gauge, the tokens-saved counter, and a
+  ``prefix`` flight event can never drift from the index's actual
+  shape.
+
+Thread model: the engine thread matches/admits (inside the
+scheduler's admission pass) and donates (at retire); client threads
+only :meth:`peek` (router affinity), which takes the lock but mutates
+nothing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import threading
+from typing import Optional
+
+import numpy as np
+
+from pytorch_distributed_nn_tpu.obs import flight
+from pytorch_distributed_nn_tpu.obs.registry import get_registry
+from pytorch_distributed_nn_tpu.runtime import chaos
+from pytorch_distributed_nn_tpu.serve.kv_pool import KVPool
+
+
+def _digest(parent: bytes, tokens: np.ndarray) -> bytes:
+    return hashlib.sha1(
+        parent + np.asarray(tokens, np.int32).tobytes()).digest()
+
+
+def _root(adapter: int) -> bytes:
+    """Chain seed. The KV content of a block depends on the LoRA
+    adapter (its v-projection delta is baked into the cached rows), so
+    the content address namespaces the whole chain by adapter id — two
+    requests share a block iff they agree on every token AND the
+    adapter. Never a valid sha1 digest (wrong length), so roots can't
+    collide with interior nodes."""
+    return b"a%d|" % int(adapter)
+
+
+@dataclasses.dataclass
+class PrefixMatch:
+    """One admission's match: ``blocks`` are shared by reference (they
+    are the head of the sequence's block table), ``tail`` is the
+    pinned copy-on-write source whose content is restored but whose
+    block is NOT in the table, ``tokens`` is the prefill offset m."""
+
+    blocks: tuple[int, ...] = ()
+    tail: Optional[int] = None
+    tokens: int = 0
+
+    @property
+    def restore_blocks(self) -> tuple[int, ...]:
+        return self.blocks + ((self.tail,) if self.tail is not None
+                              else ())
+
+
+class _Node:
+    __slots__ = ("digest", "parent", "tokens", "phys", "children")
+
+    def __init__(self, digest: bytes, parent: bytes,
+                 tokens: np.ndarray, phys: int) -> None:
+        self.digest = digest
+        self.parent = parent
+        self.tokens = np.asarray(tokens, np.int32)
+        self.phys = int(phys)
+        self.children: set[bytes] = set()
+
+
+class PrefixCache:
+    """Radix index of resident KV blocks, content-addressed."""
+
+    def __init__(self, pool: KVPool, *, max_rows: int = 0,
+                 tag: str = "") -> None:
+        self.pool = pool
+        self.block_size = pool.block_size
+        # ceiling on rows the engine's per-row cache can restore into
+        # (a COW tail whose block would overflow it is not matched)
+        self.max_rows = int(max_rows) or pool.num_blocks * pool.block_size
+        self.tag = tag
+        self._lock = threading.Lock()
+        self._nodes: dict[bytes, _Node] = {}
+        self._by_phys: dict[int, bytes] = {}
+        self.hits = 0
+        self.misses = 0
+        self.evictions = 0
+        self.tokens_saved = 0
+        reg = get_registry()
+        self._c_hits = reg.counter(
+            "serve_kv_prefix_hits_total",
+            "admissions that matched a resident prefix")
+        self._c_misses = reg.counter(
+            "serve_kv_prefix_misses_total",
+            "admissions with no resident prefix")
+        self._c_evictions = reg.counter(
+            "serve_kv_prefix_evictions_total",
+            "cached prefix blocks evicted under pressure")
+        self._c_saved = reg.counter(
+            "serve_kv_prefix_tokens_saved_total",
+            "prompt tokens whose prefill was skipped")
+        self._g_hit_rate = reg.gauge(
+            "serve_kv_prefix_hit_rate",
+            "hits / (hits + misses), lifetime")
+
+    # -- the single counted choke point ------------------------------------
+
+    def _account(self, op: str, *, tokens: int = 0,
+                 note: str = "") -> None:
+        """EVERY prefix-cache state change lands here (lint-enforced):
+        the counters, the hit-rate gauge, and the flight ring cannot
+        drift from the index's actual mutations."""
+        flight.record("prefix", op, note=note or self.tag)
+        if op == "hit":
+            self.hits += 1
+            self.tokens_saved += tokens
+            self._c_hits.inc()
+            self._c_saved.inc(tokens)
+        elif op == "miss":
+            self.misses += 1
+            self._c_misses.inc()
+        elif op == "evict":
+            self.evictions += 1
+            self._c_evictions.inc()
+        total = self.hits + self.misses
+        if total:
+            self._g_hit_rate.set(self.hits / total)
+
+    # -- matching ----------------------------------------------------------
+
+    def _match_locked(self, prompt: np.ndarray,
+                      adapter: int = 0) -> PrefixMatch:
+        """Longest resident chain, capped at ``len(prompt) - 1`` tokens
+        (>= 1 token must prefill). Read-only."""
+        bs = self.block_size
+        cap = len(prompt) - 1
+        blocks: list[int] = []
+        root = _root(adapter)
+        parent = root
+        j = 0
+        while (j + 1) * bs <= cap:
+            d = _digest(parent, prompt[j * bs:(j + 1) * bs])
+            node = self._nodes.get(d)
+            if node is None:
+                break
+            blocks.append(node.phys)
+            parent = d
+            j += 1
+        # partial tail: the request diverges inside the next block —
+        # restore a child block's content copy-on-write when its first
+        # t tokens agree (and the extra block still fits the row cache)
+        tail, t = None, cap - j * bs
+        if 0 < t < bs and (j + 1) * bs <= self.max_rows:
+            head = self._nodes.get(parent) if parent != root else None
+            kids = (head.children if head is not None
+                    else {d for d, n in self._nodes.items()
+                          if n.parent == root})
+            rest = prompt[j * bs:cap]
+            for d in sorted(kids):
+                node = self._nodes.get(d)
+                if node is not None and np.array_equal(
+                        node.tokens[:t], rest):
+                    tail = node.phys
+                    break
+        m = j * bs + (t if tail is not None else 0)
+        return PrefixMatch(blocks=tuple(blocks), tail=tail, tokens=m)
+
+    def peek(self, prompt, adapter: int = 0) -> int:
+        """Read-only matched-token count (router affinity scoring).
+        No counters, no LRU touch, no pins."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if len(prompt) < 2:
+            return 0
+        with self._lock:
+            return self._match_locked(prompt, adapter).tokens
+
+    # -- admission ---------------------------------------------------------
+
+    def admit(self, seq_id: str, prompt, total_tokens: int,
+              adapter: int = 0) -> Optional[PrefixMatch]:
+        """Match + reserve for one admission. Returns the match (tokens
+        may be 0) when the reservation landed, None on backpressure —
+        the scheduler treats None exactly like ``pool.reserve`` False.
+
+        The COW tail is pinned here and stays pinned until the engine
+        finishes restoring (:meth:`finish_restore`)."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        with self._lock:
+            if chaos.on_prefix_evict():
+                self._evict_locked(1)
+            match = self._match_locked(prompt, adapter)
+            if match.tail is not None:
+                self.pool.pin(match.tail)
+            need = (self.pool.blocks_for(total_tokens)
+                    - len(match.blocks))
+            short = need - self.pool.free_blocks
+            if short > 0:
+                self._evict_locked(short)
+            if not self.pool.reserve(seq_id, total_tokens,
+                                     shared=match.blocks):
+                if match.tail is not None:
+                    self.pool.unpin(match.tail)
+                self._account("defer", note=seq_id)
+                return None
+            if match.tokens > 0:
+                self._account("hit", tokens=match.tokens,
+                              note=f"{seq_id} m={match.tokens}")
+            else:
+                self._account("miss", note=seq_id)
+            return match
+
+    def finish_restore(self, match: PrefixMatch) -> None:
+        """Unpin the COW tail once its content has been copied into the
+        admitting sequence's rows."""
+        if match.tail is None:
+            return
+        with self._lock:
+            self.pool.unpin(match.tail)
+            self._account("unpin", note=f"b{match.tail}")
+
+    # -- donation + eviction -----------------------------------------------
+
+    def release(self, seq_id: str, tokens, adapter: int = 0) -> int:
+        """Retire-side: index the finished sequence's full blocks
+        (dedup by digest — a block whose chain is already resident is
+        not re-indexed) and free its table, retaining exactly the
+        indexed blocks in the pool's cached ring. Returns the count of
+        blocks that actually hit the free list."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        bs = self.block_size
+        root = _root(adapter)
+        with self._lock:
+            table = self.pool.block_table(seq_id)
+            retain: set[int] = set()
+            parent = root
+            for j in range(min(len(tokens) // bs, len(table))):
+                d = _digest(parent, tokens[j * bs:(j + 1) * bs])
+                node = self._nodes.get(d)
+                if node is None:
+                    node = _Node(d, parent, tokens[j * bs:(j + 1) * bs],
+                                 table[j])
+                    self._nodes[d] = node
+                    self._by_phys[node.phys] = d
+                    head = (self._nodes.get(parent)
+                            if parent != root else None)
+                    if head is not None:
+                        head.children.add(d)
+                    self._account("donate",
+                                  note=f"{seq_id} b{node.phys}")
+                if node.phys == table[j]:
+                    retain.add(table[j])
+                parent = d
+            return self.pool.free(seq_id, retain=frozenset(retain))
+
+    def abandon(self, seq_id: str) -> int:
+        """Failure-path release: free the sequence's table without
+        indexing anything new, but retain blocks the index already
+        maps (shared prefix blocks owned by a resident chain) so a
+        failed sequence can't yank content out from under the radix."""
+        with self._lock:
+            table = self.pool.block_table(seq_id)
+            retain = frozenset(b for b in table if b in self._by_phys)
+            self._account("abandon", note=seq_id)
+            return self.pool.free(seq_id, retain=retain)
+
+    def _evict_locked(self, need: int) -> int:
+        """Shed up to ``need`` unpinned LRU leaf blocks. Counted per
+        block through :meth:`_account`."""
+        shed = 0
+        progress = True
+        while shed < need and progress:
+            progress = False
+            for phys in self.pool.cached_lru():
+                d = self._by_phys.get(phys)
+                if d is None:
+                    # cached but never indexed (shouldn't happen):
+                    # reclaim it anyway
+                    if self.pool.release_cached(phys):
+                        shed += 1
+                        progress = True
+                    continue
+                node = self._nodes[d]
+                if node.children & self._nodes.keys():
+                    continue  # interior: evicting orphans descendants
+                if not self.pool.release_cached(phys):
+                    continue  # pinned (a COW restore in flight)
+                self._drop_locked(node)
+                self._account("evict", note=f"b{phys}")
+                shed += 1
+                progress = True
+                break
+        return shed
+
+    def _drop_locked(self, node: _Node) -> None:
+        del self._nodes[node.digest]
+        self._by_phys.pop(node.phys, None)
+        head = self._nodes.get(node.parent) if node.parent else None
+        if head is not None:
+            head.children.discard(node.digest)
+
+    # -- introspection -----------------------------------------------------
+
+    @property
+    def nodes(self) -> int:
+        with self._lock:
+            return len(self._nodes)
+
+    def stats(self) -> dict:
+        with self._lock:
+            total = self.hits + self.misses
+            return dict(
+                prefix_hits=self.hits, prefix_misses=self.misses,
+                prefix_evictions=self.evictions,
+                prefix_tokens_saved=self.tokens_saved,
+                prefix_hit_rate=(self.hits / total if total else 0.0),
+                prefix_nodes=len(self._nodes),
+            )
