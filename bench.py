@@ -140,6 +140,9 @@ _METRIC_NAMES = {
     "loader": "input-pipeline samples/sec ({preset})",
     "quality": "held-out NLL (llama3_8b_zero)",
     "serve": "serving tokens/sec (llama3_8b_zero)",
+    # shared-prefix A/B: same workload with the prefix cache ON; its
+    # own series so the ragged-workload band above stays comparable
+    "serve_prefix": "prefix-cache serving tokens/sec (llama3_8b_zero)",
     "fleet": "fleet serving tokens/sec (llama3_8b_zero)",
     # its own ledger series: subprocess replicas over the native store
     # (serve/procfleet.py) at CI-scale dims — mixing it into the
@@ -754,8 +757,12 @@ def bench_serve(args) -> int:
     # -- warmup: compile both paths outside the timed windows ----------
     static_pass(list(range(min(len(budget_cycle) * slots, n_req))),
                 timed=False)
+    # prefix_cache off here: every ragged prompt is distinct, so the
+    # cache can't hit — leaving it on would only add retire-side block
+    # copies and shift the series; the A/B below measures the cache
     warm_engine = ServingEngine(model, params, max_slots=slots,
-                                max_seq_len=max_seq, max_queue=n_req)
+                                max_seq_len=max_seq, max_queue=n_req,
+                                prefix_cache=False)
     warm_srv = InferenceServer(warm_engine).start()
     from pytorch_distributed_nn_tpu.serve.engine import _bucket_len
     buckets = {}  # one prompt per prefill pad bucket in the workload
@@ -771,7 +778,8 @@ def bench_serve(args) -> int:
 
     # -- continuous engine under open-loop load (timed) ----------------
     engine = ServingEngine(model, params, max_slots=slots,
-                           max_seq_len=max_seq, max_queue=n_req)
+                           max_seq_len=max_seq, max_queue=n_req,
+                           prefix_cache=False)
     server = InferenceServer(engine).start()
     period = 1.0 / args.serve_rate if args.serve_rate > 0 else 0.0
     t0 = time.perf_counter()
@@ -818,6 +826,116 @@ def bench_serve(args) -> int:
                f"vs static batches of {slots}"
                + (" [tiny dims]" if args.serve_tiny else ""),
     )
+
+    # -- shared-prefix A/B: cache ON vs OFF on the SAME workload -------
+    if args.serve_prefix_frac > 0:
+        frac = min(args.serve_prefix_frac, 0.9)
+        # prompts as long as the sequence budget allows (decode
+        # headroom of 8 >= the per-request budget of 4): the A/B
+        # measures prefill compute saved, so the prompt — not the
+        # decode tail — must dominate each request
+        total_len = max_seq - 8
+        plen = max(8, int(frac * total_len))
+        rng = np.random.default_rng(1)
+        prefixes = [rng.integers(1, model.vocab_size, size=plen)
+                    for _ in range(2)]
+        ab_prompts = [
+            np.concatenate([
+                prefixes[i % 2],
+                rng.integers(1, model.vocab_size, size=total_len - plen),
+            ]).astype(np.int32)
+            for i in range(n_req)
+        ]
+
+        def prefix_pass(on: bool) -> tuple[float, dict]:
+            eng = ServingEngine(model, params, max_slots=slots,
+                                max_seq_len=max_seq, max_queue=n_req,
+                                prefix_cache=on)
+            # two warm passes: pass 1 compiles the cold-prefill buckets
+            # and (ON) the save/restore programs; pass 2 reaches the
+            # steady state where donated chains cover the match cap, so
+            # the DEEP-match suffix buckets (different prefill shapes
+            # than shallow matches) are compiled too. Timing starts at
+            # the third pass — the steady state the cache is built for.
+            for _ in range(2):
+                for p in ab_prompts[:2 * slots]:
+                    eng.submit(p, 4)
+                eng.run_until_idle()
+            t0 = time.perf_counter()
+            for p in ab_prompts:
+                eng.submit(p, 4)
+            eng.run_until_idle()
+            dt = time.perf_counter() - t0
+            toks = sum(c["new_tokens"]
+                       for c in eng.completed[4 * slots:])
+            return toks / dt, eng.summary()
+
+        tps_off, _ = prefix_pass(False)
+        tps_on, summ_on = prefix_pass(True)
+        MetricsLogger(stream=sink).emit_benchmark(
+            metric=_METRIC_NAMES["serve_prefix"],
+            value=round(tps_on, 1), unit="tokens/sec",
+            vs_baseline=round(tps_on / tps_off, 3),
+            vs_baseline_kind="prefix_cache_on_over_off",
+            backend=backend,
+            hit_rate=round(summ_on["prefix_hit_rate"], 3),
+            tokens_saved=int(summ_on["prefix_tokens_saved"]),
+            prefix_frac=round(frac, 3),
+            detail=f"{n_req} requests of {total_len} tokens sharing 2 "
+                   f"prefixes of {plen}, budgets 4, {slots} slots, "
+                   f"cache ON vs OFF"
+                   + (" [tiny dims]" if args.serve_tiny else ""),
+        )
+    return 0
+
+
+def _serve_selftest() -> int:
+    """--serve --selftest: CPU-scale correctness gate for the serving
+    A/B — shared-prefix workload through two engines (cache ON / OFF),
+    greedy outputs must be token-identical and the ON side must
+    actually hit. The cheap stand-in for the full bench on machines
+    without an accelerator."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorch_distributed_nn_tpu.config import get_config
+    from pytorch_distributed_nn_tpu.models import get_model
+    from pytorch_distributed_nn_tpu.serve import ServingEngine
+
+    cfg = get_config("llama3_8b_zero")
+    cfg.model.extra = dict(num_layers=2, d_model=64, num_heads=4,
+                           num_kv_heads=2, mlp_dim=128, vocab_size=97)
+    cfg.model.compute_dtype = "float32"
+    cfg.model.remat = False
+    model = get_model(cfg.model)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32),
+                        train=False)["params"]
+
+    rng = np.random.default_rng(2)
+    prefixes = [rng.integers(1, 97, size=24) for _ in range(2)]
+    prompts = [
+        np.concatenate([prefixes[i % 2],
+                        rng.integers(1, 97, size=3 + i)]).astype(np.int32)
+        for i in range(6)
+    ]
+
+    outs = {}
+    summaries = {}
+    for on in (False, True):
+        eng = ServingEngine(model, params, max_slots=2, max_seq_len=64,
+                            block_size=8, max_queue=16, prefix_cache=on)
+        reqs = [eng.submit(p, 4) for p in prompts]
+        eng.run_until_idle()
+        outs[on] = [np.asarray(r.tokens) for r in reqs]
+        summaries[on] = eng.summary()
+    for a, b in zip(outs[False], outs[True]):
+        assert a.shape == b.shape and (a == b).all(), (a, b)
+    assert summaries[True]["prefix_hit_rate"] > 0, summaries[True]
+    assert summaries[True]["prefix_tokens_saved"] > 0
+    print("serve selftest ok: cache ON == OFF, hit_rate="
+          f"{summaries[True]['prefix_hit_rate']:.2f}")
     return 0
 
 
@@ -1818,6 +1936,11 @@ def main(argv=None) -> int:
     ap.add_argument("--serve-tiny", action="store_true",
                     help="serve metric: CI-scale model dims (CPU-fast) "
                          "instead of the scaled llama stand-in")
+    ap.add_argument("--serve-prefix-frac", type=float, default=0.0,
+                    help="serve metric: also run the shared-prefix A/B "
+                         "(prefix cache ON vs OFF) with this fraction "
+                         "of every prompt drawn from a shared prefix; "
+                         "0 disables (its own ledger series)")
     ap.add_argument("--loader-dataset", default="",
                     help="loader metric: swap the preset's dataset "
                          "(e.g. image_folder, cifar10_bin, mnist_idx)")
@@ -1900,7 +2023,9 @@ def main(argv=None) -> int:
                          "--capacity: run the no-backend determinism + "
                          "chaos-drill gate instead of a real fleet "
                          "sweep; --autoscale: run the no-backend Helm "
-                         "closed-loop gate instead of a live replay")
+                         "closed-loop gate instead of a live replay; "
+                         "--serve: run the CPU-scale shared-prefix A/B "
+                         "bit-identity gate instead of a real bench")
     args = ap.parse_args(argv)
     if args.serve:
         args.metric = "serve"
@@ -1918,6 +2043,9 @@ def main(argv=None) -> int:
         # no backend in this process: stub subprocess workers over a
         # real native store — the coordinator-restart drill
         return _fleet_selftest()
+    if args.metric == "serve" and args.selftest:
+        # CPU-scale gate: shared-prefix A/B bit-identity + hit-rate
+        return _serve_selftest()
     if args.ledger:
         return bench_ledger(args)
 

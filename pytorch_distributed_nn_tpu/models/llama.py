@@ -53,7 +53,7 @@ class LlamaBlock(nn.Module):
 
     @nn.compact
     def __call__(self, x, train: bool = False, decode: bool = False,
-                 cache_positions=None):
+                 cache_positions=None, lora=None):
         # inert tag unless the enclosing remat uses a name-aware policy
         # (remat_offload): then this marks the block boundary as
         # offloadable to pinned host memory instead of living in HBM
@@ -71,7 +71,7 @@ class LlamaBlock(nn.Module):
             cache_dtype=self.cache_dtype,
             fused_qkv=self.quantized and self.fused_proj,
             name="attn",
-        )(y, decode=decode, cache_positions=cache_positions)
+        )(y, decode=decode, cache_positions=cache_positions, lora=lora)
         x = x + y
         y = RMSNorm(eps=self.norm_eps, dtype=self.dtype,
                     param_dtype=self.param_dtype, name="mlp_norm")(x)
@@ -134,7 +134,8 @@ class Llama(nn.Module):
     @nn.compact
     def __call__(self, tokens, *, train: bool = False,
                  decode: bool = False, last_only: bool = False,
-                 return_hidden: bool = False, cache_positions=None):
+                 return_hidden: bool = False, cache_positions=None,
+                 lora_bank=None, adapter_ids=None):
         """``last_only`` returns logits for the final position only
         (B, 1, V) — decode prefill needs just the next-token row, and
         at real vocab sizes the (P-1) unused head projections dominate
@@ -143,7 +144,13 @@ class Llama(nn.Module):
         (train/losses.py) applies the head blockwise so full logits
         never materialize. ``cache_positions`` (B,) int32: per-row KV
         cache indices for continuous batching — see
-        nn.attention.MultiHeadAttention."""
+        nn.attention.MultiHeadAttention.
+
+        ``lora_bank`` + ``adapter_ids``: per-request LoRA (nn/lora.py).
+        The bank is the stacked ``(n, L, ...)`` factor dict; each batch
+        row selects its adapter via ``adapter_ids`` (B,) int32 — one
+        gather per factor per layer, so rows on different fine-tunes
+        share one batched forward (the multi-tenant serving path)."""
         if self.quantized:
             x = Int8Embed(self.vocab_size, self.d_model,
                           dtype=self.dtype, name="tok_embed")(tokens)
@@ -177,7 +184,19 @@ class Llama(nn.Module):
                                  policy=policy)
         else:
             block_cls = LlamaBlock
+        if lora_bank is not None:
+            from pytorch_distributed_nn_tpu.nn.lora import layer_slice
+            ids = adapter_ids
+            if ids is None:
+                ids = jnp.zeros((tokens.shape[0],), jnp.int32)
         for i in range(self.num_layers):
+            if lora_bank is None:
+                lora = None
+            else:
+                # gather each row's adapter factors for this layer —
+                # lora stays a traced positional so the remat wrapper
+                # (static_argnums covers train/decode only) is happy
+                lora = tuple(f[ids] for f in layer_slice(lora_bank, i))
             x = block_cls(
                 num_heads=self.num_heads, num_kv_heads=self.num_kv_heads,
                 mlp_dim=self.mlp_dim, rope_theta=self.rope_theta,
@@ -187,7 +206,7 @@ class Llama(nn.Module):
                 cache_dtype=self.cache_dtype,
                 fused_proj=self.fused_proj,
                 name=f"layer{i}",
-            )(x, train, decode, cache_positions)
+            )(x, train, decode, cache_positions, lora)
         if last_only:
             x = x[:, -1:]
         x = RMSNorm(eps=self.norm_eps, dtype=self.dtype,

@@ -227,7 +227,8 @@ class MultiHeadAttention(nn.Module):
     @nn.compact
     def __call__(self, x, mask: Optional[jax.Array] = None,
                  decode: bool = False,
-                 cache_positions: Optional[jax.Array] = None):
+                 cache_positions: Optional[jax.Array] = None,
+                 lora=None):
         """``decode=True`` enables the autoregressive KV cache (flax
         "cache" collection): initialize by calling ``model.init`` with a
         (B, max_len) input and ``decode=True`` — that sizes the cache —
@@ -247,7 +248,17 @@ class MultiHeadAttention(nn.Module):
         (inference/generate.py ``prompt_lengths``). Each row's
         computation is exactly the shared-index computation for that
         row, so greedy decode stays token-identical to the sequential
-        path."""
+        path.
+
+        ``lora`` — per-row LoRA deltas for multi-tenant serving
+        (nn/lora.py): a ``(a_q, b_q, a_v, b_v)`` tuple of per-BATCH-row
+        factors (each leading dim B, already gathered from the stacked
+        adapter bank by the caller). The deltas land on the q/v
+        projection *outputs* before rotary and before any cache write,
+        so cached KV rows embed the adapter's deltas — which is why the
+        prefix cache namespaces its content addresses by adapter id. A
+        zero-B adapter contributes an exact-0.0 delta: adding it leaves
+        greedy decode token-identical to running without a bank."""
         kv_heads = self.num_kv_heads or self.num_heads
         if self.quantized:
             if self.use_bias:
@@ -272,6 +283,12 @@ class MultiHeadAttention(nn.Module):
             q = dense(self.num_heads, "query")(x)
             k = dense(kv_heads, "key")(x)
             v = dense(kv_heads, "value")(x)
+        if lora is not None:
+            from pytorch_distributed_nn_tpu.nn.lora import lora_delta
+
+            a_q, b_q, a_v, b_v = lora
+            q = q + lora_delta(x, a_q, b_q)
+            v = v + lora_delta(x, a_v, b_v)
         if decode and not self.causal:
             raise ValueError("decode cache requires causal attention")
         if decode and mask is not None:

@@ -428,8 +428,9 @@ class Watchtower:
         ok = bool(ev.get("ok", True))
         ttft = float(ev.get("ttft_s", 0.0))
         rid = str(ev.get("request_id", ""))
+        tenant = str(ev.get("tenant", "default"))
         self._recent_reqs.append({
-            "request_id": rid,
+            "request_id": rid, "tenant": tenant,
             "ttft_s": round(ttft, 6), "ok": ok,
             "waterfall": ev.get("waterfall"),
         })
@@ -444,8 +445,22 @@ class Watchtower:
                 self._ttft_charged.discard(self._ttft_charged_q[0])
             self._ttft_charged_q.append(rid)
             self._ttft_charged.add(rid)
-        self._burns["ttft"].add(t, (not ok) or ttft > cfg.ttft_slo_s)
+        bad = (not ok) or ttft > cfg.ttft_slo_s
+        self._burns["ttft"].add(t, bad)
         self._check_burn("ttft", cfg.ttft_slo_s, t)
+        # per-tenant TTFT window, created lazily on first sight: one
+        # tenant burning its whole budget must page WITH THE TENANT
+        # NAMED even while healthy neighbors keep the global window
+        # under the threshold (the noisy-neighbor blind spot). The
+        # default tenant IS the global window — no second window, so a
+        # single-tenant burn still raises exactly one page.
+        if tenant != "default":
+            key = f"ttft:{tenant}"
+            if key not in self._burns:
+                self._burns[key] = _BurnWindow(cfg.slo_objective,
+                                               cfg.burn_slow_s)
+            self._burns[key].add(t, bad)
+            self._check_burn(key, cfg.ttft_slo_s, t)
 
     def _obs_serve_reject(self, ev: dict) -> None:
         # a shed request spends TTFT error budget: the client saw an
@@ -566,13 +581,17 @@ class Watchtower:
                   and slow >= cfg.burn_threshold)
         if firing and slo not in self._burn_active:
             self._burn_active.add(slo)
+            base, _, tenant = slo.partition(":")
             worst = max((r for r in self._recent_reqs
-                         if not r["ok"] or r["ttft_s"] > slo_s),
+                         if (not r["ok"] or r["ttft_s"] > slo_s)
+                         and (not tenant or r.get("tenant") == tenant)),
                         key=lambda r: r["ttft_s"],
-                        default=None) if slo == "ttft" else None
+                        default=None) if base == "ttft" else None
             attribution = {"slo": slo,
                            "burn_fast": round(fast, 4),
                            "burn_slow": round(slow, 4)}
+            if tenant:
+                attribution["tenant"] = tenant
             if worst is not None:
                 attribution["request"] = worst
             self._raise(
@@ -667,14 +686,20 @@ def events_from_jsonl(rec: dict) -> list[dict]:
                         "step": int(rec.get("step", -1)),
                         "wall_s": float(wall) / max(int(steps), 1)})
     elif ev == "serve_request":
-        out.append({"ev": "serve_request", "t": t, "ok": True,
-                    "request_id": rec.get("request_id", ""),
-                    "ttft_s": float(rec.get("ttft_s", 0.0)),
-                    "waterfall": rec.get("waterfall")})
+        e = {"ev": "serve_request", "t": t, "ok": True,
+             "request_id": rec.get("request_id", ""),
+             "ttft_s": float(rec.get("ttft_s", 0.0)),
+             "waterfall": rec.get("waterfall")}
+        if "tenant" in rec:
+            e["tenant"] = rec["tenant"]
+        out.append(e)
     elif ev == "serve_reject":
-        out.append({"ev": "serve_reject", "t": t,
-                    "request_id": rec.get("request_id", ""),
-                    "reason": rec.get("reason", "")})
+        e = {"ev": "serve_reject", "t": t,
+             "request_id": rec.get("request_id", ""),
+             "reason": rec.get("reason", "")}
+        if "tenant" in rec:
+            e["tenant"] = rec["tenant"]
+        out.append(e)
     elif ev == "fleet_replica_down":
         out.append({"ev": "replica_down", "t": t,
                     "replica": int(rec.get("replica", -1)),
@@ -772,16 +797,20 @@ def on_serve_request(rec: dict) -> None:
         return
     _tower.observe({"ev": "serve_request", "t": time.time(), "ok": True,
                     "request_id": rec.get("request_id", ""),
+                    "tenant": rec.get("tenant", "default"),
                     "ttft_s": float(rec.get("ttft_s", 0.0)),
                     "waterfall": rec.get("waterfall")})
 
 
-def on_serve_reject(request_id: str, reason: str) -> None:
-    """Scheduler rejection hook — shed traffic burns TTFT budget."""
+def on_serve_reject(request_id: str, reason: str,
+                    tenant: str = "default") -> None:
+    """Scheduler rejection hook — shed traffic burns TTFT budget (the
+    rejected tenant's, so a quota-capped flood burns its own window)."""
     if _tower is None:
         return
     _tower.observe({"ev": "serve_reject", "t": time.time(),
-                    "request_id": request_id, "reason": reason})
+                    "request_id": request_id, "reason": reason,
+                    "tenant": str(tenant)})
 
 
 def on_serve_submit(request_id: str, queue_depth: int,

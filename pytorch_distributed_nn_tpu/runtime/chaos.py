@@ -66,6 +66,21 @@ Spec grammar (faults joined by ``;``)::
                                          — the transient-partition
                                          drill the heartbeat/publisher
                                          hardening must absorb
+    evict_prefix@p=0.5[:rank=...]        each prefix-cache admission
+                                         sheds the cached blocks it
+                                         would have matched with
+                                         probability p (seeded) — the
+                                         residency drill: hits degrade
+                                         to re-prefills, outputs must
+                                         stay golden
+                                         (serve/prefix_cache.py)
+    tenant_flood@tenant=burst:rps=50[:after_s=...]
+                                         one tenant's flash crowd: the
+                                         serving engine owes synthetic
+                                         requests for this tenant at
+                                         rps (wall-clock since arming)
+                                         — the quota/fairness drill for
+                                         serve/scheduler.py
 
 ``rank`` / ``inc`` (incarnation, from ``TPUNN_RESTART``) are optional
 filters; a fault without them fires in every process / incarnation.
@@ -116,11 +131,12 @@ DEFAULT_HANG_MS = 3_600_000.0
 
 FAULT_KINDS = ("crash", "hang", "slow", "preempt", "corrupt_ckpt",
                "store_flaky", "serve_reject", "kill_replica",
-               "hang_replica", "kill_coordinator", "store_partition")
+               "hang_replica", "kill_coordinator", "store_partition",
+               "evict_prefix", "tenant_flood")
 
 _INT_KEYS = ("step", "rank", "inc", "replica")
-_FLOAT_KEYS = ("ms", "p", "after_s")
-_STR_KEYS = ("collective",)
+_FLOAT_KEYS = ("ms", "p", "after_s", "rps")
+_STR_KEYS = ("collective", "tenant")
 
 
 class ReplicaKillError(RuntimeError):
@@ -152,6 +168,8 @@ class Fault:
     p: float = 0.0
     replica: int | None = None
     after_s: float = 0.0
+    tenant: str = ""
+    rps: float = 0.0
 
 
 def parse_spec(spec: str) -> list[Fault]:
@@ -207,20 +225,25 @@ def _validate(fault: Fault) -> None:
         "serve_reject": ("p",),
         "kill_replica": ("replica",), "hang_replica": ("replica",),
         "kill_coordinator": ("after_s",), "store_partition": ("ms",),
+        "evict_prefix": ("p",), "tenant_flood": ("tenant", "rps"),
     }[fault.kind]
     for key in need:
         missing = (getattr(fault, key) in (None, "", 0.0)
-                   if key in ("collective", "ms", "p", "after_s")
+                   if key in ("collective", "ms", "p", "after_s",
+                              "tenant", "rps")
                    else getattr(fault, key) is None)
         if missing:
             raise ValueError(
                 f"chaos fault {fault.spec!r} needs {key}= "
                 f"(e.g. {fault.kind}@{key}=...)"
             )
-    if fault.kind in ("store_flaky", "serve_reject") \
+    if fault.kind in ("store_flaky", "serve_reject", "evict_prefix") \
             and not 0.0 < fault.p <= 1.0:
         raise ValueError(
             f"{fault.kind} p must be in (0, 1], got {fault.p}")
+    if fault.kind == "tenant_flood" and fault.rps < 0.0:
+        raise ValueError(
+            f"tenant_flood rps must be > 0, got {fault.rps}")
 
 
 class ChaosEngine:
@@ -246,6 +269,8 @@ class ChaosEngine:
         # store_partition: fault id -> window-close time (monotonic);
         # the window opens on the first matching store op
         self._partition_until: dict[int, float] = {}
+        # tenant_flood: fault id -> synthetic requests already owed
+        self._flood_sent: dict[int, int] = {}
 
     def _matches(self, fault: Fault, *, step: int | None = None) -> bool:
         if fault.rank is not None and fault.rank != self.rank:
@@ -349,6 +374,38 @@ class ChaosEngine:
                 return True
         return False
 
+    def prefix_evict(self) -> bool:
+        """Prefix-cache admission hook: True = shed the cached blocks
+        this admission would have matched (the residency drill)."""
+        for fault in self.faults:
+            if fault.kind != "evict_prefix" or not self._matches(fault):
+                continue
+            if self._rng.random() < fault.p:
+                self._inject_evict_prefix(fault)
+                return True
+        return False
+
+    def tenant_flood(self) -> list[tuple[str, int]]:
+        """Serving step hook: ``[(tenant, n_owed), ...]`` synthetic
+        requests the engine must submit now. Owed count is wall-clock
+        (``rps * seconds since arming``) minus what was already owed —
+        a compile-stalled step grants the whole backlog at once, which
+        is exactly a flash crowd's shape."""
+        owed: list[tuple[str, int]] = []
+        now = time.monotonic()
+        for i, fault in enumerate(self.faults):
+            if fault.kind != "tenant_flood" or not self._matches(fault):
+                continue
+            if fault.after_s and now - self._t0 < fault.after_s:
+                continue
+            due = int((now - self._t0 - fault.after_s) * fault.rps)
+            sent = self._flood_sent.get(i, 0)
+            if due > sent:
+                self._flood_sent[i] = due
+                self._inject_tenant_flood(fault, due - sent)
+                owed.append((fault.tenant, due - sent))
+        return owed
+
     def replica_round(self, replica: int, round_: int) -> None:
         """Fleet replica-driver hook: kill/hang one replica. Both fire
         once; ``step=`` keys on the replica's own round counter and
@@ -425,6 +482,17 @@ class ChaosEngine:
         self._emit(fault, note=f"{fault.spec} [{op} {key}]")
         raise OSError(
             f"chaos: store partitioned, {op}({key!r}) unreachable")
+
+    def _inject_evict_prefix(self, fault: Fault) -> None:
+        # emit-first (lint): the eviction itself happens in the prefix
+        # cache, which counts it through _account("evict") — the flight
+        # ring must already hold the injection when it does
+        self._emit(fault)
+
+    def _inject_tenant_flood(self, fault: Fault, n: int) -> None:
+        # emit-first (lint): the engine owns the synthetic submissions,
+        # each one counted through the scheduler like real traffic
+        self._emit(fault, note=f"{fault.spec} [+{n} req]")
 
     def _inject_hang_replica(self, fault: Fault, replica: int) -> None:
         self._emit(fault, note=f"{fault.spec} [replica {replica}]")
@@ -550,6 +618,27 @@ def on_coordinator_poll() -> None:
     if _engine is None:
         return
     _engine.coordinator_poll()
+
+
+def on_prefix_evict() -> bool:
+    """``serve.prefix_cache`` admission hook (evict_prefix).
+
+    True when chaos says to shed the cached blocks this admission
+    would have hit; the prefix cache owns the actual eviction (counted
+    + flight-visible there too)."""
+    if _engine is None:
+        return False
+    return _engine.prefix_evict()
+
+
+def on_tenant_flood() -> list[tuple[str, int]]:
+    """``serve.engine`` step hook (tenant_flood): the synthetic
+    flash-crowd submissions owed now, as ``[(tenant, count), ...]``.
+    The engine submits them through the normal scheduler path so the
+    quota/fairness machinery sees real counted traffic."""
+    if _engine is None:
+        return []
+    return _engine.tenant_flood()
 
 
 def on_replica_round(replica: int, round_: int) -> None:

@@ -16,8 +16,13 @@ Layering (each module's docstring carries its own contract):
   (``TPUNN_TRAFFIC`` chaos-style spec grammar), byte-identical JSONL
   traces, replay into a server or fleet; the capacity judge lives in
   :mod:`obs.capacity`;
+- :mod:`serve.prefix_cache` — Mosaic prefix-cache residency (ISSUE
+  14): content-addressed radix index over retired KV blocks, COW
+  tail reuse, leaf-only LRU eviction, one counted ``_account`` choke
+  point; the engine's save/restore side lives in :mod:`serve.engine`;
 - :mod:`serve.router` — fleet placement policy: score READY replicas
-  by KV headroom minus queue pressure, one counted choke point;
+  by KV headroom minus queue pressure plus prefix-cache affinity
+  (``PrefixCache.peek``), one counted choke point;
 - :mod:`serve.fleet` — replica supervisor: N engines behind one
   admission point, heartbeat failure detection, chaos-tested failover
   with in-flight re-admission, rolling zero-reject weight reload,
@@ -58,6 +63,15 @@ from pytorch_distributed_nn_tpu.serve.fleet import (  # noqa: F401
     ReplicaHandle,
 )
 from pytorch_distributed_nn_tpu.serve.kv_pool import KVPool  # noqa: F401
+from pytorch_distributed_nn_tpu.nn.lora import (  # noqa: F401
+    init_lora_bank,
+    merge_lora,
+    num_adapters,
+)
+from pytorch_distributed_nn_tpu.serve.prefix_cache import (  # noqa: F401
+    PrefixCache,
+    PrefixMatch,
+)
 from pytorch_distributed_nn_tpu.serve.procfleet import (  # noqa: F401
     ProcessFleet,
     ProcTicket,

@@ -45,13 +45,14 @@ import numpy as np
 from pytorch_distributed_nn_tpu import obs
 from pytorch_distributed_nn_tpu.inference.generate import (
     _apply_decode_ragged,
-    _apply_prefill_ragged,
     init_cache,
 )
+from pytorch_distributed_nn_tpu.nn.lora import num_adapters
 from pytorch_distributed_nn_tpu.obs import flight, watchtower, xray
 from pytorch_distributed_nn_tpu.runtime import chaos
 from pytorch_distributed_nn_tpu.serve import autoscale
 from pytorch_distributed_nn_tpu.serve.kv_pool import KVPool
+from pytorch_distributed_nn_tpu.serve.prefix_cache import PrefixCache
 from pytorch_distributed_nn_tpu.serve.scheduler import Request, Scheduler
 
 # TTFT spans queueing (ms..s under load); per-token latency is ms-scale
@@ -61,13 +62,48 @@ _TOKEN_BUCKETS = (0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
                   0.25, 0.5, 1.0)
 
 
+def _apply_prefill_at(model, params, cache, tokens, lengths, starts,
+                      **extra):
+    """Ragged prefill with a per-row cache-write offset: row i's KV
+    lands in cache rows [starts[i], starts[i] + lengths[i]) and its
+    queries attend absolute positions [0, starts[i] + t] — which is
+    what prefix-cache suffix prefill needs: the restored rows
+    [0, starts[i]) are already in ``cache`` and the suffix computes
+    exactly the floats a full from-zero prefill would have. Returns
+    ((B, V) logits at each row's LAST real suffix position, cache).
+    ``extra`` forwards per-request LoRA (lora_bank + adapter_ids) so
+    TransformerLM-family models never see unknown kwargs."""
+    logits, mutated = model.apply(
+        {"params": params, "cache": cache}, tokens,
+        train=False, decode=True, mutable=["cache"],
+        cache_positions=starts.astype(jnp.int32), **extra,
+    )
+    last = (lengths.astype(jnp.int32) - 1)[:, None, None]
+    next_logits = jnp.take_along_axis(logits, last, axis=1)[:, 0, :]
+    return next_logits, mutated["cache"]
+
+
 @functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
-def _serve_prefill(model, params, cache, tokens, lengths):
-    """Batch-of-one prefill + greedy first token: (1,) int32 token,
-    filled (1, P_pad, ...) row cache. The argmax runs on device so the
-    only host transfer is the token itself."""
-    next_logits, cache = _apply_prefill_ragged(model, params, cache,
-                                               tokens, lengths)
+def _serve_prefill(model, params, cache, tokens, lengths, starts):
+    """Batch-of-one (suffix) prefill + greedy first token: (1,) int32
+    token, filled (1, P_pad, ...) row cache. ``starts`` (1,) int32 is
+    the number of rows already restored from the prefix cache (0 for a
+    miss). The argmax runs on device so the only host transfer is the
+    token itself."""
+    next_logits, cache = _apply_prefill_at(model, params, cache,
+                                           tokens, lengths, starts)
+    return jnp.argmax(next_logits, axis=-1).astype(jnp.int32), cache
+
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+def _serve_prefill_lora(model, params, cache, tokens, lengths, starts,
+                        bank, ids):
+    """LoRA twin of :func:`_serve_prefill`: same math plus per-row
+    adapter deltas. A separate jit (not a None-bank branch) keeps the
+    base path's trace free of the bank pytree."""
+    next_logits, cache = _apply_prefill_at(
+        model, params, cache, tokens, lengths, starts,
+        lora_bank=bank, adapter_ids=ids)
     return jnp.argmax(next_logits, axis=-1).astype(jnp.int32), cache
 
 
@@ -85,6 +121,69 @@ def _serve_step(model, params, cache, last_tok, lengths, active):
     nxt = jnp.where(active, nxt, last_tok)
     lengths = jnp.where(active, lengths + 1, lengths)
     return nxt, lengths, cache
+
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+def _serve_step_lora(model, params, cache, last_tok, lengths, active,
+                     bank, ids):
+    """LoRA twin of :func:`_serve_step`: each row applies its own
+    adapter's deltas (ids is the per-slot adapter mirror), so one
+    batched decode serves every tenant's fine-tune at once."""
+    logits, mutated = model.apply(
+        {"params": params, "cache": cache}, last_tok[:, None],
+        train=False, decode=True, last_only=True, mutable=["cache"],
+        cache_positions=lengths.astype(jnp.int32),
+        lora_bank=bank, adapter_ids=ids,
+    )
+    nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+    nxt = jnp.where(active, nxt, last_tok)
+    lengths = jnp.where(active, lengths + 1, lengths)
+    return nxt, lengths, mutated["cache"]
+
+
+@functools.partial(jax.jit, static_argnums=(2,), donate_argnums=(1,))
+def _save_blocks(cache, store, block_size, slot, table, n):
+    """Copy the first ``n`` full blocks of batch row ``slot`` into the
+    physical blocks ``table[:n]`` of the block store (retire-side
+    donation). ``table`` is shape-padded to the per-sequence block
+    ceiling so slot/table/n are all traced — ONE program and ONE
+    dispatch per retire, however many blocks the sequence spans (the
+    per-block version made the cache-ON bench dispatch-bound)."""
+    def sv(c, s):
+        if c.ndim < 2:
+            return s
+        def body(j, acc):
+            blk = jax.lax.dynamic_slice(
+                c, (slot, j * block_size) + (0,) * (c.ndim - 2),
+                (1, block_size) + c.shape[2:])
+            return jax.lax.dynamic_update_slice(
+                acc, blk.astype(acc.dtype),
+                (table[j], 0) + (0,) * (acc.ndim - 2))
+        return jax.lax.fori_loop(0, n, body, s)
+    return jax.tree.map(sv, cache, store)
+
+
+@functools.partial(jax.jit, static_argnums=(2,), donate_argnums=(0,))
+def _restore_blocks(row_cache, store, block_size, table, n):
+    """Copy physical blocks ``table[:n]`` of the store into rows
+    [0, n * block_size) of a batch-of-one prefill cache
+    (admission-side prefix restore; one dispatch per admission). The
+    caller guarantees n * block_size <= the row cache's padded length
+    (PrefixCache ``max_rows`` caps matches; out-of-range
+    dynamic_update_slice starts would silently CLAMP and corrupt
+    neighbor rows)."""
+    def rs(r, s):
+        if r.ndim < 2:
+            return r
+        def body(j, acc):
+            blk = jax.lax.dynamic_slice(
+                s, (table[j], 0) + (0,) * (s.ndim - 2),
+                (1, block_size) + s.shape[2:])
+            return jax.lax.dynamic_update_slice(
+                acc, blk.astype(acc.dtype),
+                (0, j * block_size) + (0,) * (acc.ndim - 2))
+        return jax.lax.fori_loop(0, n, body, r)
+    return jax.tree.map(rs, row_cache, store)
 
 
 @functools.partial(jax.jit, donate_argnums=(0,))
@@ -133,13 +232,15 @@ def _bucket_len(n: int, floor: int = 16) -> int:
 class _Slot:
     """Host-side mirror of one batch row."""
 
-    __slots__ = ("req", "emitted", "tokens", "depth")
+    __slots__ = ("req", "emitted", "tokens", "depth", "cached")
 
-    def __init__(self, req: Request, first_token: int, depth: int):
+    def __init__(self, req: Request, first_token: int, depth: int,
+                 cached: int = 0):
         self.req = req
         self.tokens = [int(first_token)]
         self.emitted = 1
         self.depth = depth  # cache rows filled (prompt + emitted - 1)
+        self.cached = cached  # prompt tokens restored from prefix cache
 
 
 class ServingEngine:
@@ -149,7 +250,8 @@ class ServingEngine:
                  max_seq_len: int = 256, block_size: int = 16,
                  max_queue: int = 64, max_prefills_per_round: int = 2,
                  eos_token: Optional[int] = None, metrics=None,
-                 tag: str = "") -> None:
+                 tag: str = "", prefix_cache: bool = True,
+                 lora_bank=None, tenant_quotas=None) -> None:
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
         self.model = model
@@ -161,23 +263,50 @@ class ServingEngine:
         self.max_seq_len = int(max_seq_len)
         self.eos_token = eos_token
         self.metrics = metrics  # MetricsLogger or None
+        # per-request LoRA: stacked (n, L, ...) factor bank
+        # (nn/lora.py); requests pick an adapter at submit and each
+        # batch row applies its own deltas in the shared forward
+        self.lora_bank = lora_bank
         pool = KVPool(
             num_blocks=max_slots * (-(-self.max_seq_len // block_size)),
             block_size=block_size,
         )
+        self._cache = _fresh_cache(model, max_slots, self.max_seq_len)
+        if prefix_cache:
+            self.prefix_cache: Optional[PrefixCache] = PrefixCache(
+                pool, max_rows=self.max_seq_len, tag=tag)
+            # device block store: retired sequences donate their KV
+            # blocks here; admissions with a radix match restore from
+            # here. Scalar leaves are fresh zeros (NEVER aliased into
+            # self._cache — the decode jit donates the cache every
+            # round, and an aliased leaf would be invalidated with it).
+            self._store = jax.tree.map(
+                lambda x: (jnp.zeros_like(x) if x.ndim < 2 else
+                           jnp.zeros((pool.num_blocks, block_size)
+                                     + x.shape[2:], x.dtype)),
+                self._cache)
+            # fixed save/restore table width: one compiled program
+            # serves every sequence, whatever its block count
+            self._blocks_per_seq = -(-self.max_seq_len // block_size)
+        else:
+            self.prefix_cache = None
+            self._store = None
         self.scheduler = Scheduler(
             pool, max_queue=max_queue, max_seq_len=self.max_seq_len,
             max_prefills_per_round=max_prefills_per_round,
+            tenant_quotas=tenant_quotas,
+            prefix_cache=self.prefix_cache,
         )
         self.scheduler.metrics = metrics
-        self._cache = _fresh_cache(model, max_slots, self.max_seq_len)
         self._slots: list[Optional[_Slot]] = [None] * max_slots
         self._h_last = np.zeros((max_slots,), np.int32)
         self._h_depth = np.zeros((max_slots,), np.int32)
         self._h_active = np.zeros((max_slots,), bool)
+        self._h_adapter = np.zeros((max_slots,), np.int32)
         self._d_last = jnp.asarray(self._h_last)
         self._d_depth = jnp.asarray(self._h_depth)
         self._d_active = jnp.asarray(self._h_active)
+        self._d_adapter = jnp.asarray(self._h_adapter)
         # bench/report feed: per-round wall seconds + finished requests
         self.round_seconds: list[float] = []
         self.completed: list[dict] = []
@@ -186,6 +315,13 @@ class ServingEngine:
         self._h_ttft = reg.histogram(
             "serve_ttft_seconds", "submit -> first token",
             buckets=_TTFT_BUCKETS)
+        # per-tenant twin of serve_ttft_seconds — the base histogram
+        # stays UNLABELED (its series is the global SLO feed; labeling
+        # it would break every existing snapshot() caller)
+        self._h_ttft_tenant = reg.histogram(
+            "serve_tenant_ttft_seconds",
+            "submit -> first token, per tenant",
+            labels=("tenant",), buckets=_TTFT_BUCKETS)
         self._h_tok = reg.histogram(
             "serve_token_latency_seconds", "decode round wall time "
             "(= per-token latency of every active stream)",
@@ -198,6 +334,17 @@ class ServingEngine:
     # -- client surface ----------------------------------------------------
 
     def submit(self, prompt, max_new_tokens: int, **kw) -> Request:
+        adapter = int(kw.get("adapter", 0))
+        if self.lora_bank is not None:
+            n = num_adapters(self.lora_bank)
+            if not 0 <= adapter < n:
+                raise ValueError(
+                    f"adapter {adapter} out of range for a LoRA bank "
+                    f"of {n} adapters")
+        elif adapter != 0:
+            raise ValueError(
+                f"adapter {adapter} requested but the engine has no "
+                f"LoRA bank (pass lora_bank= to ServingEngine)")
         return self.scheduler.submit(prompt, max_new_tokens, **kw)
 
     @property
@@ -216,6 +363,12 @@ class ServingEngine:
         there was nothing to do (caller may sleep/park)."""
         sched = self.scheduler
         sched.round += 1
+        # chaos tenant_flood: synthetic burst traffic lands through the
+        # REAL submit path (quota checks, DRR queues, reject counters)
+        for tenant, owed in chaos.on_tenant_flood():
+            for _ in range(owed):
+                self.submit(np.asarray([3, 5, 7], np.int32), 2,
+                            tenant=tenant)
         changed = self._admit()
         if self.active_slots == 0:
             self._g_occ.set(0)
@@ -289,27 +442,62 @@ class ServingEngine:
 
     def _prefill_into(self, slot: int, req: Request) -> None:
         L = len(req.prompt)
-        pad = min(_bucket_len(L), self.max_seq_len)
-        tokens = np.zeros((1, pad), np.int32)
-        tokens[0, :L] = req.prompt  # left-ALIGNED (pad tail is masked)
+        match = req.prefix_match
+        m = match.tokens if match is not None else 0
+        bs = self.scheduler.pool.block_size
+        suffix = np.asarray(req.prompt[m:], np.int32)
+        T = len(suffix)  # >= 1: PrefixCache caps matches at L - 1
+        t_pad = min(_bucket_len(T), self.max_seq_len - m)
+        # row-cache length must hold BOTH the restored blocks and the
+        # suffix writes: a dynamic_update_slice whose start exceeds the
+        # buffer silently clamps (corrupting neighbor rows), so pad is
+        # sized to max(restored top, m + suffix pad), never less
+        restore_top = len(match.restore_blocks) * bs \
+            if match is not None else 0
+        pad = min(_bucket_len(max(m + t_pad, restore_top)),
+                  self.max_seq_len)
+        tokens = np.zeros((1, t_pad), np.int32)
+        tokens[0, :T] = suffix  # left-ALIGNED (pad tail is masked)
         row_cache = _fresh_cache(self.model, 1, pad)
+        if m > 0:
+            nb = len(match.restore_blocks)
+            table = np.zeros((self._blocks_per_seq,), np.int32)
+            table[:nb] = match.restore_blocks
+            row_cache = _restore_blocks(
+                row_cache, self._store, bs, table, np.int32(nb))
         with obs.span("serve/prefill", request=req.request_id,
-                      prompt_len=L):
-            tok0, row_cache = _serve_prefill(
-                self.model, self.params, row_cache,
-                jnp.asarray(tokens), jnp.asarray([L], jnp.int32))
+                      prompt_len=L, cached=m):
+            if self.lora_bank is None:
+                tok0, row_cache = _serve_prefill(
+                    self.model, self.params, row_cache,
+                    jnp.asarray(tokens), jnp.asarray([T], jnp.int32),
+                    jnp.asarray([m], jnp.int32))
+            else:
+                tok0, row_cache = _serve_prefill_lora(
+                    self.model, self.params, row_cache,
+                    jnp.asarray(tokens), jnp.asarray([T], jnp.int32),
+                    jnp.asarray([m], jnp.int32), self.lora_bank,
+                    jnp.asarray([req.adapter], jnp.int32))
             first = int(np.asarray(tok0)[0])
+        if match is not None:
+            # restored rows are copied out; the COW tail pin can drop
+            self.prefix_cache.finish_restore(match)
+            req.prefix_match = None
         now = time.monotonic()
         req.t_first_token = now
         self._h_ttft.observe(now - req.t_submit)
+        self._h_ttft_tenant.observe(now - req.t_submit,
+                                    tenant=req.tenant)
         self._cache = _insert_row(self._cache, row_cache, slot)
-        self._slots[slot] = _Slot(req, first, depth=L)
+        self._slots[slot] = _Slot(req, first, depth=L, cached=m)
         self._h_last[slot] = first
         self._h_depth[slot] = L
         self._h_active[slot] = True
+        self._h_adapter[slot] = req.adapter
         self._c_tokens.inc()  # the prefill-produced first token
         flight.record("serve", "admit", step=self.scheduler.round,
-                      note=f"{req.request_id} slot={slot} L={L}")
+                      note=f"{req.request_id} slot={slot} L={L} "
+                           f"cached={m}")
 
     def _decode_round(self):
         """THE hot loop body (see module docstring for the lint
@@ -322,9 +510,15 @@ class ServingEngine:
         # injected slow round shows up in the latency histograms
         # exactly like a real one
         chaos.on_step(self.scheduler.round)
-        nxt, depth, self._cache = _serve_step(
-            self.model, self.params, self._cache, self._d_last,
-            self._d_depth, self._d_active)
+        if self.lora_bank is None:
+            nxt, depth, self._cache = _serve_step(
+                self.model, self.params, self._cache, self._d_last,
+                self._d_depth, self._d_active)
+        else:
+            nxt, depth, self._cache = _serve_step_lora(
+                self.model, self.params, self._cache, self._d_last,
+                self._d_depth, self._d_active, self.lora_bank,
+                self._d_adapter)
         self._d_last, self._d_depth = nxt, depth
         host_tok = np.asarray(nxt)
         return host_tok, time.monotonic() - t0
@@ -359,11 +553,35 @@ class ServingEngine:
             self._h_active[i] = False
             retired += 1
             req = s.req
+            if self.prefix_cache is not None:
+                # donate BEFORE retire: release() indexes the physical
+                # blocks into the radix, so their bytes must already be
+                # in the store when another admission can match them
+                self._donate_blocks(i, s)
             self.scheduler.retire(req, np.asarray(s.tokens, np.int32))
             flight.record("serve", "retire", step=self.scheduler.round,
                           note=f"{req.request_id} tokens={s.emitted}")
             self._finish_record(req, s)
         return retired
+
+    def _donate_blocks(self, slot: int, s: _Slot) -> None:
+        """Copy the retiring slot's full KV blocks into the device
+        store. Count matches what ``PrefixCache.release`` will index:
+        ``depth // block_size`` full blocks (depth = prompt + emitted
+        - 1 = exactly the rows whose tokens the scheduler hands to
+        release). Re-saving a block the radix already owns writes
+        bit-identical bytes — harmless."""
+        pool = self.scheduler.pool
+        bs = pool.block_size
+        table = pool.block_table(s.req.request_id)
+        nb = min(s.depth // bs, len(table))
+        if nb == 0:
+            return
+        padded = np.zeros((self._blocks_per_seq,), np.int32)
+        padded[:nb] = table[:nb]
+        self._store = _save_blocks(
+            self._cache, self._store, bs,
+            np.int32(slot), padded, np.int32(nb))
 
     def _finish_record(self, req: Request, s: _Slot) -> None:
         ttft = req.t_first_token - req.t_submit
@@ -392,6 +610,8 @@ class ServingEngine:
             rounds_waited=req.round_admitted - req.round_submitted,
             kv_util=self.scheduler.pool.utilization(),
             waterfall=waterfall,
+            tenant=req.tenant, adapter=req.adapter,
+            cached_tokens=s.cached,
         )
         if self.tag:
             rec["replica"] = self.tag
@@ -425,12 +645,13 @@ class ServingEngine:
         self._d_last = jnp.asarray(self._h_last)
         self._d_depth = jnp.asarray(self._h_depth)
         self._d_active = jnp.asarray(self._h_active)
+        self._d_adapter = jnp.asarray(self._h_adapter)
 
     def summary(self) -> dict:
         """Engine-lifetime aggregates (bench + serve_summary JSONL)."""
         rounds = len(self.round_seconds)
         occ = self._occ_sum / max(rounds * self.max_slots, 1)
-        return dict(
+        out = dict(
             rounds=rounds,
             requests_done=len(self.completed),
             tokens_out=int(sum(r["new_tokens"] for r in self.completed)),
@@ -438,3 +659,6 @@ class ServingEngine:
             kv_util=self.scheduler.pool.utilization(),
             queue_depth=self.scheduler.queue_depth,
         )
+        if self.prefix_cache is not None:
+            out.update(self.prefix_cache.stats())
+        return out

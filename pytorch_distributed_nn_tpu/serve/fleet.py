@@ -414,7 +414,8 @@ class Fleet:
             _, row = _serve_prefill(
                 self.model, self.params, row,
                 jnp.zeros((1, pad), jnp.int32),
-                jnp.asarray([int(plen)], jnp.int32))
+                jnp.asarray([int(plen)], jnp.int32),
+                jnp.zeros((1,), jnp.int32))
             cache = _insert_row(cache, row, 0)
         nxt, _, _ = _serve_step(
             self.model, self.params, cache,
@@ -527,7 +528,7 @@ class Fleet:
         or the chosen replica rejects. Returns the replica index that
         accepted the request, None otherwise."""
         h = self.router.place(self._replicas,
-                              len(prompt) + max_new)
+                              len(prompt) + max_new, prompt=prompt)
         if h is None:
             self._finalize_rejected(ticket, "no_replica")
             return None

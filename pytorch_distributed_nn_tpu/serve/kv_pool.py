@@ -28,10 +28,23 @@ Design (and its honest scope):
   physical layout — the honest reading is "paged admission control over
   a dense cache". The allocator API is the kernel-ready one so a Pallas
   paged-attention kernel can slot in without scheduler changes;
+- **prefix sharing** (the Mosaic tentpole): a block has three lives.
+  *Live-exclusive* — inside exactly one sequence's table (the classic
+  case above). *Live-shared* — inside several tables at once via
+  ``reserve(shared=...)``, refcounted; the blocks return to circulation
+  only when the last sharer frees them. *Cached* — refcount-zero blocks
+  a retiring sequence donated with ``free(retain=...)`` park in an LRU
+  ring instead of the free list, so :mod:`serve.prefix_cache` can hand
+  them to a later request that shares the prefix. The free list stays
+  the backpressure truth (``free_blocks`` never counts cached blocks);
+  the prefix cache sheds cached blocks with :meth:`release_cached` when
+  a cold reservation needs them back, honoring :meth:`pin` (a
+  copy-on-write tail mid-restore must not vanish under the engine);
 - utilization lands in the metric registry as gauges
   (``serve_kv_blocks_total`` / ``serve_kv_blocks_reserved`` /
-  ``serve_kv_blocks_used``) every time the pool changes, so dashboards
-  and :mod:`scripts.obs_report` see cache pressure without polling.
+  ``serve_kv_blocks_used`` / ``serve_kv_blocks_cached``) every time the
+  pool changes, so dashboards and :mod:`scripts.obs_report` see cache
+  pressure without polling.
 
 Thread-safety: one lock around every mutation — the scheduler thread
 and submitting client threads both touch the pool.
@@ -40,6 +53,8 @@ and submitting client threads both touch the pool.
 from __future__ import annotations
 
 import threading
+from collections import OrderedDict
+from typing import Iterable
 
 from pytorch_distributed_nn_tpu.obs.registry import get_registry
 
@@ -60,6 +75,13 @@ class KVPool:
         self._tables: dict[str, list[int]] = {}
         # seq_id -> tokens actually written (high-water mark)
         self._used_tokens: dict[str, int] = {}
+        # phys block -> live sharer count (only blocks entered via
+        # reserve(shared=); exclusively-owned blocks have no entry)
+        self._ref: dict[int, int] = {}
+        # refcount-0 donated blocks, LRU order (oldest first)
+        self._cached: OrderedDict[int, None] = OrderedDict()
+        # cached blocks the prefix cache is mid-restore on: eviction-proof
+        self._pinned: set[int] = set()
         reg = get_registry()
         self._g_total = reg.gauge(
             "serve_kv_blocks_total", "KV pool size in blocks")
@@ -68,6 +90,9 @@ class KVPool:
             "sequences")
         self._g_used = reg.gauge(
             "serve_kv_blocks_used", "KV blocks backing written tokens")
+        self._g_cached = reg.gauge(
+            "serve_kv_blocks_cached", "refcount-0 prefix blocks parked "
+            "in the cached-LRU ring")
         self._g_total.set(num_blocks)
         self._publish_locked()
 
@@ -79,10 +104,11 @@ class KVPool:
         return -(-max(int(tokens), 0) // self.block_size)
 
     def _publish_locked(self) -> None:
-        reserved = self.num_blocks - len(self._free)
+        reserved = self.num_blocks - len(self._free) - len(self._cached)
         used = sum(self.blocks_for(t) for t in self._used_tokens.values())
         self._g_reserved.set(reserved)
         self._g_used.set(used)
+        self._g_cached.set(len(self._cached))
 
     # -- allocator ---------------------------------------------------------
 
@@ -90,19 +116,47 @@ class KVPool:
         with self._lock:
             return self.blocks_for(tokens) <= len(self._free)
 
-    def reserve(self, seq_id: str, tokens: int) -> bool:
+    def reserve(self, seq_id: str, tokens: int,
+                shared: Iterable[int] = ()) -> bool:
         """Reserve blocks for a sequence's worst-case ``tokens`` rows.
         False (and no state change) when the pool can't cover it — the
         scheduler's backpressure signal. A second reserve for a live
-        ``seq_id`` is a programming error and raises."""
+        ``seq_id`` is a programming error and raises.
+
+        ``shared`` prepends already-materialized prefix blocks (from
+        the cached ring or another live sharer's table) to this
+        sequence's block table instead of allocating fresh ones: a
+        cached block leaves the ring and becomes live with refcount 1;
+        an already-live shared block just gains a sharer. Only the
+        remainder ``blocks_for(tokens) - len(shared)`` comes off the
+        free list, which is the whole prefix-cache win."""
+        shared = list(shared)
         n = self.blocks_for(tokens)
+        n_fresh = n - len(shared)
+        if n_fresh < 0:
+            raise ValueError(
+                f"sequence {seq_id!r}: {len(shared)} shared blocks exceed "
+                f"the {n}-block reservation for {tokens} tokens")
         with self._lock:
             if seq_id in self._tables:
                 raise ValueError(f"sequence {seq_id!r} already holds a "
                                  f"reservation")
-            if n > len(self._free):
+            for b in shared:
+                if b not in self._cached and b not in self._ref \
+                        and not any(b in t for t in self._tables.values()):
+                    raise ValueError(
+                        f"shared block {b} is neither cached nor live — "
+                        f"the prefix index is stale")
+            if n_fresh > len(self._free):
                 return False
-            self._tables[seq_id] = [self._free.pop() for _ in range(n)]
+            for b in shared:
+                if b in self._cached:
+                    del self._cached[b]
+                    self._ref[b] = 1
+                else:
+                    self._ref[b] = self._ref.get(b, 1) + 1
+            self._tables[seq_id] = shared + [
+                self._free.pop() for _ in range(n_fresh)]
             self._used_tokens[seq_id] = 0
             self._publish_locked()
             return True
@@ -125,18 +179,85 @@ class KVPool:
                 self._used_tokens[seq_id] = int(tokens)
                 self._publish_locked()
 
-    def free(self, seq_id: str) -> int:
+    def free(self, seq_id: str,
+             retain: frozenset[int] = frozenset()) -> int:
         """Return a finished sequence's blocks to the pool; returns the
-        block count released. Freeing an unknown id is a no-op (retire
-        paths race benignly with cancel paths)."""
+        block count that reached the free list. Freeing an unknown id
+        is a no-op (retire paths race benignly with cancel paths).
+
+        Blocks still held by another sharer just drop a refcount and
+        stay live. Zero-ref blocks named in ``retain`` park in the
+        cached-LRU ring (table order, so the prefix chain ages
+        coherently) instead of going free — the donation half of the
+        prefix cache."""
         with self._lock:
             table = self._tables.pop(seq_id, None)
             self._used_tokens.pop(seq_id, None)
             if not table:
                 return 0
-            self._free.extend(reversed(table))
+            released = []
+            for b in table:
+                if b in self._ref:
+                    self._ref[b] -= 1
+                    if self._ref[b] > 0:
+                        continue  # another sharer keeps it live
+                    del self._ref[b]
+                if b in retain:
+                    self._cached[b] = None
+                    self._cached.move_to_end(b)
+                else:
+                    released.append(b)
+            self._free.extend(reversed(released))
             self._publish_locked()
-            return len(table)
+            return len(released)
+
+    # -- cached-LRU ring ---------------------------------------------------
+
+    def is_cached(self, block: int) -> bool:
+        with self._lock:
+            return block in self._cached
+
+    def refcount(self, block: int) -> int:
+        """Live sharer count for a shared block (0: cached, free, or
+        exclusively owned)."""
+        with self._lock:
+            return self._ref.get(block, 0)
+
+    def cached_lru(self) -> list[int]:
+        """Cached blocks, least-recently-touched first — the prefix
+        cache's eviction scan order."""
+        with self._lock:
+            return list(self._cached)
+
+    def touch_cached(self, block: int) -> None:
+        """Refresh a cached block's recency (a peek/partial match that
+        did not promote it to live still proves it is useful)."""
+        with self._lock:
+            if block in self._cached:
+                self._cached.move_to_end(block)
+
+    def pin(self, block: int) -> None:
+        """Make a cached block eviction-proof while the engine copies
+        its rows (the COW-tail restore window)."""
+        with self._lock:
+            self._pinned.add(block)
+
+    def unpin(self, block: int) -> None:
+        with self._lock:
+            self._pinned.discard(block)
+
+    def release_cached(self, block: int) -> bool:
+        """Evict one cached block to the free list. False — and no
+        state change — when the block is pinned or not cached (already
+        evicted, or promoted to live by a sharer in between): the
+        prefix cache's eviction scan treats False as "pick another"."""
+        with self._lock:
+            if block in self._pinned or block not in self._cached:
+                return False
+            del self._cached[block]
+            self._free.append(block)
+            self._publish_locked()
+            return True
 
     # -- introspection -----------------------------------------------------
 
@@ -150,11 +271,19 @@ class KVPool:
             return len(self._free)
 
     @property
+    def cached_blocks(self) -> int:
+        with self._lock:
+            return len(self._cached)
+
+    @property
     def live_sequences(self) -> int:
         with self._lock:
             return len(self._tables)
 
     def utilization(self) -> float:
-        """Reserved fraction of the pool, in [0, 1]."""
+        """Live-reserved fraction of the pool, in [0, 1]. Cached blocks
+        are reclaimable, so they count as headroom here even though
+        they are off the free list."""
         with self._lock:
-            return (self.num_blocks - len(self._free)) / self.num_blocks
+            return (self.num_blocks - len(self._free)
+                    - len(self._cached)) / self.num_blocks

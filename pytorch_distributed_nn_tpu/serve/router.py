@@ -12,7 +12,7 @@ real backpressure.
 
 Placement score, higher is better::
 
-    score = kv_headroom_frac - queue_frac
+    score = kv_headroom_frac - queue_frac + prefix_affinity
 
 - ``kv_headroom_frac`` — the replica's free KV blocks *after* this
   request's worst-case reservation (``ceil((L + max_new) / block)``),
@@ -20,7 +20,14 @@ Placement score, higher is better::
   scores negative and is only chosen when every ready replica is in
   the same state (the request then queues there, FIFO);
 - ``queue_frac`` — waiting requests over ``max_queue``: deep queues
-  repel new work even when KV is free (TTFT lives in the queue).
+  repel new work even when KV is free (TTFT lives in the queue);
+- ``prefix_affinity`` — when the caller passes the ``prompt``, the
+  fraction of its tokens already resident in the replica's prefix
+  cache (``PrefixCache.peek`` — read-only, no counters, no LRU touch).
+  A replica holding a tenant's system-prompt blocks beats an equally
+  idle cold one: the hit saves real prefill FLOPs and KV blocks, worth
+  more than a few percent of raw headroom. Weight 1.0: a full-prompt
+  hit outbids any headroom gap < 100% of a pool.
 
 Only ``READY`` replicas are candidates: ``starting``/``reloading``
 replicas are warming, ``draining`` replicas are being rolled, ``dead``
@@ -101,10 +108,14 @@ class Router:
         queue_frac = sched.queue_depth / max(sched.max_queue, 1)
         return headroom - queue_frac
 
-    def place(self, replicas, total_tokens: int):
+    def place(self, replicas, total_tokens: int, *, prompt=None,
+              adapter: int = 0):
         """Pick the best READY replica for a request of
         ``total_tokens`` worst-case KV footprint; None when no replica
         is ready (the fleet rejects the request as ``no_replica``).
+        ``prompt`` (optional token array) turns on prefix affinity:
+        replicas whose prefix cache already holds a chunk of the
+        prompt (for this ``adapter``) score higher.
 
         THE placement choke point: every decision — including the
         failure to make one — lands in
@@ -115,6 +126,10 @@ class Router:
             if handle.state != READY:
                 continue
             score = self._score(handle, total_tokens)
+            if prompt is not None and len(prompt) > 0:
+                pc = getattr(handle.engine, "prefix_cache", None)
+                if pc is not None:
+                    score += pc.peek(prompt, adapter) / len(prompt)
             if best is None or score > best_score:
                 best, best_score = handle, score
         self._c_placements.inc(

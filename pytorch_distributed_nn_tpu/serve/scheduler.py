@@ -12,15 +12,35 @@ batch-stepper:
   ``backpressure`` instead of growing an unbounded buffer the server
   then OOMs on. Chaos load-shedding (``serve_reject@p=``) and oversize
   prompts (``too_large``) reject at the same choke point;
-- **anti-starvation**: admission is STRICT FIFO with no bypass. If the
-  queue head does not fit (batch slot or KV-pool reservation), nothing
-  behind it is admitted this round — smaller requests cannot
-  leapfrog a big one forever. With reservation-at-admission
-  (:mod:`serve.kv_pool`) every running sequence finishes within its
-  token budget, so the head waits at most the longest remaining budget
-  before capacity frees: every admitted request finishes within a
-  bounded number of scheduler rounds (tested under sustained overload
-  in tests/test_serve.py);
+- **anti-starvation**: admission is STRICT FIFO *per tenant* with no
+  bypass, deficit-round-robin across tenants. Each tenant holds its
+  own FIFO deque; admission rotates through the tenant ring taking at
+  most one request per tenant per turn, so a tenant with a thousand
+  queued requests cannot monopolize the prefill budget — the light
+  tenant's head is at most one rotation away. Within a tenant the old
+  invariant holds: if the head does not fit (batch slot or KV-pool
+  reservation), nothing is admitted this round — smaller requests
+  cannot leapfrog a big one forever, and with
+  reservation-at-admission (:mod:`serve.kv_pool`) every running
+  sequence finishes within its token budget, so every admitted request
+  finishes within a bounded number of scheduler rounds (tested under
+  sustained overload in tests/test_serve.py). A reserve failure breaks
+  the whole round, not just the tenant — skipping to a neighbor's
+  smaller request would starve the big-request tenant forever;
+- **tenant quotas**: ``tenant_quotas={"name": n}`` caps a tenant's
+  *live* residency (queued + running) at n; a submit past the cap is
+  rejected ``tenant_quota`` at the same choke point as backpressure.
+  The cap bounds concurrency, not total service: as the tenant's
+  requests retire, new ones fit again — a flash crowd sheds its excess
+  instead of starving its neighbors (drilled by chaos
+  ``tenant_flood@tenant=...:rps=...``);
+- **prefix-cache admission**: with a :class:`serve.prefix_cache
+  .PrefixCache` attached, admission goes through
+  :meth:`PrefixCache.admit` instead of a bare ``pool.reserve`` — a
+  resident shared prefix is reserved by reference and the engine
+  prefills only the suffix; retirement donates the finished sequence's
+  full blocks back to the index (:meth:`retire` →
+  :meth:`PrefixCache.release`);
 - **interleave**: at most ``max_prefills_per_round`` queued requests
   are admitted per round. Prefill is O(prompt) compute injected into
   the decode cadence — unbounded admission would stall every running
@@ -31,7 +51,8 @@ batch-stepper:
   a batch slot it can no longer use.
 
 Every request state change goes through :meth:`Scheduler._transition`,
-which increments the ``serve_requests_total{state=}`` counter — the
+which increments the ``serve_requests_total{state=}`` counter AND the
+per-tenant ``serve_tenant_requests_total{tenant,state}`` counter — the
 test_quality.py lint enforces that no admit/reject/retire path can
 bypass the accounting. Rejections additionally bump
 ``serve_rejects_total{reason=}`` and land a ``serve`` event in the
@@ -92,6 +113,15 @@ class Request:
     # already counted queued/running in its first life on a replica
     # that died — _transition must not double-count those states
     resubmitted: bool = False
+    # multi-tenant serving (Mosaic): quota/fairness identity + the
+    # per-request LoRA adapter; prefix_match is the PrefixMatch the
+    # admission pass stored (the engine's restore/suffix-prefill input)
+    tenant: str = "default"
+    adapter: int = 0
+    prefix_match: object = None
+    # True while this request holds a slot in its tenant's live-quota
+    # count (set on QUEUED, dropped on any terminal transition)
+    quota_held: bool = False
 
     @property
     def total_tokens(self) -> int:
@@ -107,7 +137,9 @@ class Scheduler:
 
     def __init__(self, pool: KVPool, *, max_queue: int = 64,
                  max_seq_len: int = 0,
-                 max_prefills_per_round: int = 2) -> None:
+                 max_prefills_per_round: int = 2,
+                 tenant_quotas: Optional[dict] = None,
+                 prefix_cache=None) -> None:
         if max_queue < 1:
             raise ValueError(f"max_queue must be >= 1, got {max_queue}")
         if max_prefills_per_round < 1:
@@ -117,8 +149,19 @@ class Scheduler:
         self.max_queue = max_queue
         self.max_seq_len = int(max_seq_len)
         self.max_prefills_per_round = max_prefills_per_round
+        self.tenant_quotas = dict(tenant_quotas or {})
+        for tenant, quota in self.tenant_quotas.items():
+            if quota < 1:
+                raise ValueError(f"tenant quota must be >= 1, got "
+                                 f"{quota} for {tenant!r}")
+        self.prefix_cache = prefix_cache  # PrefixCache | None
         self._lock = threading.Lock()
-        self._queue: collections.deque[Request] = collections.deque()
+        # per-tenant FIFO deques + the DRR rotation ring (tenant names
+        # in rotation order; the front tenant has next claim)
+        self._queues: dict[str, collections.deque[Request]] = {}
+        self._rr: collections.deque[str] = collections.deque()
+        self._queued = 0  # total waiting across tenants (max_queue cap)
+        self._live: dict[str, int] = {}  # tenant -> queued + running
         self.round = 0  # advanced by the engine, one per decode round
         self.draining = False
         self.metrics = None  # MetricsLogger; set by the owning engine
@@ -126,6 +169,10 @@ class Scheduler:
         self._c_requests = reg.counter(
             "serve_requests_total", "request state transitions",
             labels=("state",))
+        self._c_tenant = reg.counter(
+            "serve_tenant_requests_total",
+            "request state transitions, per tenant",
+            labels=("tenant", "state"))
         self._c_rejects = reg.counter(
             "serve_rejects_total", "requests rejected at admission",
             labels=("reason",))
@@ -150,6 +197,16 @@ class Scheduler:
         # distinct shed event and already spends the TTFT budget once).
         if not (req.resubmitted and state in (QUEUED, RUNNING)):
             self._c_requests.inc(state=state)
+            self._c_tenant.inc(tenant=req.tenant, state=state)
+        # tenant live-residency (the quota denominator): held from
+        # QUEUED until any terminal state — running requests still
+        # count against their tenant's cap
+        if state == QUEUED and not req.quota_held:
+            req.quota_held = True
+            self._live[req.tenant] = self._live.get(req.tenant, 0) + 1
+        elif state in (DONE, REJECTED, FAILED) and req.quota_held:
+            req.quota_held = False
+            self._live[req.tenant] -= 1
         if state == REJECTED:
             req.reject_reason = reason
             self._c_rejects.inc(reason=reason)
@@ -159,10 +216,12 @@ class Scheduler:
             # when TPUNN_WATCH is unset), and the JSONL stream must
             # carry it too or obs_watch replay can't reproduce the
             # burn page the live tower raised
-            watchtower.on_serve_reject(req.request_id, reason)
+            watchtower.on_serve_reject(req.request_id, reason,
+                                       tenant=req.tenant)
             if self.metrics is not None:
                 self.metrics.emit("serve_reject",
-                                  request_id=req.request_id, reason=reason)
+                                  request_id=req.request_id, reason=reason,
+                                  tenant=req.tenant)
         if state in (DONE, REJECTED, FAILED):
             req.t_done = time.monotonic()
             req.round_done = self.round
@@ -173,7 +232,9 @@ class Scheduler:
     def submit(self, prompt, max_new_tokens: int, *,
                deadline_s: Optional[float] = None,
                request_id: Optional[str] = None,
-               resubmit: bool = False) -> Request:
+               resubmit: bool = False,
+               tenant: str = "default",
+               adapter: int = 0) -> Request:
         """Thread-safe admission attempt. Always returns a Request; a
         rejected one is already terminal (``done`` set, ``state ==
         REJECTED``, ``reject_reason`` says why). ``resubmit`` marks a
@@ -191,7 +252,9 @@ class Scheduler:
             request_id=request_id or f"req-{next(_ids)}",
             deadline_s=deadline_s, t_submit=time.monotonic(),
             resubmitted=bool(resubmit),
+            tenant=str(tenant), adapter=int(adapter),
         )
+        quota = self.tenant_quotas.get(req.tenant)
         with self._lock:
             req.round_submitted = self.round
             if self.draining:
@@ -202,56 +265,106 @@ class Scheduler:
                 # chaos already emitted its own flight event (emit-first
                 # lint); this transition adds the scheduler's view
                 self._transition(req, REJECTED, reason="chaos")
-            elif len(self._queue) >= self.max_queue:
+            elif quota is not None \
+                    and self._live.get(req.tenant, 0) >= quota:
+                self._transition(req, REJECTED, reason="tenant_quota")
+            elif self._queued >= self.max_queue:
                 self._transition(req, REJECTED, reason="backpressure")
             else:
-                self._queue.append(req)
+                q = self._queues.get(req.tenant)
+                if q is None:
+                    q = self._queues[req.tenant] = collections.deque()
+                    self._rr.append(req.tenant)
+                q.append(req)
+                self._queued += 1
                 self._transition(req, QUEUED)
-            self._g_queue.set(len(self._queue))
+            self._g_queue.set(self._queued)
         return req
 
     # -- engine side (one thread) ------------------------------------------
 
+    def _reserve_locked(self, head: Request) -> bool:
+        """One admission's KV reservation: through the prefix cache
+        when attached (shared-prefix blocks reserved by reference, the
+        match stored on the request for the engine's restore pass),
+        bare ``pool.reserve`` otherwise. False = backpressure."""
+        if self.prefix_cache is not None:
+            match = self.prefix_cache.admit(
+                head.request_id, head.prompt, head.total_tokens,
+                adapter=head.adapter)
+            if match is None:
+                return False
+            head.prefix_match = match
+            return True
+        return self.pool.reserve(head.request_id, head.total_tokens)
+
     def next_admissions(self, free_slots: int) -> list[Request]:
-        """Pop FIFO-eligible requests for this round: each must fit a
-        free batch slot AND reserve its worst-case KV blocks. Strict
-        FIFO — a head that doesn't fit blocks everything behind it
-        (that's the anti-starvation invariant, not an inefficiency to
-        optimize away without replacing the fairness proof)."""
+        """Pop eligible requests for this round: deficit round-robin
+        across tenants (one request per tenant per rotation turn),
+        strict FIFO within a tenant. Each admission must fit a free
+        batch slot AND reserve its worst-case KV blocks. A head that
+        can't reserve ends the whole round — no bypass, across tenants
+        too (that's the anti-starvation invariant, not an inefficiency
+        to optimize away without replacing the fairness proof)."""
         admitted: list[Request] = []
         now = time.monotonic()
         with self._lock:
-            while (self._queue and free_slots > 0
+            while (self._queued and free_slots > 0
                    and len(admitted) < self.max_prefills_per_round):
-                head = self._queue[0]
+                # front of the rotation with work; ring stays put so
+                # an emptied tenant doesn't burn a turn
+                for _ in range(len(self._rr)):
+                    if self._queues[self._rr[0]]:
+                        break
+                    self._rr.rotate(-1)
+                q = self._queues[self._rr[0]]
+                if not q:
+                    break
+                head = q[0]
                 if head.deadline_s is not None and now > head.deadline_s:
-                    self._queue.popleft()
+                    q.popleft()
+                    self._queued -= 1
                     self._transition(head, REJECTED, reason="deadline")
                     continue
-                if not self.pool.reserve(head.request_id,
-                                         head.total_tokens):
+                if not self._reserve_locked(head):
                     break  # no bypass: wait for blocks to free
-                self._queue.popleft()
+                q.popleft()
+                self._queued -= 1
                 head.t_admit = now
                 head.round_admitted = self.round
                 self._transition(head, RUNNING)
                 admitted.append(head)
                 free_slots -= 1
-            self._g_queue.set(len(self._queue))
+                self._rr.rotate(-1)  # this tenant's turn is spent
+            self._g_queue.set(self._queued)
         return admitted
 
     def retire(self, req: Request, tokens: np.ndarray) -> None:
         """A sequence finished (eos or budget): release its blocks and
-        hand the tokens to the waiting client."""
+        hand the tokens to the waiting client. With a prefix cache the
+        release is a *donation*: the full blocks covering the written
+        rows (prompt + all emitted tokens except the last, whose KV row
+        was never computed) are indexed and parked cached instead of
+        freed. The engine has already saved those rows to the device
+        block store by the time this runs."""
         req.tokens = np.asarray(tokens, np.int32)
-        self.pool.free(req.request_id)
+        if self.prefix_cache is not None:
+            covered = (np.concatenate([req.prompt, req.tokens[:-1]])
+                       if len(req.tokens) else req.prompt)
+            self.prefix_cache.release(req.request_id, covered,
+                                      adapter=req.adapter)
+        else:
+            self.pool.free(req.request_id)
         with self._lock:
             self._transition(req, DONE)
 
     def fail(self, req: Request, reason: str) -> None:
         """Evict a running sequence (engine error path). Blocks are
         freed; the client sees FAILED, not a hang."""
-        self.pool.free(req.request_id)
+        if self.prefix_cache is not None:
+            self.prefix_cache.abandon(req.request_id)
+        else:
+            self.pool.free(req.request_id)
         with self._lock:
             req.reject_reason = reason
             self._transition(req, FAILED)
@@ -263,14 +376,16 @@ class Scheduler:
         sequences are the engine's to finish. Returns rejected count."""
         with self._lock:
             self.draining = True
-            n = len(self._queue)
-            while self._queue:
-                self._transition(self._queue.popleft(), REJECTED,
-                                 reason="draining")
+            n = self._queued
+            for q in self._queues.values():
+                while q:
+                    self._transition(q.popleft(), REJECTED,
+                                     reason="draining")
+            self._queued = 0
             self._g_queue.set(0)
         return n
 
     @property
     def queue_depth(self) -> int:
         with self._lock:
-            return len(self._queue)
+            return self._queued

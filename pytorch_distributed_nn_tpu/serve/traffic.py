@@ -42,7 +42,12 @@ Shape kinds:
   ``prompt_med``/``prompt_sigma`` (lognormal: median, log-σ) or
   ``prompt_a`` (zipf exponent, heavy tail over 1..``prompt_max``), and
   the ``out_*`` twins; ``prompt_min``/``prompt_max``/``out_min``/
-  ``out_max`` clamp. Keys: ``name`` (required), ``weight``, dist keys.
+  ``out_max`` clamp. ``prefix_len``/``n_prefixes`` model shared
+  system prompts: each arrival's prompt starts with one of the
+  tenant's ``n_prefixes`` (default 1) fixed seeded prefixes of
+  ``prefix_len`` tokens (picked uniformly per arrival), followed by a
+  unique suffix — the load shape prefix caching is built for. Keys:
+  ``name`` (required), ``weight``, dist keys, prefix keys.
 
 Arrivals are a non-homogeneous Poisson process sampled by thinning
 (Lewis-Shedler) from a single ``random.Random(seed)`` stream — exact
@@ -61,6 +66,7 @@ import math
 import os
 import random
 import time
+import zlib
 from typing import Callable, Optional
 
 import numpy as np
@@ -73,7 +79,8 @@ TRAFFIC_KINDS = ("steady", "diurnal", "flash", "tenant")
 
 # typed key tables (the chaos parse_spec contract: every key is named
 # here or the spec fails loudly)
-_INT_KEYS = ("prompt_min", "prompt_max", "out_min", "out_max")
+_INT_KEYS = ("prompt_min", "prompt_max", "out_min", "out_max",
+             "prefix_len", "n_prefixes")
 _FLOAT_KEYS = ("rps", "duration_s", "amplitude", "period_s", "phase",
                "at_s", "peak", "ramp_s", "hold_s", "weight",
                "prompt_med", "prompt_sigma", "prompt_a",
@@ -116,6 +123,14 @@ def _validate(shape: Shape) -> None:
         raise ValueError("traffic flash: peak must be > 0")
     if a.get("weight", 1.0) <= 0:
         raise ValueError("traffic tenant: weight must be > 0")
+    if a.get("prefix_len", 0) < 0:
+        raise ValueError("traffic tenant: prefix_len must be >= 0")
+    if a.get("n_prefixes", 1) < 1:
+        raise ValueError("traffic tenant: n_prefixes must be >= 1")
+    if "n_prefixes" in a and a.get("prefix_len", 0) <= 0:
+        raise ValueError(
+            "traffic tenant: n_prefixes without prefix_len is "
+            "meaningless (set prefix_len > 0)")
     for side in ("prompt", "out"):
         dist = a.get(side, "lognormal")
         if dist not in _DISTS:
@@ -330,7 +345,7 @@ def generate_trace(spec: TrafficSpec, *, seed: int = 0,
             continue
         ten = tenants[bisect.bisect_left(cum, rng.random() * acc)]
         idx = len(trace)
-        trace.append({
+        rec = {
             "i": idx,
             "t": round(t, 6),
             "tenant": ten.args.get("name", "default"),
@@ -339,7 +354,22 @@ def generate_trace(spec: TrafficSpec, *, seed: int = 0,
             "max_new": _sample_len(rng, ten.args, "out",
                                    default_med=16.0, default_max=128),
             "prompt_seed": (seed * 1_000_003 + idx) & 0x7FFFFFFF,
-        })
+        }
+        prefix_len = int(ten.args.get("prefix_len", 0))
+        if prefix_len > 0:
+            # shared-system-prompt shape: pick one of the tenant's
+            # fixed prefixes. The extra rng draw happens ONLY for
+            # prefix tenants, so specs without prefix_len generate
+            # byte-identical traces to older versions.
+            pidx = rng.randrange(int(ten.args.get("n_prefixes", 1)))
+            tenant_ns = zlib.crc32(rec["tenant"].encode())
+            rec["prefix_len"] = prefix_len
+            rec["prefix_seed"] = ((seed * 1_000_033 + tenant_ns * 31
+                                   + pidx) & 0x7FFFFFFF)
+            # the prompt must extend past its prefix by >= 1 token
+            # (a cached prefix still needs a suffix to prefill)
+            rec["prompt_len"] = max(rec["prompt_len"], prefix_len + 1)
+        trace.append(rec)
     return trace
 
 
@@ -373,10 +403,21 @@ def load_trace(path: str) -> list[dict]:
 def prompt_tokens(rec: dict, vocab_size: int) -> np.ndarray:
     """The prompt for a trace record — derived from its
     ``prompt_seed``, so replay regenerates identical tokens without
-    serializing them."""
+    serializing them. Records carrying ``prefix_seed`` (the
+    ``prefix_len=`` tenant grammar) start with the shared seeded
+    prefix — every record with the same prefix_seed gets the same
+    leading tokens, which is what makes replayed traffic exercise the
+    prefix cache — followed by a per-request suffix."""
+    total = int(rec["prompt_len"])
     rng = np.random.default_rng(int(rec["prompt_seed"]))
+    if "prefix_seed" in rec:
+        plen = min(int(rec["prefix_len"]), total - 1)
+        prng = np.random.default_rng(int(rec["prefix_seed"]))
+        prefix = prng.integers(0, vocab_size, size=(plen,))
+        suffix = rng.integers(0, vocab_size, size=(total - plen,))
+        return np.concatenate([prefix, suffix]).astype(np.int32)
     return rng.integers(0, vocab_size,
-                        size=(int(rec["prompt_len"]),)).astype(np.int32)
+                        size=(total,)).astype(np.int32)
 
 
 # ---------------------------------------------------------------------------

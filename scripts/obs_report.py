@@ -170,12 +170,50 @@ def print_metric_tail(events: list[dict], last: int) -> None:
                   f"acc {_num(e, 'accuracy'):.4f}")
 
 
+def _print_tenant_rows(reqs: list[dict], rejects: list[dict]) -> None:
+    """Per-tenant breakdown of the serving section (Mosaic). One row
+    per tenant: completed requests, tokens out, prefix-cache hit rate
+    (``cached_tokens`` over prompt tokens), TTFT p50/p95, and rejects
+    split into quota (reason ``tenant_quota``) vs shed (everything
+    else). Skipped when the run is single-tenant with no rejects —
+    the global percentiles above already tell that story."""
+    per: dict[str, list[dict]] = {}
+    for e in reqs:
+        per.setdefault(str(e.get("tenant", "default")), []).append(e)
+    rej: dict[str, list[dict]] = {}
+    for e in rejects:
+        rej.setdefault(str(e.get("tenant", "default")), []).append(e)
+    tenants = sorted(set(per) | set(rej))
+    if len(tenants) <= 1 and not rejects:
+        return
+    print("-- per tenant --")
+    print(f"{'tenant':>12} {'reqs':>5} {'tokens':>7} {'hit':>6} "
+          f"{'ttft_p50':>10} {'ttft_p95':>10} {'quota':>6} {'shed':>5}")
+    for name in tenants:
+        rs = per.get(name, [])
+        ttft = [_num(e, "ttft_s") for e in rs]
+        toks = sum(int(_num(e, "new_tokens")) for e in rs)
+        prompt = sum(int(_num(e, "prompt_len")) for e in rs)
+        cached = sum(int(_num(e, "cached_tokens")) for e in rs)
+        hit = _fmt_pct(cached / prompt).strip() if prompt else "-"
+        quota = sum(1 for e in rej.get(name, [])
+                    if e.get("reason") == "tenant_quota")
+        shed = len(rej.get(name, [])) - quota
+        print(f"{name:>12} {len(rs):>5} {toks:>7} {hit:>6} "
+              f"{_fmt_s(percentile(ttft, 0.50)) if rs else '         -'} "
+              f"{_fmt_s(percentile(ttft, 0.95)) if rs else '         -'} "
+              f"{quota:>6} {shed:>5}")
+
+
 def print_serving_table(events: list[dict], last: int) -> bool:
     """Serving SLO section: per-request TTFT / per-token latency
     percentiles from ``serve_request`` events (scripts/serve.py
-    --metrics-out) plus the run-level ``serve_summary`` line. Silently
+    --metrics-out), the per-tenant breakdown (Mosaic: TTFT, prefix-cache
+    hit rate from ``cached_tokens``, quota rejects from ``serve_reject``
+    events), plus the run-level ``serve_summary`` line. Silently
     skipped when the file has no serving events (training-only runs)."""
     reqs = [e for e in events if e.get("event") == "serve_request"]
+    rejects = [e for e in events if e.get("event") == "serve_reject"]
     summary = next((e for e in reversed(events)
                     if e.get("event") == "serve_summary"), None)
     if not reqs and summary is None:
@@ -199,6 +237,7 @@ def print_serving_table(events: list[dict], last: int) -> bool:
             print(f"KV-pool utilization at retire: mean "
                   f"{_fmt_pct(sum(kv) / len(kv)).strip()}, peak "
                   f"{_fmt_pct(max(kv)).strip()}")
+        _print_tenant_rows(reqs, rejects)
         print("-- request tail --")
         for e in reqs[-last:]:
             print(f"  {e.get('request_id', '?'):>8}  "

@@ -67,6 +67,26 @@ def _no_flight_dumps_in_repo_root():
 
 
 @pytest.fixture(scope="session")
+def tiny_llama():
+    """One CI-scale llama shared across the serving test files
+    (test_serve.py, test_prefix_cache.py) so the serve jits compile
+    once per session instead of once per module."""
+    import jax.numpy as jnp
+
+    from pytorch_distributed_nn_tpu.config import ModelConfig
+    from pytorch_distributed_nn_tpu.models import get_model
+
+    model = get_model(ModelConfig(
+        name="llama3_8b", compute_dtype="float32", dtype="float32",
+        extra=dict(num_layers=2, d_model=64, num_heads=4, num_kv_heads=2,
+                   mlp_dim=128, vocab_size=97),
+    ))
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.key(1), tokens, train=False)["params"]
+    return model, params
+
+
+@pytest.fixture(scope="session")
 def devices():
     devs = jax.devices()
     assert len(devs) == 8, f"expected 8 fake CPU devices, got {len(devs)}"

@@ -220,6 +220,104 @@ def test_prefix_abandon_keeps_pool_consistent():
 
 
 # ---------------------------------------------------------------------------
+# Parity fixture: scripted workloads vs a reference model (the
+# test_store_parity pattern — the real radix/pool can never drift from
+# the simple model of what matching and block accounting MUST do)
+# ---------------------------------------------------------------------------
+
+def _common_len(a, b) -> int:
+    n = min(len(a), len(b))
+    for i in range(n):
+        if int(a[i]) != int(b[i]):
+            return i
+    return n
+
+
+def _ref_match(chains, prompt, bs: int) -> int:
+    """Reference prediction of ``PrefixMatch.tokens``: ``chains`` is
+    every indexed covered-token sequence (block-quantized, as release
+    indexes them). The radix walk is greedy full blocks then one COW
+    tail, and every radix root-path is a prefix of some released
+    chain, so the expected match is a pure function of the chains."""
+    cap = len(prompt) - 1
+    full = 0
+    for c in chains:
+        common = _common_len(c, prompt)
+        full = max(full, min(common // bs, cap // bs) * bs)
+    t = cap - full
+    if 0 < t < bs and any(_common_len(c, prompt) >= cap
+                          for c in chains):
+        return full + t
+    return full
+
+
+def test_parity_scripted_workload_matches_reference_model():
+    bs = 4
+    pool = KVPool(num_blocks=128, block_size=bs)
+    pc = PrefixCache(pool, max_rows=64)
+    rng = np.random.default_rng(11)
+    bases = [rng.integers(1, VOCAB, size=12).astype(np.int32)
+             for _ in range(3)]
+    chains: dict = {0: [], 1: []}  # adapter -> indexed token tuples
+    hits = misses = saved = 0
+    for i in range(24):
+        adapter = int(rng.integers(0, 2))
+        head = bases[int(rng.integers(0, 3))][:int(rng.integers(4, 13))]
+        suffix = rng.integers(1, VOCAB,
+                              size=int(rng.integers(1, 9)))
+        prompt = np.concatenate([head, suffix]).astype(np.int32)
+        exp = _ref_match(chains[adapter], prompt, bs)
+        m = pc.admit(f"s{i}", prompt, len(prompt) + 2, adapter=adapter)
+        assert m is not None  # 128 blocks: never deferred
+        assert m.tokens == exp, (i, list(prompt), exp, m.tokens)
+        hits += 1 if exp > 0 else 0
+        misses += 0 if exp > 0 else 1
+        saved += exp
+        pc.finish_restore(m)
+        if rng.random() < 0.8:
+            covered = np.concatenate(
+                [prompt, rng.integers(1, VOCAB, size=1)]
+            ).astype(np.int32)
+            pc.release(f"s{i}", covered, adapter=adapter)
+            chains[adapter].append(tuple(
+                int(x) for x in covered[:len(covered) // bs * bs]))
+        else:
+            pc.abandon(f"s{i}")
+        # block conservation after every op: nothing live between
+        # ops, so free + cached must cover the whole pool
+        assert pool.live_sequences == 0
+        assert pool.free_blocks + pool.cached_blocks == pool.num_blocks
+    s = pc.stats()
+    assert s["prefix_evictions"] == 0  # the reference assumes no evicts
+    assert s["prefix_hits"] == hits and s["prefix_misses"] == misses
+    assert s["prefix_tokens_saved"] == saved
+    assert hits >= 5 and misses >= 5  # the script exercises both paths
+
+
+def test_parity_accounting_invariant_under_eviction_pressure():
+    """Same conservation law when the pool is small enough that admits
+    pre-evict cached chains: defer is allowed (None), but blocks can
+    never leak — free + cached always re-covers the pool once nothing
+    is live."""
+    pool = KVPool(num_blocks=8, block_size=4)
+    pc = PrefixCache(pool, max_rows=32)
+    rng = np.random.default_rng(7)
+    admitted = 0
+    for i in range(16):
+        prompt = rng.integers(
+            1, VOCAB, size=int(rng.integers(6, 14))).astype(np.int32)
+        m = pc.admit(f"e{i}", prompt, len(prompt) + 1)
+        if m is not None:
+            admitted += 1
+            pc.finish_restore(m)
+            pc.release(f"e{i}", prompt)
+        assert pool.live_sequences == 0
+        assert pool.free_blocks + pool.cached_blocks == pool.num_blocks
+    assert admitted >= 8
+    assert pc.stats()["prefix_evictions"] > 0
+
+
+# ---------------------------------------------------------------------------
 # Engine goldens: cache ON == cache OFF == sequential generate
 # ---------------------------------------------------------------------------
 

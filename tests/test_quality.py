@@ -591,6 +591,84 @@ def test_scheduler_state_changes_only_through_counted_transition():
     )
 
 
+def test_prefix_index_changes_only_through_counted_account():
+    """ISSUE 14 lint: the prefix cache's radix index mirrors the
+    scheduler's request lifecycle — every structural change (a chain
+    indexed, a block evicted, a hit/miss/defer decided) must land in
+    ``PrefixCache._account`` (counters + hit-rate gauge + flight
+    ring). Structural proof: (a) every method that mutates the index
+    (``_nodes`` / ``_by_phys`` subscript assignment or delete) calls
+    ``_account`` itself, except the bare unlink helper
+    ``_drop_locked``; (b) ``_drop_locked``'s ONLY caller is
+    ``_evict_locked``, which accounts each evicted block (the
+    accounting-delegate pattern — same shape as the collective
+    wrappers); (c) ``_account`` bumps all four prefix counters and
+    records a flight event."""
+    tree = ast.parse((_SERVE / "prefix_cache.py").read_text())
+    cls = next(n for n in tree.body if isinstance(n, ast.ClassDef)
+               and n.name == "PrefixCache")
+    methods = {n.name: n for n in cls.body
+               if isinstance(n, ast.FunctionDef)}
+    assert "_account" in methods
+
+    def mutates_index(fn) -> bool:
+        for node in ast.walk(fn):
+            targets = []
+            if isinstance(node, ast.Assign):
+                targets = node.targets
+            elif isinstance(node, ast.Delete):
+                targets = node.targets
+            for t in targets:
+                if (isinstance(t, ast.Subscript)
+                        and isinstance(t.value, ast.Attribute)
+                        and t.value.attr in ("_nodes", "_by_phys")):
+                    return True
+        return False
+
+    offenders = []
+    for name, fn in methods.items():
+        if name in ("_account", "_drop_locked", "__init__"):
+            continue
+        if mutates_index(fn) and "_account" not in _calls_in(fn):
+            offenders.append(f"PrefixCache.{name}")
+    assert not offenders, (
+        f"radix index mutated without _account (bypasses the "
+        f"serve_kv_prefix_* accounting): {offenders}"
+    )
+
+    # (b) the unlink helper is only reachable through the accounting
+    # eviction path
+    droppers = [name for name, fn in methods.items()
+                if name != "_drop_locked"
+                and "_drop_locked" in _calls_in(fn)]
+    assert droppers == ["_evict_locked"], (
+        f"_drop_locked (unlinks without accounting) must only be "
+        f"called by _evict_locked, found callers: {droppers}"
+    )
+    assert "_account" in _calls_in(methods["_evict_locked"])
+
+    # (c) the choke point actually feeds every counter + the ring
+    incremented = set()
+    account_calls = set()
+    for node in ast.walk(methods["_account"]):
+        if isinstance(node, ast.Call) and isinstance(node.func,
+                                                     ast.Attribute):
+            if (node.func.attr == "inc"
+                    and isinstance(node.func.value, ast.Attribute)):
+                incremented.add(node.func.value.attr)
+            if (node.func.attr == "record"
+                    and isinstance(node.func.value, ast.Name)
+                    and node.func.value.id == "flight"):
+                account_calls.add("flight.record")
+    assert {"_c_hits", "_c_misses", "_c_evictions",
+            "_c_saved"} <= incremented, (
+        f"_account must bump all prefix counters, found "
+        f"{sorted(incremented)}"
+    )
+    assert "flight.record" in account_calls, \
+        "_account must record a flight-ring event"
+
+
 def test_decode_hot_loop_has_no_host_device_transfers():
     """ISSUE 5 lint: ``ServingEngine._decode_round`` is the per-token
     hot path — it must not construct or upload device arrays (``jnp.``

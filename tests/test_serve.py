@@ -18,15 +18,11 @@ import sys
 import time
 from pathlib import Path
 
-import jax
-import jax.numpy as jnp
 import numpy as np
 import pytest
 
 from pytorch_distributed_nn_tpu import obs
-from pytorch_distributed_nn_tpu.config import ModelConfig
 from pytorch_distributed_nn_tpu.inference.generate import generate
-from pytorch_distributed_nn_tpu.models import get_model
 from pytorch_distributed_nn_tpu.obs import flight
 from pytorch_distributed_nn_tpu.runtime import chaos
 from pytorch_distributed_nn_tpu.serve import (
@@ -53,16 +49,8 @@ def _fresh(monkeypatch):
     chaos.reset()
 
 
-@pytest.fixture(scope="module")
-def tiny_llama():
-    model = get_model(ModelConfig(
-        name="llama3_8b", compute_dtype="float32", dtype="float32",
-        extra=dict(num_layers=2, d_model=64, num_heads=4, num_kv_heads=2,
-                   mlp_dim=128, vocab_size=VOCAB),
-    ))
-    tokens = jnp.zeros((1, 8), jnp.int32)
-    params = model.init(jax.random.key(1), tokens, train=False)["params"]
-    return model, params
+# tiny_llama comes from conftest.py (session-scoped): one model shared
+# with test_prefix_cache.py so the serve jits compile once per session.
 
 
 def _prompts(lengths, seed=0):
