@@ -148,6 +148,10 @@ _METRIC_NAMES = {
     # (serve/procfleet.py) at CI-scale dims — mixing it into the
     # thread-fleet band would false-alarm whichever mode ran last
     "fleet_procs": "process-fleet serving tokens/sec (tiny)",
+    # disaggregated prefill/decode pools (serve/disagg.py): its own
+    # series — the unified-fleet baseline rides in vs_baseline, and
+    # mixing pool topologies into one band would mask either
+    "disagg": "disagg fleet serving tokens/sec (llama3_8b_zero)",
     # higher-is-better on purpose: no latency/seconds substring, so the
     # ledger (obs.xray.metric_direction) gates a DROP in capacity
     "capacity": "capacity sustainable req/s (llama3_8b_zero)",
@@ -1040,6 +1044,8 @@ def bench_fleet(args) -> int:
     their emitted prefix, and the record carries p99 TTFT with and
     without the kill — the failover tax the paper's robustness story
     must bound (acceptance: < 2x the steady-state p99)."""
+    if args.disagg:
+        return _bench_fleet_disagg(args)
     if args.fleet_procs:
         return _bench_fleet_procs(args)
     import jax
@@ -1141,6 +1147,142 @@ def bench_fleet(args) -> int:
         detail=f"open-loop {args.serve_rate:g} req/s, {n_req} ragged "
                f"requests, {slots} slots/replica, {n_rep} replicas vs "
                f"1; kill drill: kill_replica@replica=1:step=5"
+               + (" [tiny dims]" if args.serve_tiny else ""),
+    )
+    return 0
+
+
+def _bench_fleet_disagg(args) -> int:
+    """--fleet --disagg: disaggregated prefill/decode pools
+    (serve/disagg.py) vs a unified fleet of the SAME total replica
+    count, under deliberately mixed traffic — long-prompt/short-budget
+    requests (prefill-bound) interleaved with short-prompt/long-budget
+    ones (decode-bound), the head-of-line mix disaggregation exists
+    for. Emits the disagg fleet's tokens/s on its own ledger series
+    with ``vs_baseline`` = disagg/unified, p99 TTFT for both
+    topologies, and the drill column: p99 TTFT with a
+    ``kill_transfer@`` chaos fault killing the KV-stream source
+    mid-transfer (the decode leg re-prefills cold on a survivor)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorch_distributed_nn_tpu.config import get_config
+    from pytorch_distributed_nn_tpu.models import get_model
+    from pytorch_distributed_nn_tpu.runtime import chaos
+    from pytorch_distributed_nn_tpu.serve import Fleet
+    from pytorch_distributed_nn_tpu.serve.engine import _bucket_len
+
+    cfg = get_config("llama3_8b_zero")
+    if args.serve_tiny:
+        cfg.model.extra = dict(num_layers=4, d_model=256, num_heads=8,
+                               num_kv_heads=4, mlp_dim=1024,
+                               vocab_size=1024)
+        cfg.model.compute_dtype = "float32"
+    else:
+        cfg.model.extra = dict(num_layers=8, d_model=1024, num_heads=8,
+                               num_kv_heads=4, mlp_dim=3584,
+                               vocab_size=32000)
+    cfg.model.remat = False
+    model = get_model(cfg.model)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32),
+                        train=False)["params"]
+
+    slots = args.per_chip_batch or 4
+    n_pre = max(args.fleet_prefill, 1)
+    n_dec = max(args.fleet_decode, 1)
+    n_req = max(args.serve_requests, slots * (n_pre + n_dec))
+    max_seq = 64 if args.serve_tiny else 256
+    # the disaggregation workload: alternate prefill-bound requests
+    # (prompt near max_seq, 2-token budget) with decode-bound ones
+    # (short prompt, deep budget)
+    long_budget, short_budget = 2, 32
+    long_len = max_seq - long_budget - 2
+    rng = np.random.default_rng(0)
+    prompts, budgets = [], []
+    for i in range(n_req):
+        if i % 2 == 0:
+            n_tok, budget = long_len, long_budget
+        else:
+            n_tok, budget = 8, min(short_budget, max_seq - 10)
+        prompts.append(rng.integers(
+            1, model.vocab_size, size=(n_tok,)).astype(np.int32))
+        budgets.append(budget)
+    warm_lens = sorted({min(_bucket_len(len(p)), max_seq)
+                        for p in prompts})
+    period = 1.0 / args.serve_rate if args.serve_rate > 0 else 0.0
+
+    def run(fleet_kw: dict, kill: str | None):
+        chaos.reset()
+        if kill:
+            chaos.maybe_init(kill)
+        fleet = Fleet(model, params, max_slots=slots,
+                      max_seq_len=max_seq, max_queue=n_req,
+                      **fleet_kw)
+        fleet.start(warmup_prompt_lens=warm_lens)
+        t0 = time.perf_counter()
+        t_next = t0
+        tickets = []
+        for p, n in zip(prompts, budgets):
+            wait = t_next - time.perf_counter()
+            if wait > 0:
+                time.sleep(wait)
+            t_next += period
+            tickets.append(fleet.submit(p, n))
+        for t in tickets:
+            t.wait(300.0)
+        wall = time.perf_counter() - t0
+        fleet.stop()
+        chaos.reset()
+        done = list(fleet.completed)
+        toks = sum(c["new_tokens"] for c in done)
+        ttfts = np.array([c["ttft_s"] for c in done
+                          if c["ttft_s"] >= 0.0])
+        transfers = list(getattr(fleet, "transfers", ()))
+        return dict(tps=toks / wall, ttfts=ttfts,
+                    completed=len(done), failovers=fleet.failovers,
+                    transfers=transfers)
+
+    unified = run(dict(replicas=n_pre + n_dec), None)
+    disagg = run(dict(prefill=n_pre, decode=n_dec), None)
+    # kill the KV-stream source on the 2nd transfer: mid-run, after
+    # the pools have warmed into steady handoff traffic
+    chaotic = run(dict(prefill=n_pre, decode=n_dec),
+                  "kill_transfer@step=2")
+
+    def p99(xs):
+        return float(np.percentile(xs, 99)) if len(xs) else 0.0
+
+    backend = jax.default_backend()
+    from pytorch_distributed_nn_tpu.utils.metrics import MetricsLogger
+
+    n_ok = sum(1 for t in disagg["transfers"]
+               if t["outcome"] == "ok")
+    MetricsLogger(stream=sys.stdout).emit_benchmark(
+        metric=_METRIC_NAMES["disagg"],
+        value=round(disagg["tps"], 1), unit="tokens/sec",
+        vs_baseline=round(disagg["tps"] / unified["tps"], 3),
+        vs_baseline_kind=f"disagg_{n_pre}p{n_dec}d_over_unified_"
+                         f"{n_pre + n_dec}r",
+        backend=backend,
+        prefill_replicas=n_pre, decode_replicas=n_dec,
+        requests=n_req, completed=disagg["completed"],
+        unified_tokens_per_s=round(unified["tps"], 1),
+        ttft_p99_ms=round(p99(disagg["ttfts"]) * 1e3, 2),
+        unified_ttft_p99_ms=round(p99(unified["ttfts"]) * 1e3, 2),
+        ttft_p99_with_kill_ms=round(p99(chaotic["ttfts"]) * 1e3, 2),
+        kill_tokens_per_s=round(chaotic["tps"], 1),
+        kill_completed=chaotic["completed"],
+        kill_failovers=chaotic["failovers"],
+        kv_transfers=len(disagg["transfers"]),
+        kv_transfers_ok=n_ok,
+        kv_transfer_bytes=sum(t["bytes"]
+                              for t in disagg["transfers"]),
+        detail=f"open-loop {args.serve_rate:g} req/s, {n_req} mixed "
+               f"long-prefill/long-decode requests, {slots} "
+               f"slots/replica, {n_pre}p+{n_dec}d vs unified "
+               f"{n_pre + n_dec}r; kill drill: kill_transfer@step=2"
                + (" [tiny dims]" if args.serve_tiny else ""),
     )
     return 0
@@ -1793,6 +1935,96 @@ def _fleet_selftest() -> int:
     return 0
 
 
+def _disagg_selftest() -> int:
+    """--fleet --disagg --selftest: the CPU-scale disaggregation gate
+    (tier-1 via tests/test_quality.py). No accelerator — a 2-layer
+    toy llama on CPU, synchronous fleet drive. Asserts the Estuary
+    invariants end to end:
+
+    1. ``Fleet(prefill=P, decode=D)`` output is bit-identical to the
+       unified ``Fleet(replicas=P+D)`` for the same mixed workload;
+    2. at least one KV block stream ran, its wire bytes visible in
+       goodput accounting (``collectives.recording``) and the flight
+       ring;
+    3. a ``kill_transfer@`` chaos fault mid-transfer kills the source
+       replica, the decode leg re-prefills cold on a survivor, and the
+       stitched output is STILL bit-identical (counted as
+       ``outcome="failed"`` in the transfer log)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from pytorch_distributed_nn_tpu.config import ModelConfig
+    from pytorch_distributed_nn_tpu.models import get_model
+    from pytorch_distributed_nn_tpu.obs import flight
+    from pytorch_distributed_nn_tpu.ops import collectives
+    from pytorch_distributed_nn_tpu.runtime import chaos
+    from pytorch_distributed_nn_tpu.serve import Fleet
+    from pytorch_distributed_nn_tpu.serve.disagg import DisaggFleet
+
+    vocab = 97
+    model = get_model(ModelConfig(
+        name="llama3_8b", compute_dtype="float32", dtype="float32",
+        extra=dict(num_layers=2, d_model=64, num_heads=4,
+                   num_kv_heads=2, mlp_dim=128, vocab_size=vocab)))
+    params = model.init(jax.random.key(1),
+                        jnp.zeros((1, 8), jnp.int32),
+                        train=False)["params"]
+    rng = np.random.default_rng(7)
+    # mixed shape: two long-prompt/short-budget, two short/long; the
+    # 34-token prompts span 2 full 16-token blocks, so the prefill
+    # leg's donated chain is streamable
+    prompts = [rng.integers(1, vocab, size=(n,)).astype(np.int32)
+               for n in (34, 6, 37, 9)]
+    budgets = [2, 8, 3, 6]
+
+    def run_all(fleet):
+        tickets = [fleet.submit(p, n)
+                   for p, n in zip(prompts, budgets)]
+        fleet.run_until_idle()
+        outs = []
+        for t in tickets:
+            assert t.ok, (t.status, t.reject_reason)
+            outs.append(list(t.tokens))
+        return outs
+
+    chaos.reset()
+    flight.reset_recorder(enabled=True)
+    golden = run_all(Fleet(model, params, replicas=3, max_slots=2,
+                           max_seq_len=64, block_size=16))
+
+    with collectives.recording() as records:
+        fleet = Fleet(model, params, prefill=1, decode=2,
+                      max_slots=2, max_seq_len=64, block_size=16)
+        assert isinstance(fleet, DisaggFleet), type(fleet)
+        got = run_all(fleet)
+    assert got == golden, f"disagg output diverged:\n{got}\n{golden}"
+    streams = [r for r in records if r.op == "kv_transfer"]
+    assert streams and all(r.bytes_wire > 0 for r in streams), \
+        "no KV stream reached the collectives choke point"
+    ring = [e for e in flight.get_recorder().snapshot()
+            if e["kind"] == "fleet" and e["op"] == "kv_transfer"]
+    assert ring, "KV stream left no flight-ring event"
+    assert any(t["outcome"] == "ok" for t in fleet.transfers), \
+        fleet.transfers
+
+    chaos.maybe_init("kill_transfer@step=1", rank=0, seed=0)
+    fleet = Fleet(model, params, prefill=2, decode=2, max_slots=2,
+                  max_seq_len=64, block_size=16)
+    got = run_all(fleet)
+    assert got == golden, \
+        f"kill_transfer broke bit-identity:\n{got}\n{golden}"
+    assert any(t["outcome"] == "failed" for t in fleet.transfers), \
+        f"chaos kill never hit a transfer: {fleet.transfers}"
+    assert any(e["op"] == "state:dead" for e in
+               flight.get_recorder().snapshot()
+               if e["kind"] == "fleet"), \
+        "mid-transfer kill did not declare the source dead"
+    chaos.reset()
+    print("disagg selftest ok")
+    return 0
+
+
 def _ledger_selftest() -> int:
     """End-to-end gate check on synthetic trajectories (tier-1 smoke,
     tests/test_quality.py): an in-band series must pass, a regressed
@@ -1833,6 +2065,44 @@ def _ledger_selftest() -> int:
         os.remove(os.path.join(d, "BENCH_r06.json"))
         v3 = xray.check_ledger(xray.load_bench_records(d))
         assert v3["ok"], f"NLL improvement flagged: {v3}"
+
+    # tail-borne series: a round that benches several series in one
+    # invocation (--fleet also running --fleet-procs or --disagg)
+    # prints one benchmark line per series, but the driver's single
+    # `parsed` slot keeps only one — the stdout tail recovers the rest
+    # so EVERY emitted series joins the tracked trajectory
+    with tempfile.TemporaryDirectory(prefix="tpunn-ledger-") as d:
+        def tail_for(v):
+            line = json.dumps({
+                "event": "benchmark", "time": 0.0, "process": 0,
+                "metric": "process-fleet tokens/sec (selftest)",
+                "value": v, "unit": "tokens/sec"})
+            return "warmup noise\nnot json {\n" + line + "\n"
+
+        def write_pair(n, v_fleet, v_procs):
+            with open(os.path.join(d, f"BENCH_r{n:02d}.json"),
+                      "w") as f:
+                json.dump({"n": n, "cmd": "selftest", "rc": 0,
+                           "parsed": {
+                               "metric": "fleet tokens/sec (selftest)",
+                               "value": v_fleet,
+                               "unit": "tokens/sec"},
+                           "tail": tail_for(v_procs)}, f)
+
+        for n, (vf, vp) in enumerate(
+                [(100.0, 50.0), (101.0, 51.0), (99.0, 49.5)], start=1):
+            write_pair(n, vf, vp)
+        v4 = xray.check_ledger(xray.load_bench_records(d))
+        names = {m["metric"] for m in v4["metrics"]}
+        assert "process-fleet tokens/sec (selftest)" in names, \
+            f"tail-borne series not tracked: {v4}"
+        assert v4["ok"], v4
+        # a regression in the tail-only series must be flagged even
+        # though every parsed slot stays healthy
+        write_pair(4, 100.2, 20.0)
+        v5 = xray.check_ledger(xray.load_bench_records(d))
+        assert not v5["ok"] and any(
+            "process-fleet" in r for r in v5["regressions"]), v5
     print("ledger selftest ok")
     return 0
 
@@ -1921,6 +2191,18 @@ def main(argv=None) -> int:
     ap.add_argument("--fleet-replicas", type=int, default=3,
                     help="fleet metric: replica count for the scaling "
                          "and kill-drill runs")
+    ap.add_argument("--disagg", action="store_true",
+                    help="fleet metric: bench the disaggregated "
+                         "prefill/decode fleet (serve/disagg.py) under "
+                         "mixed long-prefill/long-decode traffic vs a "
+                         "unified fleet of the same total size, plus a "
+                         "kill_transfer@ mid-stream drill (with "
+                         "--selftest: the CPU-scale bit-identity + "
+                         "chaos gate)")
+    ap.add_argument("--fleet-prefill", type=int, default=2,
+                    help="--disagg: prefill-pool replica count")
+    ap.add_argument("--fleet-decode", type=int, default=2,
+                    help="--disagg: decode-pool replica count")
     ap.add_argument("--fleet-procs", type=int, default=0,
                     help="fleet metric: run the PROCESS-backed fleet "
                          "instead — this many replica subprocesses "
@@ -2040,6 +2322,9 @@ def main(argv=None) -> int:
     if args.metric == "autoscale" and args.selftest:
         return _autoscale_selftest()  # pure: no backend, no probe
     if args.metric == "fleet" and args.selftest:
+        if args.disagg:
+            # CPU-scale gate: disagg bit-identity + kill_transfer drill
+            return _disagg_selftest()
         # no backend in this process: stub subprocess workers over a
         # real native store — the coordinator-restart drill
         return _fleet_selftest()

@@ -813,12 +813,50 @@ def metric_direction(name: str) -> str:
             else "higher")
 
 
+def _parse_tail_metrics(tail) -> list[dict]:
+    """Benchmark records embedded in a record's captured-stdout
+    ``tail``. The driver parses ONE record per round into ``parsed``,
+    but a round that benches several series in one invocation (e.g.
+    ``--fleet`` emitting the thread-fleet AND the ``--fleet-procs`` /
+    ``--disagg`` series) prints one JSON line per series; this
+    recovers the rest so every emitted series joins the tracked
+    trajectory. Accepts both shapes MetricsLogger produces — the
+    event-wrapped ``{"event": "benchmark", ...}`` line and the bare
+    ``{"metric", "value", "unit", ...}`` record — and tolerates a
+    missing/garbled tail (older and synthetic records have none)."""
+    if isinstance(tail, str):
+        lines = tail.splitlines()
+    elif isinstance(tail, (list, tuple)):
+        lines = [str(x) for x in tail]
+    else:
+        return []
+    out = []
+    for ln in lines:
+        ln = ln.strip()
+        if not (ln.startswith("{") and '"metric"' in ln):
+            continue
+        try:
+            d = json.loads(ln)
+        except ValueError:
+            continue
+        if not isinstance(d, dict) \
+                or d.get("event") not in (None, "benchmark") \
+                or not isinstance(d.get("metric"), str) \
+                or not isinstance(d.get("value"), (int, float)):
+            continue
+        out.append({k: v for k, v in d.items()
+                    if k not in ("event", "time", "process")})
+    return out
+
+
 def load_bench_records(directory=".",
                        pattern: str = "BENCH_r*.json") -> list[dict]:
     """The BENCH_r*.json trajectory, ordered by round number ``n``.
     Unreadable files are skipped (a torn write must not kill the
     gate); records with ``parsed: null`` (failed runs) are kept so the
-    checker can report how many it ignored."""
+    checker can report how many it ignored. Extra benchmark lines in
+    each record's stdout tail land in ``_tail_metrics`` so multi-series
+    rounds track every series they emitted."""
     recs = []
     for p in sorted(glob.glob(os.path.join(str(directory), pattern))):
         try:
@@ -827,6 +865,7 @@ def load_bench_records(directory=".",
         except (OSError, ValueError):
             continue
         rec.setdefault("_path", p)
+        rec["_tail_metrics"] = _parse_tail_metrics(rec.get("tail"))
         recs.append(rec)
     recs.sort(key=lambda r: (int(r.get("n", 1 << 30)),
                              str(r.get("_path", ""))))
@@ -856,15 +895,27 @@ def check_ledger(records: list[dict], *, mad_k: float = 4.0,
     series: dict[str, list[tuple[int, float, str]]] = {}
     skipped = 0
     for rec in records:
+        entries = []
         parsed = rec.get("parsed")
-        if (not isinstance(parsed, dict)
-                or not isinstance(parsed.get("value"), (int, float))):
+        if (isinstance(parsed, dict)
+                and isinstance(parsed.get("value"), (int, float))):
+            entries.append(parsed)
+        # multi-series rounds: the driver's single `parsed` slot only
+        # holds one record; the rest ride in from the stdout tail
+        # (load_bench_records), deduped on the series name
+        seen = {str(e.get("metric", "unnamed")) for e in entries}
+        for extra in rec.get("_tail_metrics") or ():
+            if str(extra.get("metric", "unnamed")) not in seen:
+                entries.append(extra)
+                seen.add(str(extra.get("metric", "unnamed")))
+        if not entries:
             skipped += 1
             continue
-        metric = str(parsed.get("metric", "unnamed"))
-        series.setdefault(metric, []).append(
-            (int(rec.get("n", -1)), float(parsed["value"]),
-             str(rec.get("_path", ""))))
+        for parsed in entries:
+            metric = str(parsed.get("metric", "unnamed"))
+            series.setdefault(metric, []).append(
+                (int(rec.get("n", -1)), float(parsed["value"]),
+                 str(rec.get("_path", ""))))
     metrics = []
     regressions = []
     for metric in sorted(series):

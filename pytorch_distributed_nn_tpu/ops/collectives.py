@@ -124,6 +124,9 @@ _WIRE = {
     "reduce_scatter": lambda p, n: p * (n - 1) / max(n, 1),
     "ppermute": lambda p, n: float(p),
     "all_to_all": lambda p, n: p * (n - 1) / max(n, 1),
+    # point-to-point KV block streaming (disaggregated serving): one
+    # directed edge moves the whole payload, ppermute-style
+    "kv_transfer": lambda p, n: float(p),
 }
 
 
@@ -143,6 +146,44 @@ def _record(op: str, x, axis: AxisName) -> None:
     # chaos hook (runtime/chaos.py): an injected hang blocks HERE, the
     # same program point a real deadlocked collective wedges
     _chaos.on_collective(op)
+
+
+def kv_transfer(blocks, *, src: str, dst: str, src_index: int = -1,
+                dst_index: int = -1):
+    """Host-side KV block-streaming choke point (disaggregated
+    serving, :mod:`serve.disagg`): ship a pytree of paged KV blocks
+    (leading axis = block id) from replica ``src`` to replica ``dst``
+    and return it unchanged — for the in-process fleet the host arrays
+    ARE the wire.
+
+    This is deliberately the same fan-out as :func:`_record`, minus the
+    named-axis size lookup (there is no mesh axis on a host-side
+    point-to-point edge): the :class:`CommRecorder` sees the wire bytes
+    (goodput's cross-check), the flight ring gets the collective event
+    (post-mortems see every transfer), and the chaos hook may raise
+    :class:`runtime.chaos.TransferKillError` with the payload
+    half-shipped — the caller owns that failover. Lint-enforced
+    (tests/test_quality.py): every KV byte moved between replica
+    engines passes through here, and the only serve-package caller is
+    ``DisaggFleet._stream_blocks``."""
+    leaves = [x for x in jax.tree.leaves(blocks)
+              if getattr(x, "ndim", 0) >= 2]
+    payload = int(sum(x.size * x.dtype.itemsize for x in leaves))
+    n_blocks = int(leaves[0].shape[0]) if leaves else 0
+    edge = f"{src}->{dst}"
+    _recorder.record(CommRecord(
+        op="kv_transfer",
+        bytes_payload=payload,
+        bytes_wire=_WIRE["kv_transfer"](payload, 2),
+        axis=edge,
+    ))
+    _flight.on_collective("kv_transfer", axis=edge, nbytes=payload,
+                          shape=(n_blocks,), dtype="kv_blocks")
+    # chaos hook (runtime/chaos.py): kill_transfer raises HERE, after
+    # the bytes are on the books — a real mid-transfer death also
+    # burned the wire before the receiver noticed
+    _chaos.on_transfer(src_index, dst_index)
+    return blocks
 
 
 # ---------------------------------------------------------------------------

@@ -81,6 +81,18 @@ Spec grammar (faults joined by ``;``)::
                                          rps (wall-clock since arming)
                                          — the quota/fairness drill for
                                          serve/scheduler.py
+    kill_transfer@step=2[:replica=K][:after_s=...]
+                                         raise TransferKillError inside
+                                         the KV block-streaming choke
+                                         point on the step-th transfer
+                                         (process-wide ordinal,
+                                         1-based; replica= narrows to
+                                         one source replica) — the
+                                         mid-transfer-death drill for
+                                         the disaggregated fleet
+                                         (serve/disagg.py): the request
+                                         must re-prefill on a survivor,
+                                         output bit-identical
 
 ``rank`` / ``inc`` (incarnation, from ``TPUNN_RESTART``) are optional
 filters; a fault without them fires in every process / incarnation.
@@ -132,7 +144,7 @@ DEFAULT_HANG_MS = 3_600_000.0
 FAULT_KINDS = ("crash", "hang", "slow", "preempt", "corrupt_ckpt",
                "store_flaky", "serve_reject", "kill_replica",
                "hang_replica", "kill_coordinator", "store_partition",
-               "evict_prefix", "tenant_flood")
+               "evict_prefix", "tenant_flood", "kill_transfer")
 
 _INT_KEYS = ("step", "rank", "inc", "replica")
 _FLOAT_KEYS = ("ms", "p", "after_s", "rps")
@@ -145,6 +157,14 @@ class ReplicaKillError(RuntimeError):
     take the whole fleet down instead of one replica); the fleet
     supervisor catches this — like any other worker exception — and
     runs the failover path."""
+
+
+class TransferKillError(RuntimeError):
+    """Raised by an injected ``kill_transfer`` fault inside the KV
+    block-streaming choke point (``ops.collectives.kv_transfer``): the
+    source replica "dies" with the transfer half on the wire. The
+    disaggregated fleet owns the failover — it declares the source dead
+    and the in-flight request re-prefills cold on a survivor."""
 
 
 class CoordinatorKillError(RuntimeError):
@@ -226,6 +246,7 @@ def _validate(fault: Fault) -> None:
         "kill_replica": ("replica",), "hang_replica": ("replica",),
         "kill_coordinator": ("after_s",), "store_partition": ("ms",),
         "evict_prefix": ("p",), "tenant_flood": ("tenant", "rps"),
+        "kill_transfer": ("step",),
     }[fault.kind]
     for key in need:
         missing = (getattr(fault, key) in (None, "", 0.0)
@@ -271,6 +292,8 @@ class ChaosEngine:
         self._partition_until: dict[int, float] = {}
         # tenant_flood: fault id -> synthetic requests already owed
         self._flood_sent: dict[int, int] = {}
+        # kill_transfer: process-wide KV-transfer ordinal (1-based)
+        self._transfers = 0
 
     def _matches(self, fault: Fault, *, step: int | None = None) -> bool:
         if fault.rank is not None and fault.rank != self.rank:
@@ -424,6 +447,24 @@ class ChaosEngine:
             else:
                 self._inject_hang_replica(fault, replica)
 
+    def transfer(self, src: int, dst: int) -> None:
+        """KV block-streaming hook (kill_transfer). ``step=`` keys on
+        the process-wide transfer ordinal (1-based: the Nth transfer),
+        ``replica=`` optionally narrows to one *source* replica index.
+        Fires once; raises :class:`TransferKillError` mid-transfer."""
+        self._transfers += 1
+        for i, fault in enumerate(self.faults):
+            if (fault.kind != "kill_transfer" or i in self._fired
+                    or (fault.replica is not None
+                        and fault.replica != src)
+                    or not self._matches(fault, step=self._transfers)):
+                continue
+            if fault.after_s \
+                    and time.monotonic() - self._t0 < fault.after_s:
+                continue
+            self._fired.add(i)
+            self._inject_kill_transfer(fault, src, dst)
+
     # -- injections (each one _emits first: lint-enforced) ---------------
 
     def _inject_crash(self, fault: Fault) -> None:
@@ -493,6 +534,12 @@ class ChaosEngine:
         # emit-first (lint): the engine owns the synthetic submissions,
         # each one counted through the scheduler like real traffic
         self._emit(fault, note=f"{fault.spec} [+{n} req]")
+
+    def _inject_kill_transfer(self, fault: Fault, src: int,
+                              dst: int) -> None:
+        self._emit(fault, note=f"{fault.spec} [r{src}->r{dst}]")
+        raise TransferKillError(
+            f"chaos: injected kill mid-transfer r{src}->r{dst}")
 
     def _inject_hang_replica(self, fault: Fault, replica: int) -> None:
         self._emit(fault, note=f"{fault.spec} [replica {replica}]")
@@ -639,6 +686,17 @@ def on_tenant_flood() -> list[tuple[str, int]]:
     if _engine is None:
         return []
     return _engine.tenant_flood()
+
+
+def on_transfer(src: int = -1, dst: int = -1) -> None:
+    """``ops.collectives.kv_transfer`` hook (kill_transfer). May raise
+    :class:`TransferKillError` with the payload half-shipped — the
+    disaggregated fleet (:mod:`serve.disagg`) owns the failover: the
+    source replica is declared dead and the request re-prefills cold
+    on a survivor, stitched output still bit-identical."""
+    if _engine is None:
+        return
+    _engine.transfer(src, dst)
 
 
 def on_replica_round(replica: int, round_: int) -> None:

@@ -27,6 +27,12 @@ Layering (each module's docstring carries its own contract):
   admission point, heartbeat failure detection, chaos-tested failover
   with in-flight re-admission, rolling zero-reject weight reload,
   elastic ``scale_to`` with a warm-before-READY join gate;
+- :mod:`serve.disagg` — Estuary (ISSUE 15): disaggregated
+  prefill/decode pools (``Fleet(prefill=P, decode=D)``), KV block
+  streaming between replicas through the
+  :func:`ops.collectives.kv_transfer` choke point, two-stage
+  stage-aware placement, chaos-tested mid-transfer failover with
+  bit-identical stitched output;
 - :mod:`serve.autoscale` — Helm: the SLO burn-rate autoscaler closing
   the watchtower → fleet loop (``TPUNN_AUTOSCALE`` spec grammar,
   explainable ``autoscale_decision`` journal, hysteresis/cooldowns,
@@ -41,8 +47,8 @@ Layering (each module's docstring carries its own contract):
   restart; journal continuity across incarnations).
 
 CLI: ``scripts/serve.py``, ``scripts/fleet_deploy.py``; load test:
-``bench.py --serve`` / ``bench.py --fleet [--fleet-procs N]``;
-docs: ``docs/serving.md``.
+``bench.py --serve`` / ``bench.py --fleet [--fleet-procs N]`` /
+``bench.py --fleet --disagg``; docs: ``docs/serving.md``.
 """
 
 from pytorch_distributed_nn_tpu.serve.autoscale import (  # noqa: F401
@@ -54,6 +60,9 @@ from pytorch_distributed_nn_tpu.serve.autoscale import (  # noqa: F401
     SimController,
 )
 from pytorch_distributed_nn_tpu.serve import autoscale  # noqa: F401
+from pytorch_distributed_nn_tpu.serve.disagg import (  # noqa: F401
+    DisaggFleet,
+)
 from pytorch_distributed_nn_tpu.serve.engine import (  # noqa: F401
     ServingEngine,
 )

@@ -1,0 +1,350 @@
+"""Disaggregated prefill/decode fleet with KV block streaming.
+
+Serving has two phases with opposite resource shapes: **prefill** is one
+compute-bound GEMM pass over the whole prompt (arithmetic intensity of a
+training step, holds KV for milliseconds), **decode** is a long
+bandwidth-bound drip (one token per round, holds its KV blocks for the
+whole emission). A unified replica runs both, so a long prefill stalls
+every decode sharing its batch — the head-of-line blocking behind TTFT
+p99 cliffs under mixed traffic. Disaggregation (DistServe/Splitwise)
+gives each phase its own replica pool sized independently, at the cost
+of moving the prompt's KV cache between pools.
+
+:class:`DisaggFleet` is that split over the existing fleet machinery —
+``Fleet(model, params, prefill=P, decode=D)`` constructs one (the base
+class dispatches on the kwargs), so every call site that sizes a fleet
+today opts in with two keywords:
+
+- **prefill leg**: the request is admitted on a prefill-pool replica
+  with a budget of exactly 1 token. The engine's continuous-batching
+  admission runs the full prompt prefill, emits the first token, and
+  retires the sequence *in the same pass* — donating its prompt blocks
+  to the replica's prefix cache, which is precisely the state the
+  decode leg needs;
+- **handoff**: when the prefill leg finalizes, the supervisor rewrites
+  the ticket — ``prompt' = prompt + [t1]``, budget ``max_new - 1`` —
+  and places the decode leg through the two-stage router
+  (:meth:`serve.router.Router.place` with ``stage=``: prefill scored by
+  queue depth, decode by KV-headroom-after-reservation plus prefix
+  affinity). Greedy decoding makes the stitch exact: the decode leg's
+  suffix prefill replays the same logits the unified engine would have
+  seen, so stitched output is bit-identical to a unified fleet's;
+- **KV block streaming**: before the decode leg is submitted, the fleet
+  pulls the prompt's resident prefix chain from the peer that owns it —
+  export (:meth:`serve.engine.ServingEngine.export_blocks`), one
+  point-to-point hop through the :func:`ops.collectives.kv_transfer`
+  choke point (wire bytes land in goodput accounting and the flight
+  ring like every other collective), ingest into the destination's
+  radix + store (:meth:`serve.engine.ServingEngine.ingest_blocks`). The
+  decode admission then prefix-matches the streamed blocks and restores
+  instead of re-prefilling. The same path is the prefix-cache miss
+  repair: a decode replica placed by headroom rather than affinity
+  pulls the matched blocks from whichever peer holds them;
+- **failure**: streaming is best-effort and correctness-free. A
+  ``kill_transfer@`` chaos fault (:mod:`runtime.chaos`) raises
+  :class:`runtime.chaos.TransferKillError` with the payload half on the
+  wire; the fleet declares the *source* dead (its stranded requests
+  re-admit through the normal failover path) and the decode leg simply
+  runs cold — it re-prefills on the survivor, output still
+  bit-identical. Warmth is an optimization; the ticket journal is the
+  only durable state.
+
+Scaling: :meth:`Fleet.scale_to` on a disaggregated fleet targets the
+**decode** pool (``_scalable``) — decode is the KV/bandwidth-bound
+class whose pressure the Helm autoscaler actually measures; the prefill
+pool is sized at construction. Thread-fleet only: the process-backed
+fleet (:mod:`serve.procfleet`) keeps unified replicas — streaming
+host-side KV pytrees across process boundaries needs a wire format the
+store protocol doesn't carry yet.
+
+Observability: ``serve_kv_transfer_bytes`` / ``serve_kv_transfer_seconds``
+/ ``serve_kv_transfer_total{outcome}`` and per-class
+``serve_fleet_replicas{role}`` gauges, plus ``handoff`` / ``kv_transfer``
+flight-ring events. Lint-enforced (tests/test_quality.py): the ONLY
+serve-package caller of :func:`ops.collectives.kv_transfer` is
+:meth:`DisaggFleet._stream_blocks`, so every streamed KV byte is on the
+books.
+"""
+
+from __future__ import annotations
+
+import time
+
+import jax
+import numpy as np
+
+from pytorch_distributed_nn_tpu.obs import flight
+from pytorch_distributed_nn_tpu.obs.registry import get_registry
+from pytorch_distributed_nn_tpu.ops import collectives
+from pytorch_distributed_nn_tpu.runtime import chaos
+from pytorch_distributed_nn_tpu.serve.fleet import (
+    Fleet,
+    FleetTicket,
+    ReplicaHandle,
+)
+from pytorch_distributed_nn_tpu.serve.router import DEAD, READY
+from pytorch_distributed_nn_tpu.serve.scheduler import DONE, REJECTED
+
+# transfer latency buckets: an in-process hop is sub-millisecond; a real
+# ICI/DCN block stream for a 100k-token prompt is tens of milliseconds
+_TRANSFER_BUCKETS = (
+    0.0001, 0.00025, 0.0005, 0.001, 0.0025, 0.005, 0.01, 0.025,
+    0.05, 0.1, 0.25, 0.5, 1.0,
+)
+
+
+class DisaggFleet(Fleet):
+    """Prefill pool + decode pool behind the one admission point."""
+
+    def __init__(self, model, params, *, prefill: int = 1,
+                 decode: int = 1, **kw) -> None:
+        if "replicas" in kw:
+            raise TypeError(
+                "DisaggFleet sizes its pools with prefill=/decode=; "
+                "replicas= is the unified Fleet's knob")
+        prefill, decode = int(prefill), int(decode)
+        if prefill < 1 or decode < 1:
+            raise ValueError(
+                f"need at least one replica per pool, got "
+                f"prefill={prefill} decode={decode}")
+        # pool sizes and instruments exist before super().__init__ —
+        # the base constructor calls our _new_handle/_set_state
+        # overrides while building the replica list
+        self.n_prefill = prefill
+        self.n_decode = decode
+        # transfer log for introspection/bench (one dict per attempt)
+        self.transfers: list[dict] = []
+        reg = get_registry()
+        self._c_transfer_bytes = reg.counter(
+            "serve_kv_transfer_bytes",
+            "KV block bytes streamed between replicas")
+        self._c_transfer_total = reg.counter(
+            "serve_kv_transfer_total",
+            "KV block-streaming attempts", labels=("outcome",))
+        self._h_transfer_s = reg.histogram(
+            "serve_kv_transfer_seconds",
+            "KV block-streaming transfer latency",
+            buckets=_TRANSFER_BUCKETS)
+        self._g_replicas = reg.gauge(
+            "serve_fleet_replicas",
+            "ready replicas per pool class", labels=("role",))
+        super().__init__(model, params, replicas=prefill + decode, **kw)
+        # the scalable pool is decode; prefill is fixed at construction
+        self._target_replicas = decode
+        self._publish_roles()
+
+    # -- pool shape --------------------------------------------------------
+
+    def _new_handle(self, index: int) -> ReplicaHandle:
+        h = super()._new_handle(index)
+        # indexes are never reused and only scale_to (decode pool) adds
+        # handles, so the first n_prefill indexes are the prefill pool
+        # for the fleet's whole life
+        h.role = "prefill" if index < self.n_prefill else "decode"
+        return h
+
+    def _set_state(self, h: ReplicaHandle, state: str,
+                   reason: str = "") -> None:
+        super()._set_state(h, state, reason)
+        self._publish_roles()
+
+    def _scalable(self) -> list[ReplicaHandle]:
+        return [h for h in self._replicas if h.role == "decode"]
+
+    def _publish_roles(self) -> None:
+        counts = {"prefill": 0, "decode": 0}
+        for h in getattr(self, "_replicas", ()):
+            if h.state == READY:
+                counts[h.role] = counts.get(h.role, 0) + 1
+        for role, n in counts.items():
+            self._g_replicas.set(n, role=role)
+
+    # -- two-stage placement -----------------------------------------------
+
+    def _place(self, ticket: FleetTicket, prompt: np.ndarray,
+               max_new: int, *, resubmit: bool):
+        """Stage-aware placement (caller holds the fleet lock). A fresh
+        ticket starts its prefill leg with a budget of exactly 1 token;
+        a decode-stage ticket (post-handoff, or a decode-leg failover
+        re-admission) places by KV headroom + affinity and pulls warmth
+        from the owning peer first."""
+        if not ticket.stage:
+            ticket.stage = "prefill"
+        if ticket.stage == "prefill":
+            leg_budget = 1
+            h = self.router.place(self._replicas, len(prompt) + 1,
+                                  prompt=prompt, stage="prefill")
+        else:
+            leg_budget = max_new
+            h = self.router.place(self._replicas,
+                                  len(prompt) + max_new,
+                                  prompt=prompt, stage="decode")
+        if h is None:
+            self._finalize_rejected(ticket, "no_replica")
+            return None
+        if ticket.stage == "decode":
+            # best-effort: a failed/absent stream just means a cold
+            # suffix prefill on h — never a correctness event
+            self._warm_peer(h, prompt)
+        req = h.engine.submit(
+            prompt, leg_budget, deadline_s=ticket.deadline_s,
+            request_id=ticket.request_id, resubmit=resubmit)
+        ticket._attempt = (h.index, req)
+        if req.done.is_set() and req.state == REJECTED:
+            self._finalize_rejected(ticket, req.reject_reason)
+            return None
+        return h.index
+
+    # -- the prefill -> decode handoff -------------------------------------
+
+    def _finalize_tickets(self) -> None:
+        # intercept finished prefill legs before the base finalizer
+        # would stitch them as complete requests
+        for ticket in list(self._journal.values()):
+            if ticket.done.is_set() or ticket._attempt is None \
+                    or ticket.stage != "prefill":
+                continue
+            idx, req = ticket._attempt
+            if req.done.is_set() and req.state == DONE:
+                self._handoff(ticket, idx, req)
+        super()._finalize_tickets()
+
+    def _handoff(self, ticket: FleetTicket, idx: int, req) -> None:
+        """Rewrite a finished prefill leg into its decode leg: the
+        emitted first token joins the stitched prefix, the remaining
+        budget becomes the decode submission. TTFT is the prefill
+        leg's first-token time — handoff latency lands in TBT, not
+        TTFT. A budget-1 request (or an instant EOS) is already
+        complete and finalizes without a decode leg."""
+        emitted = ([int(t) for t in req.tokens]
+                   if req.tokens is not None else [])
+        if ticket.t_first_token == 0.0:
+            ticket.t_first_token = req.t_first_token
+        hit_eos = (self.eos_token is not None and emitted
+                   and emitted[-1] == int(self.eos_token))
+        if hit_eos or len(ticket.prefix) + len(emitted) \
+                >= ticket.max_new_tokens:
+            # _finalize_done stitches prefix + this attempt's tokens
+            self._finalize_done(ticket, idx)
+            return
+        ticket.prefix.extend(emitted)
+        ticket.stage = "decode"
+        remaining = ticket.max_new_tokens - len(ticket.prefix)
+        new_prompt = np.concatenate(
+            [ticket.prompt, np.asarray(ticket.prefix, np.int32)])
+        flight.record("fleet", "handoff",
+                      note=f"{ticket.request_id} r{idx}-> "
+                           f"prefix={len(ticket.prefix)} "
+                           f"remaining={remaining}")
+        if self.metrics is not None:
+            self.metrics.emit("fleet_handoff",
+                              request_id=ticket.request_id,
+                              from_replica=idx,
+                              prefix_tokens=len(ticket.prefix),
+                              remaining=remaining)
+        self._place(ticket, new_prompt, remaining, resubmit=True)
+
+    # -- KV block streaming ------------------------------------------------
+
+    def _warm_peer(self, dst: ReplicaHandle, prompt,
+                   adapter: int = 0) -> int:
+        """Pull the longest resident prefix chain for ``prompt`` from
+        the peer that owns it into ``dst``'s cache, if any peer beats
+        what ``dst`` already holds. Returns blocks ingested (0: nobody
+        warmer, or the stream failed — the caller proceeds cold)."""
+        if dst.engine is None or dst.engine.prefix_cache is None:
+            return 0
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        have = dst.engine.prefix_cache.peek(prompt, adapter)
+        best = best_match = None
+        for h in self._replicas:
+            if h is dst or h.state == DEAD or h.engine is None \
+                    or h.engine.prefix_cache is None:
+                continue
+            m = h.engine.prefix_cache.resident_chain(prompt, adapter)
+            if m.tokens > have and (best_match is None
+                                    or m.tokens > best_match.tokens):
+                best, best_match = h, m
+        if best is None:
+            return 0
+        return self._stream_blocks(best, dst, best_match, prompt,
+                                   adapter)
+
+    def _stream_blocks(self, src: ReplicaHandle, dst: ReplicaHandle,
+                       match, prompt, adapter: int = 0) -> int:
+        """THE transfer path (lint-enforced, tests/test_quality.py):
+        pin the chain on the source, export its block rows, ship them
+        through :func:`ops.collectives.kv_transfer` (wire bytes →
+        goodput + flight ring; a ``kill_transfer@`` chaos fault raises
+        here), ingest into the destination's radix + store. Returns
+        blocks ingested."""
+        pool = src.engine.scheduler.pool
+        blocks = list(match.blocks)
+        for b in blocks:
+            pool.pin(b)
+        t0 = time.monotonic()
+        outcome, ingested, payload = "skipped", 0, 0
+        try:
+            # the chain could have been evicted between match and pin;
+            # re-match under the pins and keep the surviving prefix
+            m2 = src.engine.prefix_cache.resident_chain(prompt, adapter)
+            k = 0
+            while (k < min(len(blocks), len(m2.blocks))
+                   and m2.blocks[k] == blocks[k]):
+                k += 1
+            blocks = blocks[:k]
+            if not blocks:
+                return 0
+            host = src.engine.export_blocks(blocks)
+            payload = int(sum(
+                x.nbytes for x in jax.tree.leaves(host)
+                if getattr(x, "ndim", 0) >= 2))
+            outcome = "failed"  # until the wire round-trips
+            collectives.kv_transfer(
+                host, src=src.name, dst=dst.name,
+                src_index=src.index, dst_index=dst.index)
+            bs = pool.block_size
+            ingested = dst.engine.ingest_blocks(
+                prompt[:len(blocks) * bs], host, adapter)
+            outcome = "ok"
+            return ingested
+        except chaos.TransferKillError:
+            # the source "died" with the payload half on the wire:
+            # declare it dead (its stranded requests re-admit through
+            # the normal failover) and let the caller's decode leg run
+            # cold — re-prefill on the survivor, output unchanged
+            self._fail_replica(src, kind="crash",
+                               reason="crash:kill_transfer")
+            return 0
+        finally:
+            for b in match.blocks:
+                pool.unpin(b)
+            dt = time.monotonic() - t0
+            self._c_transfer_total.inc(outcome=outcome)
+            if outcome != "skipped":
+                self._c_transfer_bytes.inc(payload)
+                self._h_transfer_s.observe(dt)
+            self.transfers.append(dict(
+                src=src.name, dst=dst.name, blocks=len(blocks),
+                ingested=ingested, bytes=payload, outcome=outcome,
+                seconds=round(dt, 6)))
+            flight.record("fleet", "kv_transfer",
+                          note=f"{src.name}->{dst.name} "
+                               f"blocks={len(blocks)} "
+                               f"ingested={ingested} {outcome}")
+            if self.metrics is not None:
+                self.metrics.emit(
+                    "kv_transfer", src=src.index, dst=dst.index,
+                    blocks=len(blocks), ingested=ingested,
+                    bytes=payload, outcome=outcome)
+
+    # -- introspection -----------------------------------------------------
+
+    def summary(self) -> dict:
+        s = super().summary()
+        n_ok = sum(1 for t in self.transfers if t["outcome"] == "ok")
+        s["disagg"] = dict(
+            prefill=self.n_prefill, decode=self.n_decode,
+            transfers=len(self.transfers), transfers_ok=n_ok,
+            transfer_bytes=sum(t["bytes"] for t in self.transfers))
+        return s

@@ -583,6 +583,40 @@ class ServingEngine:
             self._cache, self._store, bs,
             np.int32(slot), padded, np.int32(nb))
 
+    def export_blocks(self, table):
+        """Host-side copy of physical store blocks ``table`` (leading
+        axis = position in the streamed chain) — the transfer SOURCE of
+        KV block streaming (:mod:`serve.disagg`). Reads the device
+        block store the retire path's ``_save_blocks`` maintains; the
+        caller pins the blocks in the pool across the export window so
+        eviction cannot recycle them before the peer's write lands.
+        Non-block leaves (ndim < 2 scalars) ship as empty placeholders
+        so the pytree structure round-trips."""
+        idx = jnp.asarray(np.asarray(table, np.int32))
+        return jax.tree.map(
+            lambda s: np.asarray(s[idx]) if s.ndim >= 2
+            else np.zeros((), s.dtype), self._store)
+
+    def ingest_blocks(self, tokens, host_blocks, adapter: int = 0) -> int:
+        """Transfer SINK of KV block streaming: index ``tokens``'s full
+        blocks in this engine's prefix cache (:meth:`PrefixCache.
+        ingest` adopts cached-ring blocks from the free list) and
+        scatter the streamed ``host_blocks`` rows into the device store
+        at the adopted ids. Already-resident blocks dedup by digest and
+        are not rewritten. Returns blocks written; 0 when this engine
+        has no prefix cache or the pool had no headroom to adopt."""
+        if self.prefix_cache is None or self._store is None:
+            return 0
+        plan = self.prefix_cache.ingest(tokens, adapter)
+        if not plan:
+            return 0
+        src = jnp.asarray(np.asarray([j for j, _ in plan], np.int32))
+        dst = jnp.asarray(np.asarray([p for _, p in plan], np.int32))
+        self._store = jax.tree.map(
+            lambda d, b: d.at[dst].set(jnp.asarray(b)[src])
+            if d.ndim >= 2 else d, self._store, host_blocks)
+        return len(plan)
+
     def _finish_record(self, req: Request, s: _Slot) -> None:
         ttft = req.t_first_token - req.t_submit
         total = req.t_done - req.t_submit

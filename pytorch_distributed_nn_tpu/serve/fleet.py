@@ -109,6 +109,9 @@ class FleetTicket:
         self.tokens: Optional[np.ndarray] = None
         self.done = threading.Event()
         self._attempt: Optional[tuple[int, object]] = None
+        # disaggregated fleets (serve/disagg.py): which leg the current
+        # attempt runs — "" (unified), "prefill", or "decode"
+        self.stage = ""
 
     @property
     def ok(self) -> bool:
@@ -225,10 +228,26 @@ class ReplicaHandle:
     warm_done: bool = True
     # scale-down: draining toward removal; reaped by poll() once empty
     retiring: bool = False
+    # pool class (serve/disagg.py): "unified" | "prefill" | "decode";
+    # the router's stage= filter keys on this
+    role: str = "unified"
 
 
 class Fleet:
     """N serving replicas behind one admission point."""
+
+    def __new__(cls, *args, **kwargs):
+        # ``Fleet(prefill=P, decode=D)`` is the disaggregated
+        # constructor: swap in the subclass (serve/disagg.py) so every
+        # call site that builds a Fleet today opts into split pools
+        # with two kwargs instead of a new import.
+        if cls is Fleet and ("prefill" in kwargs
+                             or "decode" in kwargs):
+            from pytorch_distributed_nn_tpu.serve.disagg import (
+                DisaggFleet,
+            )
+            return super().__new__(DisaggFleet)
+        return super().__new__(cls)
 
     def __init__(self, model, params, *, replicas: int = 2,
                  max_slots: int = 4, max_seq_len: int = 256,
@@ -516,8 +535,17 @@ class Fleet:
                     h.engine.step()
                     busy = True
             self.poll()
-            if not busy:
-                return
+            if busy:
+                continue
+            # poll() itself can create work after an idle sweep — a
+            # failover re-admission or a disagg prefill->decode handoff
+            # lands new queue entries — so only an idle sweep FOLLOWED
+            # by an idle poll terminates
+            if any(h.state in (READY, DRAINING, RELOADING)
+                   and h.engine is not None and h.engine.has_work
+                   for h in self._replicas):
+                continue
+            return
 
     # -- placement ---------------------------------------------------------
 
@@ -811,7 +839,7 @@ class Fleet:
         if n < 1:
             raise ValueError(f"scale_to: n must be >= 1, got {n}")
         with self._lock:
-            current = [h for h in self._replicas if not h.retiring]
+            current = [h for h in self._scalable() if not h.retiring]
             delta = n - len(current)
             added, retiring = 0, 0
             if delta > 0:
@@ -846,6 +874,14 @@ class Fleet:
             # idle retirees on a synchronous fleet reap right here
             self._reap_retiring()
         return dict(target=n, added=added, retiring=retiring)
+
+    def _scalable(self) -> list[ReplicaHandle]:
+        """The handles ``scale_to``'s size intent counts against. The
+        unified fleet scales every slot; the disaggregated fleet
+        (:mod:`serve.disagg`) narrows this to the decode pool — decode
+        is the KV/bandwidth-bound class Helm's burn-rate evidence
+        actually measures."""
+        return self._replicas
 
     def _reap_retiring(self) -> None:
         """Release retired slots whose drain completed: worker exited
@@ -899,7 +935,7 @@ class Fleet:
         for h in self._replicas:
             eng = h.engine.summary() if h.engine is not None else {}
             per_replica.append(dict(
-                replica=h.name, state=h.state,
+                replica=h.name, state=h.state, role=h.role,
                 incarnations=h.incarnations,
                 budget_restarts=h.policy.budget_restarts,
                 preempt_restarts=h.policy.preempt_restarts,

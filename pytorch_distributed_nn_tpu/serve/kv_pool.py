@@ -246,6 +246,24 @@ class KVPool:
         with self._lock:
             self._pinned.discard(block)
 
+    def adopt_cached(self) -> int | None:
+        """Pop one free block and park it directly in the cached-LRU
+        ring (most-recent end), returning its id — the receiving side
+        of KV block streaming (:mod:`serve.disagg`): a peer's prefix
+        block lands here already materialized, never owned by a live
+        sequence on this replica, and is handed out later exactly like
+        a locally-donated block (``reserve(shared=...)``). None — and
+        no state change — when the free list is empty: streamed warmth
+        must never displace live reservations' headroom."""
+        with self._lock:
+            if not self._free:
+                return None
+            b = self._free.pop()
+            self._cached[b] = None
+            self._cached.move_to_end(b)
+            self._publish_locked()
+            return b
+
     def release_cached(self, block: int) -> bool:
         """Evict one cached block to the free list. False — and no
         state change — when the block is pinned or not cached (already

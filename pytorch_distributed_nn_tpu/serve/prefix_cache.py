@@ -213,6 +213,21 @@ class PrefixCache:
         with self._lock:
             return self._match_locked(prompt, adapter).tokens
 
+    def resident_chain(self, prompt, adapter: int = 0) -> PrefixMatch:
+        """Read-only full-block resident chain for ``prompt`` — the
+        streamable prefix for peer warm-up (:mod:`serve.disagg`).
+        Unlike :meth:`admit` there is no COW tail (only whole blocks
+        ship between replicas) and nothing is counted or touched; the
+        caller pins the returned blocks in the pool across the export
+        window so eviction cannot recycle them mid-stream."""
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if len(prompt) < 2:
+            return PrefixMatch()
+        with self._lock:
+            m = self._match_locked(prompt, adapter)
+        return PrefixMatch(blocks=m.blocks,
+                           tokens=len(m.blocks) * self.block_size)
+
     # -- admission ---------------------------------------------------------
 
     def admit(self, seq_id: str, prompt, total_tokens: int,
@@ -290,6 +305,47 @@ class PrefixCache:
                     retain.add(table[j])
                 parent = d
             return self.pool.free(seq_id, retain=frozenset(retain))
+
+    def ingest(self, tokens, adapter: int = 0) -> list[tuple[int, int]]:
+        """Receive side of KV block streaming (:mod:`serve.disagg`):
+        index ``tokens``'s full blocks as resident, adopting a
+        cached-ring block (:meth:`KVPool.adopt_cached`) for each one
+        the radix does not already hold. Returns ``[(chain_pos, phys)]``
+        for the newly-indexed blocks — the ones whose streamed bytes
+        still need writing into the device block store
+        (already-resident blocks dedup by digest, exactly like
+        :meth:`release`). Stops early, indexing a shorter chain, when
+        the pool has no free block to adopt and nothing unpinned to
+        shed — streamed warmth never displaces live reservations."""
+        tokens = np.asarray(tokens, np.int32).reshape(-1)
+        bs = self.block_size
+        root = _root(adapter)
+        plan: list[tuple[int, int]] = []
+        with self._lock:
+            parent = root
+            for j in range(len(tokens) // bs):
+                blk = tokens[j * bs:(j + 1) * bs]
+                d = _digest(parent, blk)
+                node = self._nodes.get(d)
+                if node is None:
+                    phys = self.pool.adopt_cached()
+                    if phys is None:
+                        if not self._evict_locked(1):
+                            break
+                        phys = self.pool.adopt_cached()
+                        if phys is None:
+                            break
+                    node = _Node(d, parent, blk, phys)
+                    self._nodes[d] = node
+                    self._by_phys[phys] = d
+                    head = (self._nodes.get(parent)
+                            if parent != root else None)
+                    if head is not None:
+                        head.children.add(d)
+                    self._account("ingest", note=f"b{phys}")
+                    plan.append((j, phys))
+                parent = d
+        return plan
 
     def abandon(self, seq_id: str) -> int:
         """Failure-path release: free the sequence's table without

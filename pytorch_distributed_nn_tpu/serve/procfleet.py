@@ -165,6 +165,10 @@ class ProcReplica:
         self.restart_at: Optional[float] = None
         self.stop_reason = ""
         self.retiring = False
+        # the process fleet keeps unified replicas: disaggregated
+        # prefill/decode pools (serve/disagg.py) are thread-fleet only
+        # until the store protocol carries a KV-block wire format
+        self.role = "unified"
         self.adopted = False  # inherited live from a dead coordinator
         self.spawned_at = time.monotonic()
         self.gauge_round = -1

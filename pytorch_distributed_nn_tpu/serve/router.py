@@ -34,12 +34,23 @@ replicas are warming, ``draining`` replicas are being rolled, ``dead``
 replicas are the failover path's business. Ties break on the lowest
 replica index, so placement is deterministic for a given fleet state.
 
+Two-stage placement (disaggregated fleets, :mod:`serve.disagg`): when
+the caller passes ``stage="prefill"`` or ``stage="decode"``, only
+replicas of that role are candidates and the score specializes to the
+stage's bottleneck — prefill is compute-bound, so
+:meth:`Router._score_prefill` is pure queue depth (shallowest queue
+reaches the prefill GEMMs first); decode is KV/bandwidth-bound, so
+:meth:`Router._score_decode` is headroom-after-reservation plus the
+prefix-affinity term (a decode replica already holding the streamed
+prompt blocks skips the restore transfer entirely). ``stage=None``
+keeps the unified single-pool behavior above.
+
 Design contract (lint-enforced by tests/test_quality.py, mirroring the
 scheduler's ``_transition``): EVERY placement decision goes through
 :meth:`Router.place`, which bumps the
 ``serve_router_placements_total{outcome}`` counter — no caller can
-pick a replica off the books — and the scoring helper ``_score`` is
-called from nowhere else.
+pick a replica off the books — and the scoring helpers (``_score``,
+``_score_prefill``, ``_score_decode``) are called from nowhere else.
 """
 
 from __future__ import annotations
@@ -108,14 +119,41 @@ class Router:
         queue_frac = sched.queue_depth / max(sched.max_queue, 1)
         return headroom - queue_frac
 
+    def _score_prefill(self, handle) -> float:
+        """Prefill-stage score: pure queue depth. Prefill is
+        compute-bound — the leg runs one prompt-sized GEMM batch and
+        retires, so KV residency is transient and the only thing that
+        moves TTFT is how many requests are already waiting for the
+        prefill slots."""
+        sched = handle.engine.scheduler
+        return -sched.queue_depth / max(sched.max_queue, 1)
+
+    def _score_decode(self, handle, total_tokens: int) -> float:
+        """Decode-stage score: KV headroom after this request's
+        worst-case reservation. Decode is bandwidth/KV-bound — the leg
+        holds its blocks for the whole emission — so free blocks after
+        reservation is the real capacity signal; the queue term stays
+        as the tiebreak pressure and ``place`` adds prefix affinity on
+        top (a replica already holding the streamed blocks wins)."""
+        pool = handle.engine.scheduler.pool
+        sched = handle.engine.scheduler
+        need = -(-int(total_tokens) // pool.block_size)
+        headroom = (pool.free_blocks - need) / max(pool.num_blocks, 1)
+        queue_frac = sched.queue_depth / max(sched.max_queue, 1)
+        return headroom - queue_frac
+
     def place(self, replicas, total_tokens: int, *, prompt=None,
-              adapter: int = 0):
+              adapter: int = 0, stage: str | None = None):
         """Pick the best READY replica for a request of
         ``total_tokens`` worst-case KV footprint; None when no replica
         is ready (the fleet rejects the request as ``no_replica``).
         ``prompt`` (optional token array) turns on prefix affinity:
         replicas whose prefix cache already holds a chunk of the
-        prompt (for this ``adapter``) score higher.
+        prompt (for this ``adapter``) score higher. ``stage`` narrows
+        candidates to one disaggregated pool (``"prefill"`` /
+        ``"decode"``) and switches to that stage's scoring; prefill
+        placement ignores affinity (the leg is one shot — queue depth
+        dominates).
 
         THE placement choke point: every decision — including the
         failure to make one — lands in
@@ -125,8 +163,17 @@ class Router:
         for handle in replicas:
             if handle.state != READY:
                 continue
-            score = self._score(handle, total_tokens)
-            if prompt is not None and len(prompt) > 0:
+            if stage is not None \
+                    and getattr(handle, "role", "unified") != stage:
+                continue
+            if stage == "prefill":
+                score = self._score_prefill(handle)
+            elif stage == "decode":
+                score = self._score_decode(handle, total_tokens)
+            else:
+                score = self._score(handle, total_tokens)
+            if stage != "prefill" and prompt is not None \
+                    and len(prompt) > 0:
                 pc = getattr(handle.engine, "prefix_cache", None)
                 if pc is not None:
                     score += pc.peek(prompt, adapter) / len(prompt)

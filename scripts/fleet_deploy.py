@@ -81,6 +81,13 @@ def _run_fleet(fleet) -> int:
 def _cmd_start(args) -> int:
     from pytorch_distributed_nn_tpu.serve.procfleet import ProcessFleet
 
+    if args.fleet_prefill or args.fleet_decode:
+        # the process fleet keeps unified replicas until the store
+        # protocol carries a KV-block wire format (serve/procfleet.py)
+        print("error: --fleet-prefill/--fleet-decode need the "
+              "thread fleet (bench.py --fleet --disagg); the process "
+              "fleet serves unified replicas only", file=sys.stderr)
+        return 2
     fleet = ProcessFleet(
         replicas=args.replicas, backend=args.backend,
         namespace=args.namespace, store_endpoint=args.store or None,
@@ -171,6 +178,12 @@ def main() -> int:
                            default=5.0)
         if name == "start":
             p.add_argument("--replicas", type=int, default=2)
+            p.add_argument("--fleet-prefill", type=int, default=0,
+                           help="reserved: disaggregated pools are "
+                                "thread-fleet only (bench.py --fleet "
+                                "--disagg); rejected here")
+            p.add_argument("--fleet-decode", type=int, default=0,
+                           help="reserved: see --fleet-prefill")
     args = ap.parse_args()
     return {"store": _cmd_store, "start": _cmd_start,
             "recover": _cmd_recover, "status": _cmd_status}[args.cmd](args)

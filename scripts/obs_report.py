@@ -270,9 +270,11 @@ def print_fleet_table(events: list[dict], last: int) -> bool:
     fos = [e for e in events if e.get("event") == "fleet_failover"]
     states = [e for e in events if e.get("event") == "fleet_state"]
     reloads = [e for e in events if e.get("event") == "fleet_reload"]
+    hoffs = [e for e in events if e.get("event") == "fleet_handoff"]
+    xfers = [e for e in events if e.get("event") == "kv_transfer"]
     tagged = [e for e in events
               if e.get("event") == "serve_request" and e.get("replica")]
-    if not (downs or fos or states or reloads):
+    if not (downs or fos or states or reloads or hoffs or xfers):
         return False
 
     print("\n== fleet ==")
@@ -307,6 +309,24 @@ def print_fleet_table(events: list[dict], last: int) -> bool:
                   f"->r{int(_num(e, 'to_replica', -1))}  "
                   f"prefix {int(_num(e, 'prefix_tokens')):>3} tok  "
                   f"readmit {_num(e, 'readmit_s') * 1e3:8.2f}ms")
+    if hoffs:
+        # disaggregated fleet (serve/disagg.py): prefill->decode
+        # handoffs and the KV block streams that warm them
+        pfx = [_num(e, "prefix_tokens") for e in hoffs]
+        print(f"prefill->decode handoffs: {len(hoffs)}  "
+              f"stitched prefix p50 {percentile(pfx, 0.50):.0f} tok  "
+              f"p99 {percentile(pfx, 0.99):.0f} tok")
+    if xfers:
+        n_ok = sum(1 for e in xfers if e.get("outcome") == "ok")
+        failed = [e for e in xfers if e.get("outcome") == "failed"]
+        total_b = sum(_num(e, "bytes") for e in xfers)
+        print(f"kv transfers: {len(xfers)} ({n_ok} ok, "
+              f"{len(failed)} failed)  "
+              f"{total_b / 1e6:.2f} MB streamed")
+        for e in failed[-last:]:
+            print(f"  r{int(_num(e, 'src', -1))}"
+                  f"->r{int(_num(e, 'dst', -1))} FAILED mid-transfer "
+                  f"({int(_num(e, 'blocks'))} blocks)")
     if reloads:
         rolled = sum(int(_num(e, "replicas")) for e in reloads)
         print(f"rolling reloads: {len(reloads)} "
@@ -511,7 +531,8 @@ def main(argv=None) -> int:
     has_serve = any(e.get("event") in
                     ("serve_request", "serve_summary", "fleet_state",
                      "fleet_replica_down", "fleet_failover",
-                     "fleet_reload", "capacity_rung",
+                     "fleet_reload", "fleet_handoff", "kv_transfer",
+                     "capacity_rung",
                      "capacity_frontier", "capacity_plan",
                      "autoscale_decision")
                     for e in events)

@@ -381,6 +381,24 @@ def test_bench_fleet_selftest_smoke():
     assert "fleet selftest ok" in proc.stdout
 
 
+def test_bench_disagg_selftest_smoke():
+    """The Estuary acceptance drill (ISSUE 15 tentpole), run exactly
+    as CI would: a disaggregated prefill/decode fleet on a tiny model,
+    greedy stitched output bit-identical to the unified fleet, KV
+    blocks streamed through the collectives choke point (wire bytes on
+    the books), and a kill_transfer@ chaos drill that re-prefills on a
+    survivor without changing a single token."""
+    repo = Path(__file__).parent.parent
+    proc = subprocess.run(
+        [sys.executable, str(repo / "bench.py"), "--fleet", "--disagg",
+         "--selftest"],
+        capture_output=True, text=True, timeout=300, cwd=repo,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr or proc.stdout
+    assert "disagg selftest ok" in proc.stdout
+
+
 _AUTOSCALE = (Path(__file__).parent.parent
               / "pytorch_distributed_nn_tpu" / "serve" / "autoscale.py")
 
@@ -708,13 +726,14 @@ def test_replica_state_changes_only_through_counted_set_state():
     draining/reloading → dead`` move must hit the
     ``serve_replica_state_total`` counter and the flight ring.
     Structural proof: (a) the ONLY place a handle's ``.state`` is
-    assigned across serve/fleet.py + serve/router.py is
-    ``Fleet._set_state`` (the dataclass default is an AnnAssign, not a
-    mutation); (b) ``_set_state`` increments ``_c_replica_state`` and
-    records a ``fleet`` flight event."""
+    assigned across serve/fleet.py + serve/router.py + serve/disagg.py
+    is ``Fleet._set_state`` (the dataclass default is an AnnAssign, not
+    a mutation; DisaggFleet's override delegates to super); (b)
+    ``_set_state`` increments ``_c_replica_state`` and records a
+    ``fleet`` flight event."""
     offenders = []
     set_state = None
-    for fname in ("fleet.py", "router.py"):
+    for fname in ("fleet.py", "router.py", "disagg.py"):
         tree = ast.parse((_SERVE / fname).read_text())
         for cls in [n for n in tree.body
                     if isinstance(n, ast.ClassDef)]:
@@ -754,14 +773,16 @@ def test_replica_state_changes_only_through_counted_set_state():
 
 
 def test_router_placement_is_counted_and_scoring_is_internal():
-    """ISSUE 8 lint: ``Router.place`` is THE placement choke point —
-    it must bump ``serve_router_placements_total`` on every decision,
-    and the scoring helper ``_score`` must be called from nowhere else
-    in the serving package (no caller can pick a replica off the
-    books)."""
+    """ISSUE 8 lint (stage-aware since ISSUE 15): ``Router.place`` is
+    THE placement choke point — it must bump
+    ``serve_router_placements_total`` on every decision, and the
+    scoring helpers (``_score``, ``_score_prefill``, ``_score_decode``)
+    must be called from nowhere else in the serving package (no caller
+    can pick a replica off the books)."""
     place = None
-    score_callers = []
-    for fname in ("fleet.py", "router.py"):
+    score_callers = {"_score": [], "_score_prefill": [],
+                     "_score_decode": []}
+    for fname in ("fleet.py", "router.py", "disagg.py"):
         tree = ast.parse((_SERVE / fname).read_text())
         for cls in [n for n in tree.body
                     if isinstance(n, ast.ClassDef)]:
@@ -772,14 +793,15 @@ def test_router_placement_is_counted_and_scoring_is_internal():
                 for node in ast.walk(fn):
                     if (isinstance(node, ast.Call)
                             and isinstance(node.func, ast.Attribute)
-                            and node.func.attr == "_score"):
-                        score_callers.append(
+                            and node.func.attr in score_callers):
+                        score_callers[node.func.attr].append(
                             f"{fname}:{cls.name}.{fn.name}")
     assert place is not None, "Router.place not found"
-    assert score_callers == ["router.py:Router.place"], (
-        f"_score must be called only from Router.place, "
-        f"found {score_callers}"
-    )
+    for helper, callers in score_callers.items():
+        assert callers == ["router.py:Router.place"], (
+            f"{helper} must be called only from Router.place, "
+            f"found {callers}"
+        )
     incremented = set()
     for node in ast.walk(place):
         if (isinstance(node, ast.Call)
@@ -791,3 +813,59 @@ def test_router_placement_is_counted_and_scoring_is_internal():
         f"Router.place must bump serve_router_placements_total, "
         f"found {sorted(incremented)}"
     )
+
+def test_kv_transfer_is_the_single_streaming_choke_point():
+    """ISSUE 15 lint: every KV byte moved between replica engines goes
+    through ``ops.collectives.kv_transfer``, which must fan out to the
+    same three books as ``_record`` — the comm recorder (goodput's
+    wire-byte cross-check), the flight ring, and the chaos hook
+    (``on_transfer`` may raise mid-transfer). Structural proof: (a)
+    ``kv_transfer`` performs all three calls; (b) the ONLY caller of
+    ``kv_transfer`` in the serve package is
+    ``DisaggFleet._stream_blocks``; (c) the engine's
+    ``export_blocks``/``ingest_blocks`` pair is likewise called only
+    from that streaming path — nobody can ship blocks off the books."""
+    tree = ast.parse((_OPS / "collectives.py").read_text())
+    kv = next((n for n in tree.body if isinstance(n, ast.FunctionDef)
+               and n.name == "kv_transfer"), None)
+    assert kv is not None, "ops.collectives.kv_transfer not found"
+    fanout = set()
+    for node in ast.walk(kv):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)):
+            fanout.add(f"{node.func.value.id}.{node.func.attr}")
+    for required in ("_recorder.record", "_flight.on_collective",
+                     "_chaos.on_transfer"):
+        assert required in fanout, (
+            f"kv_transfer must call {required} (the _record fan-out "
+            f"contract), found {sorted(fanout)}"
+        )
+    callers = {"kv_transfer": [], "export_blocks": [],
+               "ingest_blocks": []}
+    for path in sorted(_SERVE.glob("*.py")):
+        tree = ast.parse(path.read_text())
+        for cls in [n for n in tree.body if isinstance(n, ast.ClassDef)]:
+            for fn in [n for n in cls.body
+                       if isinstance(n, ast.FunctionDef)]:
+                for node in ast.walk(fn):
+                    if (isinstance(node, ast.Call)
+                            and isinstance(node.func, ast.Attribute)
+                            and node.func.attr in callers):
+                        callers[node.func.attr].append(
+                            f"{path.name}:{cls.name}.{fn.name}")
+    assert callers["kv_transfer"] == \
+        ["disagg.py:DisaggFleet._stream_blocks"], (
+            f"ops.collectives.kv_transfer must be called only from "
+            f"DisaggFleet._stream_blocks, found {callers['kv_transfer']}"
+        )
+    assert callers["export_blocks"] == \
+        ["disagg.py:DisaggFleet._stream_blocks"], (
+            f"engine.export_blocks must be called only from the "
+            f"streaming path, found {callers['export_blocks']}"
+        )
+    assert callers["ingest_blocks"] == \
+        ["disagg.py:DisaggFleet._stream_blocks"], (
+            f"engine.ingest_blocks must be called only from the "
+            f"streaming path, found {callers['ingest_blocks']}"
+        )
