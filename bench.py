@@ -781,6 +781,10 @@ def bench_serve(args) -> int:
     static_tps = static_toks / static_dt
 
     # -- continuous engine under open-loop load (timed) ----------------
+    # armed after warmup so a TPUNN_TRACE A/B (docs/observability.md
+    # "Causeway") times the armed hook path, not compile noise
+    from pytorch_distributed_nn_tpu.obs import trace
+    trace.maybe_init()
     engine = ServingEngine(model, params, max_slots=slots,
                            max_seq_len=max_seq, max_queue=n_req,
                            prefix_cache=False)

@@ -31,6 +31,16 @@ heartbeats) with:
   goodput-saturation knee, and the "replicas needed per SLO per
   traffic shape" planning report (``bench.py --capacity``,
   ``scripts/obs_report.py --capacity``);
+- :mod:`obs.trace` — Causeway distributed request tracing (ISSUE 16):
+  per-request :class:`~obs.trace.TraceContext` minted at submit,
+  propagated across scheduler transitions, prefill/decode legs, KV
+  transfers, failover re-admissions, and the process-fleet store wire;
+  inert unless ``TPUNN_TRACE`` is set;
+- :mod:`obs.critpath` — waterfall assembly + critical-path attribution
+  over Causeway spans: per-trace segment decomposition
+  (queued/prefill/transfer/failover/restore/decode/stitch) that
+  provably sums to end-to-end latency, plus the fleet rollup per SLO
+  bucket (``scripts/obs_trace.py`` renders both);
 - :mod:`obs.xray` — anomaly-triggered device profiling (ISSUE 10):
   bounded, rate-limited ``jax.profiler`` captures (page/interval/
   on-demand triggers), per-op MFU/roofline attribution, compile
@@ -45,8 +55,10 @@ heartbeats) with:
 ``bench.py --goodput`` attaches the breakdown to benchmark records.
 """
 
+from pytorch_distributed_nn_tpu.obs import critpath  # noqa: F401
 from pytorch_distributed_nn_tpu.obs import flight  # noqa: F401
 from pytorch_distributed_nn_tpu.obs import stats  # noqa: F401
+from pytorch_distributed_nn_tpu.obs import trace  # noqa: F401
 from pytorch_distributed_nn_tpu.obs import watchtower  # noqa: F401
 from pytorch_distributed_nn_tpu.obs import xray  # noqa: F401
 from pytorch_distributed_nn_tpu.obs.goodput import (  # noqa: F401

@@ -78,6 +78,38 @@ def collect_snapshots(client, ranks, *, incarnation: int = 0,
     return out
 
 
+_TRACE_KEY_FMT = "trace/{rank}"
+
+
+def publish_spans(client, *, rank: int, spans: list[dict]) -> str:
+    """Causeway span transport (obs/trace.py): write this process's
+    span buffer under ``trace/<rank>`` — same store, same
+    last-writer-wins snapshot semantics as the metric snapshots.
+    Canonical sort_keys JSON (the byte-determinism contract)."""
+    key = _TRACE_KEY_FMT.format(rank=rank)
+    client.set(key, json.dumps(spans, sort_keys=True).encode())
+    return key
+
+
+def collect_spans(client, ranks, *, timeout_ms: int = 1000) -> list[dict]:
+    """Coordinator pull: every published per-host span buffer, joined
+    into one flat list (absent ranks are skipped — a worker that has
+    not traced anything yet is not an error). obs/critpath.py
+    assembles the result into per-trace waterfalls."""
+    out: list[dict] = []
+    for rank in ranks:
+        key = _TRACE_KEY_FMT.format(rank=rank)
+        try:
+            if not client.check(key):
+                continue
+            out.extend(json.loads(
+                client.get(key, timeout_ms=timeout_ms).decode()))
+        except (OSError, TimeoutError, ValueError) as e:
+            log.warning("trace span pull for rank %d failed: %s",
+                        rank, e)
+    return out
+
+
 def merge_snapshots(snapshots: dict[int, dict]) -> dict:
     """{"summed": {metric: Σ across hosts}, "per_rank": {metric:
     {rank: value}}} — counters read from "summed", gauges from

@@ -1,0 +1,222 @@
+"""Critical-path attribution over Causeway trace spans.
+
+Input: the plain span dicts :mod:`obs.trace` emits (``{trace, span,
+parent, leg, segment, host, t0, t1, ...}``), possibly joined from many
+hosts (:func:`obs.aggregate.collect_spans`) or from a merged Chrome
+trace (:func:`spans_from_chrome` reads back what
+:func:`obs.trace.spans_to_chrome` wrote, so
+:func:`obs.span.merge_chrome_traces` output stays a lossless join).
+
+Three layers:
+
+- :func:`assemble` — one trace's spans, leg-linked: verifies every
+  leg's ``parent`` chain reaches leg 0 (the re-admitted-leg-links-to-
+  original-trace invariant the failover drill asserts).
+- :func:`critical_path` — partition the trace's observed extent
+  ``[t0, t1]`` into attributed intervals: at every instant the
+  highest-priority active duration span owns the time
+  (transfer > failover > restore > prefill > decode > queued), and
+  instants no span covers are ``stitch`` (scheduler glue, handoff
+  rewrite, poll latency). The partition is exhaustive and disjoint BY
+  CONSTRUCTION, so the per-segment seconds provably sum to the
+  measured end-to-end extent — the property the tier-1 selftest pins
+  to within 1% of the ticket's wall-clock latency.
+- :func:`rollup` — fleet-level: every trace's dominant segment,
+  bucketed by end-to-end latency SLO band, plus per-segment
+  p50/p99 — "what do we fix first for the p99 band" in one table.
+
+:func:`canonical_json` is the determinism gate's comparison unit:
+structure only (ids, legs, segments, hosts, span counts), timestamps
+excluded — same seed ⇒ byte-identical canonical JSON even though wall
+clocks differ run to run.
+
+Stdlib-only (no jax, no numpy).
+"""
+
+from __future__ import annotations
+
+import json
+
+from pytorch_distributed_nn_tpu.obs import stats
+
+# at any instant the highest-priority active span owns the time; ties
+# broken by later start (the more specific, inner phase)
+PRIORITY = {"transfer": 6, "failover": 5, "restore": 4, "prefill": 3,
+            "decode": 2, "queued": 1}
+
+STITCH = "stitch"
+
+# end-to-end latency bands the rollup groups traces into (seconds)
+SLO_BUCKETS = (0.1, 0.5, 2.0)
+
+
+def spans_from_chrome(events: list[dict]) -> list[dict]:
+    """Recover span dicts from a (merged) Chrome trace: every event
+    with ``cat == "trace"`` carries its full span in ``args``."""
+    return [dict(e["args"]) for e in events
+            if e.get("cat") == "trace" and "args" in e
+            and "trace" in e["args"]]
+
+
+def _durations(spans: list[dict]) -> list[dict]:
+    """Duration spans only — marks are breadcrumbs, they never own
+    critical-path time."""
+    return [s for s in spans
+            if s.get("segment") in PRIORITY and s["t1"] > s["t0"]]
+
+
+def assemble(spans: list[dict], trace_id: str) -> dict:
+    """One trace's view: spans sorted by (t0, priority), legs indexed,
+    and the leg linkage verified — ``linked`` is True iff every leg
+    > 0 has a ``parent`` equal to some earlier leg's root span id (the
+    failover/handoff re-admission contract)."""
+    mine = sorted((s for s in spans if s.get("trace") == trace_id),
+                  key=lambda s: (s["t0"], -PRIORITY.get(
+                      s.get("segment", ""), 0)))
+    legs: dict[int, dict] = {}
+    for s in mine:
+        leg = legs.setdefault(int(s.get("leg", 0)), {
+            "span": s.get("span", ""), "parent": s.get("parent", ""),
+            "hosts": set(), "segments": {}})
+        leg["hosts"].add(str(s.get("host", "")))
+        seg = s.get("segment", "")
+        leg["segments"][seg] = leg["segments"].get(seg, 0) + 1
+    roots = {n: leg["span"] for n, leg in legs.items()}
+    linked = all(
+        legs[n]["parent"] in {roots[m] for m in legs if m < n}
+        for n in legs if n > 0) if legs else False
+    return {
+        "trace": trace_id,
+        "spans": mine,
+        "legs": {n: {**leg, "hosts": sorted(leg["hosts"])}
+                 for n, leg in sorted(legs.items())},
+        "linked": linked,
+    }
+
+
+def critical_path(spans: list[dict]) -> dict:
+    """Attribute every instant of the trace's extent to exactly one
+    segment. Returns::
+
+        {"t0": ..., "t1": ..., "total_s": t1 - t0,
+         "intervals": [{"segment", "t0", "t1", "seconds"}, ...],
+         "segments": {segment: seconds, ...},   # sums to total_s
+         "dominant": segment}
+
+    ``sum(segments.values()) == total_s`` holds by construction: the
+    intervals are a partition of ``[t0, t1]`` (gaps are ``stitch``)."""
+    durs = _durations(spans)
+    if not durs:
+        return {"t0": 0.0, "t1": 0.0, "total_s": 0.0,
+                "intervals": [], "segments": {}, "dominant": ""}
+    bounds = sorted({t for s in durs for t in (s["t0"], s["t1"])})
+    t0, t1 = bounds[0], bounds[-1]
+    intervals: list[dict] = []
+    for lo, hi in zip(bounds, bounds[1:]):
+        if hi <= lo:
+            continue
+        active = [s for s in durs if s["t0"] <= lo and s["t1"] >= hi]
+        if active:
+            win = max(active, key=lambda s: (PRIORITY[s["segment"]],
+                                             s["t0"]))
+            seg = win["segment"]
+        else:
+            seg = STITCH
+        if intervals and intervals[-1]["segment"] == seg \
+                and intervals[-1]["t1"] == lo:
+            intervals[-1]["t1"] = hi
+        else:
+            intervals.append({"segment": seg, "t0": lo, "t1": hi})
+    segments: dict[str, float] = {}
+    for iv in intervals:
+        iv["seconds"] = iv["t1"] - iv["t0"]
+        segments[iv["segment"]] = (segments.get(iv["segment"], 0.0)
+                                   + iv["seconds"])
+    dominant = max(segments, key=lambda k: segments[k])
+    return {"t0": t0, "t1": t1, "total_s": t1 - t0,
+            "intervals": intervals, "segments": segments,
+            "dominant": dominant}
+
+
+def waterfall(spans: list[dict], trace_id: str) -> dict:
+    """Render-ready single-trace view: the assembly, its critical
+    path, and per-span rows with start offsets relative to the trace's
+    first instant (``scripts/obs_trace.py`` draws these as bars)."""
+    asm = assemble(spans, trace_id)
+    cp = critical_path(asm["spans"])
+    rows = [{
+        "leg": int(s.get("leg", 0)),
+        "segment": s.get("segment", ""),
+        "host": str(s.get("host", "")),
+        "start_s": round(s["t0"] - cp["t0"], 6) if cp["total_s"] else 0.0,
+        "dur_s": round(s["t1"] - s["t0"], 6),
+        "attrs": {k: v for k, v in s.items()
+                  if k not in ("trace", "span", "parent", "leg",
+                               "segment", "host", "t0", "t1")},
+    } for s in _durations(asm["spans"])]
+    return {"trace": trace_id, "rows": rows, "critical_path": cp,
+            "legs": asm["legs"], "linked": asm["linked"]}
+
+
+def rollup(spans: list[dict],
+           buckets: tuple = SLO_BUCKETS) -> dict:
+    """Fleet-level view across every trace present in ``spans``: per
+    SLO latency band, how many traces landed there, which segment
+    dominates the band's critical paths (summed seconds), and
+    per-segment p50/p99 across the band's traces."""
+    by_trace: dict[str, list[dict]] = {}
+    for s in spans:
+        by_trace.setdefault(str(s.get("trace", "")), []).append(s)
+    bands: dict[str, dict] = {}
+    for trace_id, mine in sorted(by_trace.items()):
+        cp = critical_path(mine)
+        if not cp["segments"]:
+            continue
+        label = next((f"<{b:g}s" for b in buckets
+                      if cp["total_s"] < b), f">={buckets[-1]:g}s")
+        band = bands.setdefault(label, {
+            "traces": 0, "seconds": {}, "samples": {}})
+        band["traces"] += 1
+        for seg, sec in cp["segments"].items():
+            band["seconds"][seg] = band["seconds"].get(seg, 0.0) + sec
+            band["samples"].setdefault(seg, []).append(sec)
+    out = {}
+    order = [f"<{b:g}s" for b in buckets] + [f">={buckets[-1]:g}s"]
+    for label in order:
+        if label not in bands:
+            continue
+        band = bands[label]
+        dominant = max(band["seconds"], key=lambda k: band["seconds"][k])
+        out[label] = {
+            "traces": band["traces"],
+            "dominant": dominant,
+            "segments": {
+                seg: {
+                    "total_s": round(band["seconds"][seg], 6),
+                    "p50_s": round(stats.percentile(xs, 50.0), 6),
+                    "p99_s": round(stats.percentile(xs, 99.0), 6),
+                }
+                for seg, xs in sorted(band["samples"].items())
+            },
+        }
+    return out
+
+
+def canonical_json(spans: list[dict]) -> str:
+    """Structure-only canonical form (the ``obs_trace --selftest``
+    determinism unit): ids, legs, segments, hosts and stable counts —
+    every wall-clock value excluded — serialized with sorted keys, so
+    the same seeded drill yields byte-identical output run to run."""
+    skeleton = sorted((
+        {
+            "trace": s.get("trace", ""), "span": s.get("span", ""),
+            "parent": s.get("parent", ""),
+            "leg": int(s.get("leg", 0)),
+            "segment": s.get("segment", ""),
+            "mark": s.get("mark", ""),
+            "host": str(s.get("host", "")),
+        }
+        for s in spans
+    ), key=lambda d: (d["trace"], d["leg"], d["segment"], d["mark"],
+                      d["host"], d["span"]))
+    return json.dumps(skeleton, sort_keys=True)

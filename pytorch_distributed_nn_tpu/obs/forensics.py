@@ -103,6 +103,14 @@ class RankDump:
         stranded."""
         return [e for e in self.events if e.get("kind") == "fleet"]
 
+    @property
+    def trace_events(self) -> list[dict]:
+        """Causeway spans (obs/trace.py) in the ring before the dump —
+        emit-first puts every completed segment here, so a post-mortem
+        names the exact traces in flight when the process died. The
+        note leads with the trace_id (``<trace> leg=<n> <request>``)."""
+        return [e for e in self.events if e.get("kind") == "trace"]
+
     def last_event(self) -> dict | None:
         return self.events[-1] if self.events else None
 
@@ -330,6 +338,33 @@ def fleet_summary(dumps: dict[int, RankDump]) -> dict | None:
                                   "downs": coord_downs,
                                   "max_gap_s": max_gap_s}
     return summary
+
+
+def trace_summary(dumps: dict[int, RankDump]) -> dict | None:
+    """Causeway traces (obs/trace.py) alive in each rank's ring when
+    the dump landed: per-rank {trace_id: {segments tally, legs seen}},
+    so a post-mortem goes from a crashed rank straight to the request
+    waterfalls to pull (``scripts/obs_trace.py``). None when no dump
+    holds trace events (TPUNN_TRACE unset stays trace-silent)."""
+    out: dict[str, dict] = {}
+    for rank, d in sorted(dumps.items()):
+        per: dict[str, dict] = {}
+        for e in d.trace_events:
+            note = str(e.get("note", ""))
+            trace_id = note.split(" ", 1)[0]
+            if not trace_id:
+                continue
+            ent = per.setdefault(trace_id, {"segments": {}, "legs": []})
+            seg = str(e.get("op", ""))
+            ent["segments"][seg] = ent["segments"].get(seg, 0) + 1
+            for part in note.split():
+                if part.startswith("leg="):
+                    leg = int(part[4:])
+                    if leg not in ent["legs"]:
+                        ent["legs"].append(leg)
+        if per:
+            out[str(rank)] = per
+    return out or None
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,500 @@
+"""Causeway: per-request distributed tracing with cross-process
+context propagation.
+
+Every observability layer below this one — registry, flight ring,
+watchtower, xray — is host- or replica-scoped: once a request crosses
+a disagg handoff, a ``kv_transfer``, a failover re-admission, or a
+store-dispatched process boundary, its latency story shatters into
+uncorrelated fragments. This module is the causal backbone that keeps
+the fragments joined: a :class:`TraceContext` (trace id, per-leg root
+span id, parent span id, leg ordinal) is minted at ``Fleet.submit`` /
+``Scheduler.submit``, carried on the ticket, and echoed through every
+boundary a request can cross:
+
+- scheduler ``_transition`` states (zero-duration marks),
+- engine queued/restore/prefill/decode segments (retroactive, from the
+  scheduler's lifecycle timestamps — nothing lands in the decode hot
+  loop),
+- the disagg prefill->decode handoff and the
+  ``ops.collectives.kv_transfer`` wire choke point,
+- failover re-admission (the re-admitted leg's context links back to
+  the original trace via ``parent_id``),
+- the :class:`serve.procfleet.ProcessFleet` store wire format:
+  ``req/<idx>/<k>`` dispatch records carry ``"trace"`` and worker
+  ``prog/`` / ``done/`` echoes return it, so ``fleet_worker.py`` emits
+  spans for work it ran into its OWN per-host buffer (published
+  through :func:`obs.aggregate.publish_spans`).
+
+Spans are plain dicts — ``{trace, span, parent, leg, segment, host,
+t0, t1, ...attrs}`` with unix-epoch second timestamps (monotonic
+deltas rebased once per tracer, so one process's spans never skew
+against each other; cross-host skew is the store collector's caveat,
+same as :func:`obs.span.merge_chrome_traces`). :mod:`obs.critpath`
+assembles them into waterfalls and critical paths;
+``scripts/obs_trace.py`` renders both.
+
+Arming: ``TPUNN_TRACE=`` (chaos-style spec grammar):
+
+    TPUNN_TRACE=1                          # defaults: sample every request
+    TPUNN_TRACE=sample=0.1                 # deterministic 10% sample
+    TPUNN_TRACE=tenant=acme                # only tenant "acme"
+    TPUNN_TRACE=sample=0.5:slow_ms=250     # keep only traces >= 250ms
+                                           # at export time
+
+Sampling is a deterministic hash of the request id (no RNG draw: the
+same workload traces the same requests on every host and every rerun —
+the byte-identical-replay contract every stream in this codebase
+follows). ``slow_ms`` is a retention filter applied at export, not at
+emit (a span cannot know its request's final latency).
+
+Design contract (the chaos/watchtower lint rules, enforced by
+tests/test_quality.py):
+
+- **Inert when unset.** Every ``on_*`` hook opens with the literal
+  ``if _tracer is None: return`` — an unset ``TPUNN_TRACE`` costs one
+  global load + one comparison per hook, and performs ZERO registry or
+  flight-ring writes (the counters are registered at arm time, not at
+  import).
+- **Emit-first.** Every span lands in the flight ring before anything
+  else sees it (``Tracer._emit``'s first statement) — a crash right
+  after a segment completes must still show it post-mortem.
+
+Stdlib-only (no jax, no numpy): ``fleet_worker.py`` imports this
+before deciding whether to touch a backend.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import logging
+import os
+import time
+from typing import Optional
+
+from pytorch_distributed_nn_tpu.obs import flight
+from pytorch_distributed_nn_tpu.obs.registry import get_registry
+
+log = logging.getLogger(__name__)
+
+ENV_TRACE = "TPUNN_TRACE"
+
+# segments a critical path can be attributed to (obs/critpath.py
+# priorities live there; this is the emit-side vocabulary)
+SEGMENTS = ("queued", "restore", "prefill", "transfer", "failover",
+            "decode", "mark")
+
+_ID_BITS = 16  # hex chars of the sha1 digest used for ids
+
+
+def _digest(text: str) -> str:
+    return hashlib.sha1(text.encode()).hexdigest()[:_ID_BITS]
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceContext:
+    """The propagated unit: one request's identity on one leg.
+
+    ``trace_id`` names the logical request and never changes across
+    handoffs or failovers; ``span_id`` is this leg's root span;
+    ``parent_id`` is the previous leg's root span (``""`` for leg 0) —
+    the link that keeps a re-admitted leg attached to the original
+    trace. Ids derive from the request id by hash, so the same seed
+    yields byte-identical trace JSON (the determinism gate
+    ``scripts/obs_trace.py --selftest`` pins)."""
+
+    trace_id: str
+    span_id: str
+    parent_id: str = ""
+    leg: int = 0
+
+    def to_wire(self) -> str:
+        """Compact store/JSONL wire form — round-trips byte-identically
+        through MemStore and the native StoreClient
+        (tests/test_store_parity.py)."""
+        return (f"{self.trace_id}/{self.span_id}/"
+                f"{self.parent_id or '-'}/{self.leg}")
+
+    @classmethod
+    def from_wire(cls, wire: str) -> "TraceContext":
+        trace_id, span_id, parent, leg = wire.split("/")
+        return cls(trace_id=trace_id, span_id=span_id,
+                   parent_id="" if parent == "-" else parent,
+                   leg=int(leg))
+
+    def child(self) -> "TraceContext":
+        """The next leg's context: same trace, leg+1, linked back to
+        this leg's root span."""
+        leg = self.leg + 1
+        return TraceContext(
+            trace_id=self.trace_id,
+            span_id=_digest(f"{self.trace_id}:{leg}"),
+            parent_id=self.span_id, leg=leg)
+
+
+@dataclasses.dataclass
+class TraceConfig:
+    """``TPUNN_TRACE`` spec knobs (chaos-grammar ``key=value:...``)."""
+
+    sample: float = 1.0   # deterministic request-id hash sample rate
+    tenant: str = ""      # only trace this tenant ("" = all)
+    slow_ms: float = 0.0  # export-time retention floor (0 = keep all)
+    max_spans: int = 8192  # per-process span buffer bound
+
+
+_FIELD_TYPES = {f.name: f.type for f in dataclasses.fields(TraceConfig)}
+
+
+def parse_spec(spec: str) -> TraceConfig:
+    """``TPUNN_TRACE`` spec → :class:`TraceConfig`. ``"1"`` / ``"on"``
+    mean defaults; otherwise ``:``-separated ``key=value`` overrides.
+    Unknown keys raise (a typo'd trace spec must fail loudly, not
+    silently trace nothing — the chaos-spec contract)."""
+    cfg = TraceConfig()
+    spec = (spec or "").strip()
+    if spec in ("", "1", "on", "true"):
+        return cfg
+    for field in filter(None, spec.split(":")):
+        key, eq, value = field.partition("=")
+        key = key.strip()
+        if not eq or key not in _FIELD_TYPES:
+            raise ValueError(
+                f"unknown trace key {key!r} in {spec!r}; have "
+                f"{sorted(_FIELD_TYPES)}")
+        try:
+            kind = _FIELD_TYPES[key]
+            setattr(cfg, key,
+                    value if kind in (str, "str")
+                    else int(value) if kind in (int, "int")
+                    else float(value))
+        except ValueError:
+            raise ValueError(
+                f"bad value for trace key {key!r}: {value!r}") from None
+    if not 0.0 <= cfg.sample <= 1.0:
+        raise ValueError(f"sample must be in [0, 1], got {cfg.sample}")
+    return cfg
+
+
+class Tracer:
+    """Per-process span buffer + the sampling decision. One instance
+    per armed process (module singleton); workers and the coordinator
+    each run their own and the store collector joins them."""
+
+    def __init__(self, config: TraceConfig, *, rank: int = 0,
+                 metrics=None) -> None:
+        self.cfg = config
+        self.rank = int(rank)
+        self.host = f"h{self.rank}"
+        self.metrics = metrics  # MetricsLogger | None
+        self.spans: list[dict] = []
+        # monotonic -> unix rebase, computed ONCE: every span in this
+        # process shares the offset, so intra-process deltas are exact
+        self._unix_offset = time.time() - time.monotonic()
+        # worker-side admit timestamps (request_id -> t_mono), bounded
+        # by the span buffer the same requests land in
+        self._admits: dict[str, float] = {}
+        self._published = 0  # spans already shipped via maybe_publish
+        # registered HERE, not at import: TPUNN_TRACE unset must mean
+        # zero registry writes (tested)
+        reg = get_registry()
+        self._c_spans = reg.counter(
+            "trace_spans_total", "trace spans emitted",
+            labels=("segment",))
+        self._c_dropped = reg.counter(
+            "trace_dropped_total", "trace spans dropped",
+            labels=("reason",))
+
+    # -- sampling ----------------------------------------------------------
+
+    def sampled(self, request_id: str, tenant: str = "default") -> bool:
+        """Deterministic: hash(request_id), no RNG — the same request
+        id samples identically on every host and every rerun."""
+        if self.cfg.tenant and tenant != self.cfg.tenant:
+            return False
+        if self.cfg.sample >= 1.0:
+            return True
+        if self.cfg.sample <= 0.0:
+            return False
+        h = int(hashlib.sha1(request_id.encode()).hexdigest()[:8], 16)
+        return h / float(0xFFFFFFFF) < self.cfg.sample
+
+    def mint(self, request_id: str,
+             tenant: str = "default") -> Optional[TraceContext]:
+        if not self.sampled(request_id, tenant):
+            return None
+        trace_id = _digest(request_id)
+        return TraceContext(trace_id=trace_id,
+                            span_id=_digest(f"{trace_id}:0"))
+
+    # -- the span choke point ----------------------------------------------
+
+    def _emit(self, span: dict) -> None:
+        """Every span lands in the flight ring FIRST (lint-enforced:
+        a crash right after a segment completes must still show it
+        post-mortem), then the registry counter, the buffer, and the
+        JSONL stream."""
+        flight.record("trace", span["segment"],
+                      note=f"{span['trace']} leg={span['leg']} "
+                           f"{span.get('request_id', '')}")
+        self._c_spans.inc(segment=span["segment"])
+        if len(self.spans) >= self.cfg.max_spans:
+            self._c_dropped.inc(reason="buffer_full")
+            return
+        self.spans.append(span)
+        if self.metrics is not None:
+            self.metrics.emit("trace_span", **span)
+
+    def to_unix(self, t_mono: float) -> float:
+        return t_mono + self._unix_offset
+
+    def segment(self, ctx: TraceContext, segment: str, t0_mono: float,
+                t1_mono: float, **attrs) -> None:
+        """Record one duration span for ``ctx`` (timestamps are
+        time.monotonic() values from the emitting process)."""
+        t0 = self.to_unix(t0_mono)
+        t1 = self.to_unix(max(t1_mono, t0_mono))
+        span = dict(trace=ctx.trace_id, span=ctx.span_id,
+                    parent=ctx.parent_id, leg=ctx.leg,
+                    segment=segment, host=self.host,
+                    t0=round(t0, 6), t1=round(t1, 6))
+        span.update(attrs)
+        self._emit(span)
+
+    def mark(self, ctx: TraceContext, name: str, **attrs) -> None:
+        """Zero-duration breadcrumb (scheduler state transitions, the
+        kv_transfer wire point) — proves the context crossed a
+        boundary without claiming any critical-path time."""
+        now = self.to_unix(time.monotonic())
+        span = dict(trace=ctx.trace_id, span=ctx.span_id,
+                    parent=ctx.parent_id, leg=ctx.leg,
+                    segment="mark", mark=name, host=self.host,
+                    t0=round(now, 6), t1=round(now, 6))
+        span.update(attrs)
+        self._emit(span)
+
+    # -- export ------------------------------------------------------------
+
+    def export_spans(self) -> list[dict]:
+        """The buffer, with the ``slow_ms`` retention filter applied:
+        traces whose observed extent is under the floor are dropped
+        (and counted) — emit time cannot know a request's final
+        latency, so slow-only tracing filters here."""
+        if self.cfg.slow_ms <= 0:
+            return list(self.spans)
+        extent: dict[str, list[float]] = {}
+        for s in self.spans:
+            lo_hi = extent.setdefault(s["trace"], [s["t0"], s["t1"]])
+            lo_hi[0] = min(lo_hi[0], s["t0"])
+            lo_hi[1] = max(lo_hi[1], s["t1"])
+        keep = {t for t, (lo, hi) in extent.items()
+                if (hi - lo) * 1e3 >= self.cfg.slow_ms}
+        dropped = len(extent) - len(keep)
+        if dropped:
+            self._c_dropped.inc(dropped, reason="fast")
+        return [s for s in self.spans if s["trace"] in keep]
+
+
+# ---------------------------------------------------------------------------
+# Module singleton + the inert hooks (chaos-style lint contract)
+# ---------------------------------------------------------------------------
+
+_tracer: Tracer | None = None
+
+
+def maybe_init(spec: str | None = None, *, rank: int | None = None,
+               metrics=None,
+               config: TraceConfig | None = None) -> Tracer | None:
+    """Arm the process tracer from ``TPUNN_TRACE`` (or an explicit
+    ``spec``/``config``). No-op beyond one env read when unset or
+    ``"0"``; idempotent when armed."""
+    global _tracer
+    if _tracer is not None:
+        return _tracer
+    spec = os.environ.get(ENV_TRACE) if spec is None else spec
+    if not spec or spec == "0":
+        return None
+    _tracer = Tracer(
+        config if config is not None else parse_spec(spec),
+        rank=flight.default_rank() if rank is None else rank,
+        metrics=metrics,
+    )
+    log.warning("trace armed: %s (rank %d)", spec, _tracer.rank)
+    return _tracer
+
+
+def enabled() -> bool:
+    return _tracer is not None
+
+
+def tracer() -> Tracer | None:
+    return _tracer
+
+
+def reset() -> None:
+    """Disarm (test isolation)."""
+    global _tracer
+    _tracer = None
+
+
+def attach_metrics(metrics) -> None:
+    """Late-bind the JSONL sink (engines/fleets construct after
+    arming). Not a hot-path hook, but still inert-guarded."""
+    if _tracer is None:
+        return
+    if metrics is not None:
+        _tracer.metrics = metrics
+
+
+def export_spans() -> list[dict]:
+    """This process's spans (slow_ms filter applied); [] when unarmed."""
+    if _tracer is None:
+        return []
+    return _tracer.export_spans()
+
+
+# -- propagation hooks (every one: inert fast path, lint-enforced) ----------
+
+
+def on_submit(request_id: str,
+              tenant: str = "default") -> Optional[TraceContext]:
+    """Mint a context at admission (``Fleet.submit`` /
+    ``ProcessFleet.submit`` / standalone ``Scheduler.submit``).
+    None when unarmed or the request is not sampled."""
+    if _tracer is None:
+        return None
+    return _tracer.mint(request_id, tenant)
+
+
+def on_resubmit(ctx) -> Optional[TraceContext]:
+    """The failover / handoff boundary: the next leg's context, linked
+    back to the original trace (``parent_id`` = the previous leg's
+    root span). None when unarmed or ``ctx`` is None."""
+    if _tracer is None:
+        return None
+    if ctx is None:
+        return None
+    return ctx.child()
+
+
+def on_transition(ctx, state: str, request_id: str = "") -> None:
+    """Scheduler ``_transition`` breadcrumb — every state change of a
+    traced request leaves a mark (lint-pinned to the one choke
+    point)."""
+    if _tracer is None:
+        return
+    if ctx is None:
+        return
+    _tracer.mark(ctx, f"state:{state}", request_id=request_id)
+
+
+def on_segment(ctx, segment: str, t0_mono: float, t1_mono: float,
+               **attrs) -> None:
+    """One attributed slice of a traced request's life (queued /
+    restore / prefill / transfer / failover / decode), timestamps in
+    the emitting process's ``time.monotonic()``."""
+    if _tracer is None:
+        return
+    if ctx is None:
+        return
+    _tracer.segment(ctx, segment, t0_mono, t1_mono, **attrs)
+
+
+def on_transfer(ctx, *, src: str, dst: str, nbytes: int) -> None:
+    """The ``ops.collectives.kv_transfer`` wire choke point: a mark
+    that the context rode the KV stream (the duration lands as a
+    ``transfer`` segment from ``DisaggFleet._stream_blocks``, which
+    owns the wall clock around the wire)."""
+    if _tracer is None:
+        return
+    if ctx is None:
+        return
+    _tracer.mark(ctx, "kv_transfer", src=src, dst=dst, nbytes=int(nbytes))
+
+
+def on_worker_admit(rec: dict, *, host: int) -> None:
+    """Worker-process side (fleet_worker.py): a dispatch record pulled
+    from ``req/<idx>/<k>`` enters the backend — stamp the admit time
+    so the completion hook can span the remote leg."""
+    if _tracer is None:
+        return
+    if "trace" not in rec:
+        return
+    _tracer._admits[str(rec.get("request_id", ""))] = time.monotonic()
+
+
+def on_worker_done(rec: dict, tokens: list, status: str, *,
+                   host: int) -> None:
+    """Worker-process side: the request finished on this replica —
+    emit the remote decode span into THIS process's buffer (its own
+    per-host ring; the store collector joins it with the
+    coordinator's)."""
+    if _tracer is None:
+        return
+    if "trace" not in rec:
+        return
+    try:
+        ctx = TraceContext.from_wire(str(rec["trace"]))
+    except (ValueError, TypeError):
+        _tracer._c_dropped.inc(reason="bad_wire")
+        return
+    rid = str(rec.get("request_id", ""))
+    now = time.monotonic()
+    t0 = _tracer._admits.pop(rid, now)
+    _tracer.segment(ctx, "decode", t0, now, request_id=rid,
+                    host_index=int(host), tokens=len(tokens),
+                    status=status)
+
+
+def maybe_publish(client, *, rank: int) -> bool:
+    """Publish this process's spans through the store (the
+    :func:`obs.aggregate.publish_spans` transport). Inert no-op when
+    unarmed or nothing new since the last publish; never raises into
+    the serve loop."""
+    if _tracer is None:
+        return False
+    if not _tracer.spans:
+        return False
+    n = len(_tracer.spans)
+    if n == _tracer._published:
+        return False
+    from pytorch_distributed_nn_tpu.obs import aggregate
+
+    try:
+        aggregate.publish_spans(client, rank=rank,
+                                spans=_tracer.export_spans())
+        _tracer._published = n
+        return True
+    except (OSError, TimeoutError) as e:
+        _tracer._c_dropped.inc(reason="store_error")
+        log.warning("trace span publish failed: %s", e)
+        return False
+
+
+# ---------------------------------------------------------------------------
+# Chrome trace-event bridge (obs/span.py merge compatibility)
+# ---------------------------------------------------------------------------
+
+
+def spans_to_chrome(spans: list[dict], *,
+                    pid: int | None = None) -> list[dict]:
+    """Span dicts → Chrome trace events (``ph:"X"``, µs since the unix
+    epoch) whose ``args`` carry the full span — so a file written from
+    these merges through :func:`obs.span.merge_chrome_traces` and
+    :func:`obs.critpath.spans_from_chrome` can reconstruct the spans
+    from the merged timeline."""
+    out = []
+    for s in spans:
+        host_pid = pid
+        if host_pid is None:
+            h = str(s.get("host", "h0"))
+            digits = "".join(c for c in h if c.isdigit())
+            host_pid = int(digits) if digits else 0
+        out.append({
+            "name": f"{s['trace'][:8]}/{s['segment']}",
+            "cat": "trace", "ph": "X",
+            "ts": s["t0"] * 1e6,
+            "dur": max(s["t1"] - s["t0"], 0.0) * 1e6,
+            "pid": host_pid, "tid": s.get("leg", 0),
+            "args": dict(s),
+        })
+    return out

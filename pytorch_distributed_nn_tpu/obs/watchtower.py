@@ -429,11 +429,19 @@ class Watchtower:
         ttft = float(ev.get("ttft_s", 0.0))
         rid = str(ev.get("request_id", ""))
         tenant = str(ev.get("tenant", "default"))
-        self._recent_reqs.append({
+        entry = {
             "request_id": rid, "tenant": tenant,
             "ttft_s": round(ttft, 6), "ok": ok,
             "waterfall": ev.get("waterfall"),
-        })
+        }
+        # Causeway (obs/trace.py): a traced request carries its
+        # trace_id into the worst-offender attribution, so an SLO-burn
+        # page names the exact trace to pull the waterfall for. Key
+        # absent when untraced — replaying an untraced stream stays
+        # byte-identical.
+        if ev.get("trace"):
+            entry["trace"] = ev["trace"]
+        self._recent_reqs.append(entry)
         # one budget sample per request id (set-based, so replaying the
         # same stream stays byte-identical): the first terminal outcome
         # — reject or completion — is the one the client experienced;

@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from pytorch_distributed_nn_tpu.obs import flight as _flight
+from pytorch_distributed_nn_tpu.obs import trace as _trace
 from pytorch_distributed_nn_tpu.runtime import chaos as _chaos
 
 AxisName = str | tuple[str, ...]
@@ -149,7 +150,7 @@ def _record(op: str, x, axis: AxisName) -> None:
 
 
 def kv_transfer(blocks, *, src: str, dst: str, src_index: int = -1,
-                dst_index: int = -1):
+                dst_index: int = -1, trace=None):
     """Host-side KV block-streaming choke point (disaggregated
     serving, :mod:`serve.disagg`): ship a pytree of paged KV blocks
     (leading axis = block id) from replica ``src`` to replica ``dst``
@@ -179,6 +180,10 @@ def kv_transfer(blocks, *, src: str, dst: str, src_index: int = -1,
     ))
     _flight.on_collective("kv_transfer", axis=edge, nbytes=payload,
                           shape=(n_blocks,), dtype="kv_blocks")
+    # trace context rides the transfer (obs/trace.py, lint-pinned):
+    # mark BEFORE the chaos hook so a killed wire still shows the
+    # transfer on the trace it was serving
+    _trace.on_transfer(trace, src=src, dst=dst, nbytes=payload)
     # chaos hook (runtime/chaos.py): kill_transfer raises HERE, after
     # the bytes are on the books — a real mid-transfer death also
     # burned the wire before the receiver noticed

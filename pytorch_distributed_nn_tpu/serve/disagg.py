@@ -73,7 +73,7 @@ import time
 import jax
 import numpy as np
 
-from pytorch_distributed_nn_tpu.obs import flight
+from pytorch_distributed_nn_tpu.obs import flight, trace
 from pytorch_distributed_nn_tpu.obs.registry import get_registry
 from pytorch_distributed_nn_tpu.ops import collectives
 from pytorch_distributed_nn_tpu.runtime import chaos
@@ -185,10 +185,12 @@ class DisaggFleet(Fleet):
         if ticket.stage == "decode":
             # best-effort: a failed/absent stream just means a cold
             # suffix prefill on h — never a correctness event
-            self._warm_peer(h, prompt)
+            self._warm_peer(h, prompt, trace_ctx=ticket.trace)
         req = h.engine.submit(
             prompt, leg_budget, deadline_s=ticket.deadline_s,
-            request_id=ticket.request_id, resubmit=resubmit)
+            request_id=ticket.request_id, resubmit=resubmit,
+            trace_ctx=ticket.trace, t_origin=ticket.t_submit,
+            t_first_origin=ticket.t_first_token)
         ticket._attempt = (h.index, req)
         if req.done.is_set() and req.state == REJECTED:
             self._finalize_rejected(ticket, req.reject_reason)
@@ -229,6 +231,11 @@ class DisaggFleet(Fleet):
             return
         ticket.prefix.extend(emitted)
         ticket.stage = "decode"
+        # Causeway: the decode leg is a resubmission of the same trace
+        # — leg+1, parent = the prefill leg's root span
+        nxt = trace.on_resubmit(ticket.trace)
+        if nxt is not None:
+            ticket.trace = nxt
         remaining = ticket.max_new_tokens - len(ticket.prefix)
         new_prompt = np.concatenate(
             [ticket.prompt, np.asarray(ticket.prefix, np.int32)])
@@ -247,7 +254,7 @@ class DisaggFleet(Fleet):
     # -- KV block streaming ------------------------------------------------
 
     def _warm_peer(self, dst: ReplicaHandle, prompt,
-                   adapter: int = 0) -> int:
+                   adapter: int = 0, trace_ctx=None) -> int:
         """Pull the longest resident prefix chain for ``prompt`` from
         the peer that owns it into ``dst``'s cache, if any peer beats
         what ``dst`` already holds. Returns blocks ingested (0: nobody
@@ -268,10 +275,11 @@ class DisaggFleet(Fleet):
         if best is None:
             return 0
         return self._stream_blocks(best, dst, best_match, prompt,
-                                   adapter)
+                                   adapter, trace_ctx=trace_ctx)
 
     def _stream_blocks(self, src: ReplicaHandle, dst: ReplicaHandle,
-                       match, prompt, adapter: int = 0) -> int:
+                       match, prompt, adapter: int = 0, *,
+                       trace_ctx=None) -> int:
         """THE transfer path (lint-enforced, tests/test_quality.py):
         pin the chain on the source, export its block rows, ship them
         through :func:`ops.collectives.kv_transfer` (wire bytes →
@@ -302,19 +310,32 @@ class DisaggFleet(Fleet):
             outcome = "failed"  # until the wire round-trips
             collectives.kv_transfer(
                 host, src=src.name, dst=dst.name,
-                src_index=src.index, dst_index=dst.index)
+                src_index=src.index, dst_index=dst.index,
+                trace=trace_ctx)
             bs = pool.block_size
             ingested = dst.engine.ingest_blocks(
                 prompt[:len(blocks) * bs], host, adapter)
             outcome = "ok"
+            trace.on_segment(trace_ctx, "transfer", t0, time.monotonic(),
+                             src=src.name, dst=dst.name,
+                             blocks=len(blocks), bytes=payload,
+                             outcome="ok")
             return ingested
         except chaos.TransferKillError:
             # the source "died" with the payload half on the wire:
             # declare it dead (its stranded requests re-admit through
             # the normal failover) and let the caller's decode leg run
             # cold — re-prefill on the survivor, output unchanged
+            t_kill = time.monotonic()
+            trace.on_segment(trace_ctx, "transfer", t0, t_kill,
+                             src=src.name, dst=dst.name,
+                             blocks=len(blocks), bytes=payload,
+                             outcome="failed")
             self._fail_replica(src, kind="crash",
                                reason="crash:kill_transfer")
+            trace.on_segment(trace_ctx, "failover", t_kill,
+                             time.monotonic(), from_replica=src.name,
+                             reason="kill_transfer")
             return 0
         finally:
             for b in match.blocks:

@@ -48,7 +48,7 @@ from pytorch_distributed_nn_tpu.inference.generate import (
     init_cache,
 )
 from pytorch_distributed_nn_tpu.nn.lora import num_adapters
-from pytorch_distributed_nn_tpu.obs import flight, watchtower, xray
+from pytorch_distributed_nn_tpu.obs import flight, trace, watchtower, xray
 from pytorch_distributed_nn_tpu.runtime import chaos
 from pytorch_distributed_nn_tpu.serve import autoscale
 from pytorch_distributed_nn_tpu.serve.kv_pool import KVPool
@@ -263,6 +263,9 @@ class ServingEngine:
         self.max_seq_len = int(max_seq_len)
         self.eos_token = eos_token
         self.metrics = metrics  # MetricsLogger or None
+        # Causeway: give an armed tracer the JSONL sink (no-op when
+        # TPUNN_TRACE is unset — zero writes, lint contract)
+        trace.attach_metrics(metrics)
         # per-request LoRA: stacked (n, L, ...) factor bank
         # (nn/lora.py); requests pick an adapter at submit and each
         # batch row applies its own deltas in the shared forward
@@ -463,8 +466,11 @@ class ServingEngine:
             nb = len(match.restore_blocks)
             table = np.zeros((self._blocks_per_seq,), np.int32)
             table[:nb] = match.restore_blocks
+            t_restore = time.monotonic()
             row_cache = _restore_blocks(
                 row_cache, self._store, bs, table, np.int32(nb))
+            trace.on_segment(req.trace, "restore", t_restore,
+                             time.monotonic(), blocks=nb, cached=m)
         with obs.span("serve/prefill", request=req.request_id,
                       prompt_len=L, cached=m):
             if self.lora_bank is None:
@@ -485,9 +491,16 @@ class ServingEngine:
             req.prefix_match = None
         now = time.monotonic()
         req.t_first_token = now
-        self._h_ttft.observe(now - req.t_submit)
-        self._h_ttft_tenant.observe(now - req.t_submit,
-                                    tenant=req.tenant)
+        # TTFT is charged from the logical request's ORIGINAL arrival
+        # (t_origin: set by the fleet on resubmitted legs), and only
+        # when THIS leg delivers the first token — a disagg decode leg
+        # or a post-first-token failover re-admission arrives with
+        # t_first_origin already set and must not observe again (the
+        # capacity sim's accounting, now pinned for the live fleet too)
+        if req.t_first_origin == 0.0:
+            ttft = now - (req.t_origin or req.t_submit)
+            self._h_ttft.observe(ttft)
+            self._h_ttft_tenant.observe(ttft, tenant=req.tenant)
         self._cache = _insert_row(self._cache, row_cache, slot)
         self._slots[slot] = _Slot(req, first, depth=L, cached=m)
         self._h_last[slot] = first
@@ -618,7 +631,14 @@ class ServingEngine:
         return len(plan)
 
     def _finish_record(self, req: Request, s: _Slot) -> None:
-        ttft = req.t_first_token - req.t_submit
+        # TTFT from the logical request's original arrival: for a
+        # resubmitted leg, t_origin is the FIRST submit and
+        # t_first_origin (if set) the first token an earlier leg
+        # already delivered — the JSONL must agree with the fleet
+        # ticket and the capacity sim, not restart the clock per leg
+        origin = req.t_origin or req.t_submit
+        t_first = req.t_first_origin or req.t_first_token
+        ttft = t_first - origin
         total = req.t_done - req.t_submit
         decode = req.t_done - req.t_first_token
         per_tok = decode / max(s.emitted - 1, 1)
@@ -649,10 +669,29 @@ class ServingEngine:
         )
         if self.tag:
             rec["replica"] = self.tag
+        if req.trace is not None:
+            # the record names its trace (watchtower pages attach it;
+            # key absent when untraced, so replayed streams from an
+            # unarmed run stay byte-identical)
+            rec["trace"] = req.trace.trace_id
         self.completed.append(rec)
         if self.metrics is not None:
             self.metrics.emit("serve_request", **rec)
         watchtower.on_serve_request(rec)
+        # Causeway segments, retroactive from the scheduler's
+        # lifecycle timestamps — the decode hot loop stays untouched
+        # (its lint bans extras); resubmit legs ride the ctx the fleet
+        # minted/linked
+        trace.on_segment(req.trace, "queued", req.t_submit,
+                         req.t_admit, request_id=req.request_id,
+                         replica=self.tag)
+        trace.on_segment(req.trace, "prefill", req.t_admit,
+                         req.t_first_token, request_id=req.request_id,
+                         replica=self.tag, cached=s.cached,
+                         prompt_len=len(req.prompt))
+        trace.on_segment(req.trace, "decode", req.t_first_token,
+                         req.t_done, request_id=req.request_id,
+                         replica=self.tag, tokens=s.emitted)
         tracer = obs.current_recorder()
         if tracer is not None:
             # retroactive per-request span: duration is only known now

@@ -59,7 +59,7 @@ from typing import Optional
 import numpy as np
 
 from pytorch_distributed_nn_tpu.launch import RestartPolicy
-from pytorch_distributed_nn_tpu.obs import flight, watchtower
+from pytorch_distributed_nn_tpu.obs import flight, trace, watchtower
 from pytorch_distributed_nn_tpu.obs.registry import get_registry
 from pytorch_distributed_nn_tpu.runtime import chaos, failure
 from pytorch_distributed_nn_tpu.serve.engine import ServingEngine
@@ -112,6 +112,10 @@ class FleetTicket:
         # disaggregated fleets (serve/disagg.py): which leg the current
         # attempt runs — "" (unified), "prefill", or "decode"
         self.stage = ""
+        # Causeway (obs/trace.py): the logical request's TraceContext,
+        # re-linked (leg+1, parent=previous root span) on every
+        # resubmission; None when unarmed or unsampled
+        self.trace = None
 
     @property
     def ok(self) -> bool:
@@ -509,6 +513,9 @@ class Fleet:
         ticket = FleetTicket(
             request_id or f"freq-{next(_ids)}", prompt,
             max_new_tokens, deadline_s)
+        # Causeway mint point: the context outlives every per-replica
+        # Request this ticket will spawn
+        ticket.trace = trace.on_submit(ticket.request_id)
         with self._lock:
             self._journal[ticket.request_id] = ticket
             self._place(ticket, prompt, int(max_new_tokens),
@@ -562,7 +569,9 @@ class Fleet:
             return None
         req = h.engine.submit(
             prompt, max_new, deadline_s=ticket.deadline_s,
-            request_id=ticket.request_id, resubmit=resubmit)
+            request_id=ticket.request_id, resubmit=resubmit,
+            trace_ctx=ticket.trace, t_origin=ticket.t_submit,
+            t_first_origin=ticket.t_first_token)
         ticket._attempt = (h.index, req)
         if req.done.is_set() and req.state == REJECTED:
             self._finalize_rejected(ticket, req.reject_reason)
@@ -688,9 +697,18 @@ class Fleet:
                 [ticket.prompt,
                  np.asarray(ticket.prefix, np.int32)])
         self.failovers += 1
+        # Causeway: the re-admitted leg gets a linked child context
+        # (same trace_id, leg+1, parent = the dead leg's root span)
+        nxt = trace.on_resubmit(ticket.trace)
+        if nxt is not None:
+            ticket.trace = nxt
         placed = self._place(ticket, new_prompt, remaining,
                              resubmit=True)
         readmit_s = time.monotonic() - t_detect
+        trace.on_segment(ticket.trace, "failover", t_detect,
+                         time.monotonic(),
+                         request_id=ticket.request_id,
+                         from_replica=from_replica, reason=reason)
         to_replica = placed if placed is not None else -1
         fo = dict(from_replica=from_replica, to_replica=to_replica,
                   reason=reason, readmit_s=round(readmit_s, 6),

@@ -48,6 +48,7 @@ import os
 import sys
 import time
 
+from pytorch_distributed_nn_tpu.obs import trace
 from pytorch_distributed_nn_tpu.runtime import chaos, failure
 from pytorch_distributed_nn_tpu.runtime.platform import (
     apply_platform_overrides,
@@ -218,6 +219,8 @@ def _publish_done(ps, rec: dict, tokens: list, status: str,
     write landing, only latency does."""
     payload = {"life": int(rec.get("life", 0)), "status": status,
                "tokens": [int(t) for t in tokens]}
+    if "trace" in rec:  # Causeway echo — absent when unarmed
+        payload["trace"] = rec["trace"]
     key = f"done/{rec['request_id']}"
     for _ in range(retries):
         if _publish(ps, key, payload, op="worker_done"):
@@ -257,16 +260,24 @@ def _serve_loop(args, ps, idx: int, reporter, backend) -> int:
         except (OSError, TimeoutError):
             failure.count_store_error("worker_pull")
         while queue and backend.slots_free > 0:
-            backend.admit(queue.pop(0))
+            rec0 = queue.pop(0)
+            # Causeway: stamp the admit time for this leg's decode
+            # span before the backend owns the record
+            trace.on_worker_admit(rec0, host=idx)
+            backend.admit(rec0)
         progress, completed = backend.step()
         for rec, toks in progress:
             if toks:
-                _publish(ps, f"prog/{rec['request_id']}",
-                         {"life": int(rec.get("life", 0)),
-                          "tokens": [int(t) for t in toks]},
+                payload = {"life": int(rec.get("life", 0)),
+                           "tokens": [int(t) for t in toks]}
+                if "trace" in rec:  # Causeway echo
+                    payload["trace"] = rec["trace"]
+                _publish(ps, f"prog/{rec['request_id']}", payload,
                          op="worker_prog")
         for rec, toks, status in completed:
+            trace.on_worker_done(rec, toks, status, host=idx)
             _publish_done(ps, rec, toks, status)
+        trace.maybe_publish(ps, rank=idx)
         _publish(ps, f"gauge/{idx}", dict(
             queue_depth=len(queue), max_queue=args.max_queue,
             pid=os.getpid(), round=rounds, draining=draining,
@@ -314,6 +325,9 @@ def main(argv=None) -> int:
     client = make_store(args.store)
     ps = PrefixStore(client, args.namespace) if args.namespace else client
     idx = int(args.replica_index)
+    # arm tracing from TPUNN_TRACE (inherited via worker_env) — each
+    # worker process owns its own span ring, published at trace/<idx>
+    trace.maybe_init(rank=idx)
     reporter = failure.HeartbeatReporter(
         ps, rank=idx, incarnation=0,
         interval_s=args.hb_interval,

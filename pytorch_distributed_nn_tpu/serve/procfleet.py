@@ -62,7 +62,7 @@ from typing import Optional
 import numpy as np
 
 from pytorch_distributed_nn_tpu.launch import RestartPolicy, worker_env
-from pytorch_distributed_nn_tpu.obs import flight, watchtower
+from pytorch_distributed_nn_tpu.obs import flight, trace, watchtower
 from pytorch_distributed_nn_tpu.obs.registry import get_registry
 from pytorch_distributed_nn_tpu.runtime import chaos, failure
 from pytorch_distributed_nn_tpu.serve import autoscale as _autoscale
@@ -105,6 +105,7 @@ class ProcTicket:
         self.reject_reason = ""
         self.tokens: Optional[np.ndarray] = None
         self.assigned: Optional[int] = None  # replica index, None=unplaced
+        self.trace = None  # TraceContext (obs/trace.py), None when unarmed
         self.done = threading.Event()
 
     @property
@@ -540,6 +541,7 @@ class ProcessFleet:
             request_id
             or f"preq-{self.incarnation}-{next(_ids)}",
             prompt, int(max_new_tokens))
+        ticket.trace = trace.on_submit(ticket.request_id)
         with self._lock:
             self._tickets[ticket.request_id] = ticket
             try:
@@ -567,6 +569,11 @@ class ProcessFleet:
                "prompt": ticket.prompt + ticket.prefix,
                "max_new_tokens": remaining,
                "life": ticket.life}
+        # Causeway (obs/trace.py, lint-pinned): the trace context rides
+        # the dispatch record to the worker process — key ABSENT when
+        # unarmed so the wire bytes are unchanged byte-for-byte
+        if ticket.trace is not None:
+            rec["trace"] = ticket.trace.to_wire()
         try:
             self.journal.append({
                 "event": "place", "request_id": ticket.request_id,
@@ -801,6 +808,11 @@ class ProcessFleet:
                  reason: str) -> None:
         t.prefix.extend(emitted)
         t.life += 1
+        # Causeway: the re-admitted life is a child leg of the same
+        # trace — linked to the original, never a fresh trace_id
+        nxt = trace.on_resubmit(t.trace)
+        if nxt is not None:
+            t.trace = nxt
         if len(t.prefix) >= t.max_new_tokens:
             self._finalize_from_payload(
                 t, {"life": t.life, "status": "done", "tokens": []})
@@ -813,6 +825,9 @@ class ProcessFleet:
                   readmit_s=round(time.monotonic() - t_detect, 6),
                   prefix_tokens=len(t.prefix))
         t.failovers.append(fo)
+        trace.on_segment(t.trace, "failover", t_detect,
+                         time.monotonic(), request_id=t.request_id,
+                         from_replica=from_replica, reason=reason)
         flight.record("fleet", "readmit",
                       note=f"{t.request_id} r{from_replica}->"
                            f"r{fo['to_replica']} "

@@ -71,7 +71,7 @@ from typing import Optional
 
 import numpy as np
 
-from pytorch_distributed_nn_tpu.obs import flight, watchtower
+from pytorch_distributed_nn_tpu.obs import flight, trace, watchtower
 from pytorch_distributed_nn_tpu.obs.registry import get_registry
 from pytorch_distributed_nn_tpu.runtime import chaos
 from pytorch_distributed_nn_tpu.serve.kv_pool import KVPool
@@ -105,6 +105,14 @@ class Request:
     t_admit: float = 0.0
     t_first_token: float = 0.0
     t_done: float = 0.0
+    # logical-request origin times (fleet-level): a resubmitted leg —
+    # failover re-admission or a disagg decode-leg rewrite — carries
+    # the ORIGINAL arrival in t_origin (0.0: this leg is the arrival)
+    # and, when an earlier leg already delivered the first token, that
+    # token's time in t_first_origin (0.0: not delivered yet). TTFT is
+    # always charged from t_origin, exactly once per logical request.
+    t_origin: float = 0.0
+    t_first_origin: float = 0.0
     # scheduler-round bookkeeping (the anti-starvation test's evidence)
     round_submitted: int = -1
     round_admitted: int = -1
@@ -119,6 +127,9 @@ class Request:
     tenant: str = "default"
     adapter: int = 0
     prefix_match: object = None
+    # Causeway (obs/trace.py): the propagated TraceContext, or None
+    # when tracing is unarmed / the request is not sampled
+    trace: object = None
     # True while this request holds a slot in its tenant's live-quota
     # count (set on QUEUED, dropped on any terminal transition)
     quota_held: bool = False
@@ -187,6 +198,12 @@ class Scheduler:
         enforced): the counter can't drift from reality, and terminal
         states release the waiting client exactly once."""
         req.state = state
+        # Causeway breadcrumb (inert one-comparison no-op unless
+        # TPUNN_TRACE armed AND this request was sampled): every state
+        # change of a traced request marks its trace, lint-pinned to
+        # this one choke point
+        trace.on_transition(req.trace, state,
+                            request_id=req.request_id)
         # fleet re-admission idempotency: a request re-submitted with
         # the same id after a replica death already counted its
         # queued/running transitions in its first life — one logical
@@ -234,13 +251,22 @@ class Scheduler:
                request_id: Optional[str] = None,
                resubmit: bool = False,
                tenant: str = "default",
-               adapter: int = 0) -> Request:
+               adapter: int = 0,
+               trace_ctx: object = None,
+               t_origin: Optional[float] = None,
+               t_first_origin: float = 0.0) -> Request:
         """Thread-safe admission attempt. Always returns a Request; a
         rejected one is already terminal (``done`` set, ``state ==
         REJECTED``, ``reject_reason`` says why). ``resubmit`` marks a
         fleet failover re-admission (same ``request_id`` as a request
         stranded on a dead replica): its queued/running transitions are
-        not re-counted (see :meth:`_transition`)."""
+        not re-counted (see :meth:`_transition`). ``t_origin`` /
+        ``t_first_origin`` carry the logical request's original arrival
+        and (if already delivered) first-token times across legs, so
+        TTFT is charged from first submit exactly once;
+        ``trace_ctx`` is the Causeway context riding the leg. A
+        standalone (fleet-less) submit mints its own context when
+        tracing is armed."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         if prompt.size < 1:
             raise ValueError("empty prompt")
@@ -253,7 +279,14 @@ class Scheduler:
             deadline_s=deadline_s, t_submit=time.monotonic(),
             resubmitted=bool(resubmit),
             tenant=str(tenant), adapter=int(adapter),
+            t_origin=float(t_origin) if t_origin else 0.0,
+            t_first_origin=float(t_first_origin),
         )
+        # fleet legs arrive with their context minted at Fleet.submit;
+        # a bare engine/scheduler mints here (same choke point role)
+        req.trace = (trace_ctx if trace_ctx is not None or resubmit
+                     else trace.on_submit(req.request_id,
+                                          tenant=req.tenant))
         quota = self.tenant_quotas.get(req.tenant)
         with self._lock:
             req.round_submitted = self.round

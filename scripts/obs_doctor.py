@@ -87,6 +87,11 @@ def _analyze(paths_or_dir, expect_ranks: int | None, last: int,
                           "note": e.get("note")}
                          for e in d.autoscale_events]
                 for r, d in dumps.items() if d.autoscale_events},
+            # Causeway traces (obs/trace.py) alive in each ring when
+            # the dump landed — trace_id -> segment tally + legs, the
+            # handle scripts/obs_trace.py pulls waterfalls by; None
+            # for runs with TPUNN_TRACE unset
+            "traces": forensics.trace_summary(dumps),
             # profiler captures (obs/xray.py) that fired before the
             # dump — the landing dir per rank, so a post-mortem can go
             # straight from the incident to the device trace covering

@@ -334,6 +334,56 @@ def print_fleet_table(events: list[dict], last: int) -> bool:
     return True
 
 
+def print_trace_table(events: list[dict], last: int) -> bool:
+    """Causeway section (obs/trace.py): per-segment latency
+    percentiles across every traced request in the stream, plus the
+    dominant-segment table — for each trace, which segment owned the
+    most critical-path time (obs/critpath.py attribution). Silently
+    skipped when the file has no ``trace_span`` events (TPUNN_TRACE
+    unset). Full waterfalls: ``scripts/obs_trace.py`` on this file."""
+    spans = [{k: v for k, v in e.items()
+              if k not in ("event", "time", "process")}
+             for e in events if e.get("event") == "trace_span"]
+    if not spans:
+        return False
+    from pytorch_distributed_nn_tpu.obs import critpath
+
+    print("\n== request traces (Causeway) ==")
+    durs = [s for s in spans
+            if s.get("segment") in critpath.PRIORITY
+            and _num(s, "t1") > _num(s, "t0")]
+    per_seg: dict[str, list[float]] = {}
+    for s in durs:
+        per_seg.setdefault(str(s["segment"]), []).append(
+            _num(s, "t1") - _num(s, "t0"))
+    traces = sorted({str(s.get("trace", "")) for s in spans})
+    print(f"{len(traces)} trace(s), {len(spans)} span(s)")
+    if per_seg:
+        print(f"{'segment':>9} {'spans':>6} {'p50':>10} {'p99':>10}")
+        for seg in sorted(per_seg,
+                          key=lambda k: -critpath.PRIORITY[k]):
+            xs = per_seg[seg]
+            print(f"{seg:>9} {len(xs):>6} "
+                  f"{_fmt_s(percentile(xs, 0.50))} "
+                  f"{_fmt_s(percentile(xs, 0.99))}")
+    dominated: dict[str, int] = {}
+    worst: list[tuple[float, str, str]] = []
+    for t in traces:
+        cp = critpath.critical_path(
+            [s for s in spans if str(s.get("trace", "")) == t])
+        if not cp["segments"]:
+            continue
+        dominated[cp["dominant"]] = dominated.get(cp["dominant"], 0) + 1
+        worst.append((cp["total_s"], t, cp["dominant"]))
+    if dominated:
+        print("dominant segment: " + ", ".join(
+            f"{seg} x{n}" for seg, n in
+            sorted(dominated.items(), key=lambda kv: -kv[1])))
+    for total, t, dom in sorted(worst, reverse=True)[:last]:
+        print(f"  {t}  {total * 1e3:8.1f}ms  dominated by {dom}")
+    return True
+
+
 def print_capacity_table(events: list[dict], last: int,
                          requested: bool = False) -> bool:
     """Skyline capacity-planning section (obs/capacity.py): the
@@ -532,7 +582,7 @@ def main(argv=None) -> int:
                     ("serve_request", "serve_summary", "fleet_state",
                      "fleet_replica_down", "fleet_failover",
                      "fleet_reload", "fleet_handoff", "kv_transfer",
-                     "capacity_rung",
+                     "trace_span", "capacity_rung",
                      "capacity_frontier", "capacity_plan",
                      "autoscale_decision")
                     for e in events)
@@ -540,14 +590,15 @@ def main(argv=None) -> int:
     print_comms_table(events, args.trace or None)
     serve_ok = print_serving_table(events, args.last)
     fleet_ok = print_fleet_table(events, args.last)
+    trace_ok = print_trace_table(events, args.last)
     cap_ok = print_capacity_table(events, args.last,
                                   requested=args.capacity)
     helm_ok = print_autoscale_table(events, args.last,
                                     requested=args.autoscale)
     xray_ok = print_xray_table(args.xray or None, args.last)
     print_metric_tail(events, args.last)
-    return 0 if (ok or serve_ok or fleet_ok or cap_ok or helm_ok
-                 or xray_ok) else 1
+    return 0 if (ok or serve_ok or fleet_ok or trace_ok or cap_ok
+                 or helm_ok or xray_ok) else 1
 
 
 if __name__ == "__main__":

@@ -160,3 +160,54 @@ def test_coordinator_abandon_adopt_readmit():
         f1._client.close()
         if f1._server is not None:
             f1._server.stop()
+
+
+@pytest.mark.slow
+def test_trace_context_survives_process_boundary():
+    """Causeway cross-process continuity (ISSUE 16): the coordinator
+    mints the context, ships it inside the ``req/<idx>/<k>`` dispatch
+    record, and each worker SUBPROCESS emits its own decode span into
+    its own buffer, published at ``trace/<idx>`` — pulled back through
+    the store, the worker spans carry the coordinator's trace ids."""
+    from pytorch_distributed_nn_tpu.obs import aggregate
+    from pytorch_distributed_nn_tpu.obs import trace as tr
+
+    tr.reset()
+    tr.maybe_init("1", rank=0)
+    try:
+        with ProcessFleet(
+                replicas=2, backend="stub",
+                heartbeat_interval_s=0.05, heartbeat_timeout_s=5.0,
+                worker_extra_env={"TPUNN_TRACE": "1"},
+        ) as fleet:
+            fleet.start()
+            assert fleet.wait_ready(2, timeout=120)
+            tickets = [fleet.submit(p, 16) for p in _prompts(3)]
+            assert fleet.wait_all(tickets, timeout=60)
+            minted = {t.trace.trace_id for t in tickets}
+            assert len(minted) == 3  # every ticket carried a context
+            deadline = time.time() + 30
+            spans = []
+            while time.time() < deadline:
+                spans = aggregate.collect_spans(
+                    fleet._ns, range(2))
+                done = [s for s in spans
+                        if s.get("segment") == "decode"
+                        and s.get("status") == "done"]
+                if {s["trace"] for s in done} >= minted:
+                    break
+                time.sleep(0.2)
+        workers = [s for s in spans if s.get("segment") == "decode"]
+        assert {s["trace"] for s in workers} >= minted, \
+            (minted, workers)
+        # the worker recovered the full context from the wire, not
+        # just the id: leg + root span match what the coordinator sent
+        by_id = {t.trace.trace_id: t.trace for t in tickets}
+        for s in workers:
+            if s["trace"] in by_id:
+                ctx = by_id[s["trace"]]
+                assert s["span"] == ctx.span_id
+                assert s["leg"] == ctx.leg
+                assert s["host"] in ("h0", "h1")
+    finally:
+        tr.reset()

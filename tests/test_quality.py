@@ -869,3 +869,159 @@ def test_kv_transfer_is_the_single_streaming_choke_point():
             f"engine.ingest_blocks must be called only from the "
             f"streaming path, found {callers['ingest_blocks']}"
         )
+
+
+_TRACE = (Path(__file__).parent.parent / "pytorch_distributed_nn_tpu"
+          / "obs" / "trace.py")
+_SERVE = Path(__file__).parent.parent / "pytorch_distributed_nn_tpu" \
+    / "serve"
+
+
+def test_trace_hooks_are_provably_inert_when_unset():
+    """ISSUE 16 lint: every public ``on_*`` hook in obs/trace.py must
+    open with the literal ``if _tracer is None: return`` fast path
+    (the chaos/watchtower/xray contract) — on_transition sits in the
+    scheduler's state machine and on_segment in the engine's finish
+    path, so an unset ``TPUNN_TRACE`` must cost one global load + one
+    comparison per hook, nothing more."""
+    tree = ast.parse(_TRACE.read_text())
+    hooks = [n for n in tree.body if isinstance(n, ast.FunctionDef)
+             and n.name.startswith("on_")]
+    assert len(hooks) >= 7, "expected submit/resubmit/transition/" \
+                            "segment/transfer/worker_admit/worker_done"
+    for fn in hooks:
+        first = fn.body[0]
+        if isinstance(first, ast.Expr) and isinstance(
+                first.value, ast.Constant):  # docstring
+            first = fn.body[1]
+        ok = (isinstance(first, ast.If)
+              and isinstance(first.test, ast.Compare)
+              and isinstance(first.test.left, ast.Name)
+              and first.test.left.id == "_tracer"
+              and len(first.test.ops) == 1
+              and isinstance(first.test.ops[0], ast.Is)
+              and isinstance(first.test.comparators[0], ast.Constant)
+              and first.test.comparators[0].value is None
+              and len(first.body) == 1
+              and isinstance(first.body[0], ast.Return))
+        assert ok, (f"trace.{fn.name} must start with "
+                    f"'if _tracer is None: return' (the disabled "
+                    f"fast path)")
+
+
+def test_trace_spans_record_to_flight_ring_first():
+    """ISSUE 16 lint: ``Tracer._emit``'s FIRST statement must be the
+    flight-ring record — a crash right after a segment completes must
+    still show the span post-mortem (the watchtower/xray emit-first
+    contract), and every span flows through ``_emit`` (``segment`` and
+    ``mark`` are the only constructors and both call it)."""
+    tree = ast.parse(_TRACE.read_text())
+    cls = next(n for n in tree.body if isinstance(n, ast.ClassDef)
+               and n.name == "Tracer")
+    methods = {n.name: n for n in cls.body
+               if isinstance(n, ast.FunctionDef)}
+    emit = methods["_emit"]
+    first = emit.body[0]
+    if isinstance(first, ast.Expr) and isinstance(
+            first.value, ast.Constant):  # docstring
+        first = emit.body[1]
+    is_flight_record = (
+        isinstance(first, ast.Expr)
+        and isinstance(first.value, ast.Call)
+        and isinstance(first.value.func, ast.Attribute)
+        and first.value.func.attr == "record"
+        and isinstance(first.value.func.value, ast.Name)
+        and first.value.func.value.id == "flight"
+        and isinstance(first.value.args[0], ast.Constant)
+        and first.value.args[0].value == "trace")
+    assert is_flight_record, (
+        "Tracer._emit must call flight.record('trace', ...) FIRST")
+    for name in ("segment", "mark"):
+        calls = {node.func.attr for node in ast.walk(methods[name])
+                 if isinstance(node, ast.Call)
+                 and isinstance(node.func, ast.Attribute)}
+        assert "_emit" in calls, \
+            f"Tracer.{name} must fan out through _emit"
+
+
+def test_trace_context_pinned_at_choke_points():
+    """ISSUE 16 lint: context propagation happens at the named choke
+    points and nowhere else matters — (a) ``Scheduler._transition``
+    (the one state-change path) marks the transition, (b)
+    ``collectives.kv_transfer`` (the one streaming path) carries the
+    context on the wire, (c) ``DisaggFleet._stream_blocks`` passes it
+    into that wire call, (d) ``ProcessFleet._place`` injects the
+    ``"trace"`` key into the store dispatch record. Moving any of
+    these breaks cross-process continuity silently — so pin them."""
+
+    def func(tree, cls_name, fn_name):
+        for n in tree.body:
+            if cls_name is None and isinstance(n, ast.FunctionDef) \
+                    and n.name == fn_name:
+                return n
+            if isinstance(n, ast.ClassDef) and n.name == cls_name:
+                for m in n.body:
+                    if isinstance(m, ast.FunctionDef) \
+                            and m.name == fn_name:
+                        return m
+        raise AssertionError(f"{cls_name}.{fn_name} not found")
+
+    def calls(fn):
+        return {f"{node.func.value.id}.{node.func.attr}"
+                for node in ast.walk(fn)
+                if isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)}
+
+    sched = ast.parse((_SERVE / "scheduler.py").read_text())
+    assert "trace.on_transition" in calls(
+        func(sched, "Scheduler", "_transition")), \
+        "Scheduler._transition must mark the state change on the trace"
+
+    coll = ast.parse(
+        (_SERVE.parent / "ops" / "collectives.py").read_text())
+    assert "_trace.on_transfer" in calls(
+        func(coll, None, "kv_transfer")), \
+        "collectives.kv_transfer must carry the trace context"
+
+    disagg = ast.parse((_SERVE / "disagg.py").read_text())
+    stream = func(disagg, "DisaggFleet", "_stream_blocks")
+    xfer_kwargs = {
+        kw.arg for node in ast.walk(stream)
+        if isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "kv_transfer"
+        for kw in node.keywords}
+    assert "trace" in xfer_kwargs, \
+        "_stream_blocks must pass trace= into kv_transfer"
+
+    proc = ast.parse((_SERVE / "procfleet.py").read_text())
+    place = func(proc, "ProcessFleet", "_place")
+    injects = any(
+        isinstance(node, ast.Assign)
+        and any(isinstance(t, ast.Subscript)
+                and isinstance(t.slice, ast.Constant)
+                and t.slice.value == "trace"
+                for t in node.targets)
+        for node in ast.walk(place))
+    assert injects, ("ProcessFleet._place must inject the 'trace' key "
+                     "into the store dispatch record")
+
+
+def test_obs_trace_selftest_smoke():
+    """The Causeway acceptance drill (ISSUE 16 tentpole), run exactly
+    as CI would: one traced request through a disaggregated fleet with
+    a kill_transfer@ chaos kill mid-stream must yield ONE merged trace
+    whose queued/prefill/transfer/failover/decode segments sum to the
+    measured end-to-end latency within 1%, re-admitted leg linked to
+    the original trace, byte-identical canonical JSON across two
+    seeded runs."""
+    repo = Path(__file__).parent.parent
+    proc = subprocess.run(
+        [sys.executable, str(repo / "scripts" / "obs_trace.py"),
+         "--selftest"],
+        capture_output=True, text=True, timeout=300, cwd=repo,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr or proc.stdout
+    assert "trace selftest ok" in proc.stdout

@@ -152,3 +152,72 @@ def test_store_flaky_chaos_passthrough(store):
         store.add("n", 1)
     chaos.reset()
     assert store.get("pre", timeout_ms=1000) == b"x"  # healed
+
+
+# ---------------------------------------------------------------------------
+# Causeway trace-context wire parity (ISSUE 16 satellite): the trace
+# context must round-trip byte-identically through BOTH store backends
+# exactly as the process fleet ships it — inside the req/<idx>/<k>
+# dispatch record and back in the prog/ / done/ worker echoes.
+# ---------------------------------------------------------------------------
+
+
+def test_trace_context_round_trips_through_dispatch_record(store):
+    import json
+
+    from pytorch_distributed_nn_tpu.obs.trace import TraceContext
+
+    ctx = TraceContext(trace_id="a" * 16, span_id="b" * 16,
+                       parent_id="", leg=0)
+    rec = {"request_id": "preq-0-1", "prompt": [1, 2, 3],
+           "max_new_tokens": 4, "life": 0,
+           "trace": ctx.to_wire()}
+    wire = json.dumps(rec, sort_keys=True).encode()
+    store.set("req/0/0", wire)
+    got = store.get("req/0/0", timeout_ms=1000)
+    assert got == wire  # byte-identical through the backend
+    back = json.loads(got.decode())
+    rt = TraceContext.from_wire(back["trace"])
+    assert rt == ctx
+
+    # the worker-echo path: prog/ and done/ payloads carry the same
+    # wire form back, and a child (failover) leg survives the trip too
+    child = ctx.child()
+    done = {"life": 1, "status": "done", "tokens": [7, 8],
+            "trace": child.to_wire()}
+    store.set("done/preq-0-1",
+              json.dumps(done, sort_keys=True).encode())
+    echoed = json.loads(
+        store.get("done/preq-0-1", timeout_ms=1000).decode())
+    rt2 = TraceContext.from_wire(echoed["trace"])
+    assert rt2 == child
+    assert rt2.parent_id == ctx.span_id and rt2.leg == 1
+
+
+def test_untraced_dispatch_record_has_no_trace_key(store):
+    """TPUNN_TRACE unset must leave the wire bytes EXACTLY as they
+    were before tracing existed — the key is absent, not null."""
+    import json
+
+    rec = {"request_id": "preq-0-2", "prompt": [1],
+           "max_new_tokens": 2, "life": 0}
+    wire = json.dumps(rec, sort_keys=True).encode()
+    store.set("req/0/1", wire)
+    back = json.loads(store.get("req/0/1", timeout_ms=1000).decode())
+    assert "trace" not in back
+
+
+def test_trace_spans_publish_and_collect_through_store(store):
+    """The span transport (obs/aggregate.py): per-rank publishes join
+    into one flat list, absent ranks skipped, identical through both
+    backends."""
+    from pytorch_distributed_nn_tpu.obs import aggregate
+
+    s0 = [{"trace": "t1", "span": "s0", "parent": "", "leg": 0,
+           "segment": "prefill", "host": "h0", "t0": 1.0, "t1": 2.0}]
+    s1 = [{"trace": "t1", "span": "s1", "parent": "s0", "leg": 1,
+           "segment": "decode", "host": "h1", "t0": 2.0, "t1": 3.0}]
+    aggregate.publish_spans(store, rank=0, spans=s0)
+    aggregate.publish_spans(store, rank=1, spans=s1)
+    got = aggregate.collect_spans(store, ranks=range(3))
+    assert got == s0 + s1  # rank 2 never published — skipped
