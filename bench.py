@@ -152,6 +152,12 @@ _METRIC_NAMES = {
     # series — the unified-fleet baseline rides in vs_baseline, and
     # mixing pool topologies into one band would mask either
     "disagg": "disagg fleet serving tokens/sec (llama3_8b_zero)",
+    # Abacus showback (obs/meter.py): dollars per 1k generated tokens
+    # at the nominal tariff, from the armed meter's analytic ledger —
+    # "cost" in the name makes the ledger gate an INCREASE
+    # (obs.xray.metric_direction); vs_baseline carries the
+    # armed-vs-unset throughput ratio, the hook-overhead A/B
+    "serve_cost": "serve cost-per-1k-tokens (tiny)",
     # higher-is-better on purpose: no latency/seconds substring, so the
     # ledger (obs.xray.metric_direction) gates a DROP in capacity
     "capacity": "capacity sustainable req/s (llama3_8b_zero)",
@@ -894,6 +900,63 @@ def bench_serve(args) -> int:
                    f"cache ON vs OFF"
                    + (" [tiny dims]" if args.serve_tiny else ""),
         )
+
+    # -- Abacus cost series + armed-vs-unset overhead A/B --------------
+    # (docs/observability.md "Abacus"): the SAME closed-loop ragged
+    # workload twice — meter unset, then armed — so vs_baseline is the
+    # metering hook overhead, and the armed pass's ledger delta prices
+    # the series. When TPUNN_METER was already set for the whole bench
+    # the unset leg is impossible; the series still lands, un-ratioed.
+    from pytorch_distributed_nn_tpu.obs import meter
+
+    def closed_pass() -> tuple[float, int]:
+        eng = ServingEngine(model, params, max_slots=slots,
+                            max_seq_len=max_seq, max_queue=n_req,
+                            prefix_cache=False)
+        # derive the analytic cost model outside the timed window: it
+        # is a one-time per-engine lowering, not per-request overhead,
+        # and the A/B below is about the steady-state hook cost
+        eng.flops_per_token()
+        t0 = time.perf_counter()
+        for p, n in zip(prompts, budgets):
+            eng.submit(p, n, tenant="bench")
+        eng.run_until_idle()
+        dt = time.perf_counter() - t0
+        return (sum(c["new_tokens"] for c in eng.completed) / dt,
+                len(eng.completed))
+
+    price_per_pflop = 2.0  # nominal tariff; the FLOPs are the unit
+    was_armed = meter.enabled()
+    tps_unset = 0.0
+    if not was_armed:
+        tps_unset, _ = closed_pass()
+        meter.maybe_init("1")
+    before = meter.ledger_totals(meter.export_ledgers())
+    tps_armed, _ = closed_pass()
+    after = meter.ledger_totals(meter.export_ledgers())
+    billed_flops = after["flops"] - before["flops"]
+    billed_toks = after["tokens"] - before["tokens"]
+    if not was_armed:
+        meter.reset()  # leave the process as unarmed as it arrived
+    cost_rec = dict(
+        metric=_METRIC_NAMES["serve_cost"],
+        value=round(billed_flops / 1e15 * price_per_pflop
+                    * 1000.0 / max(billed_toks, 1), 8),
+        unit="$/1k tokens", backend=backend,
+        billed_flops=int(billed_flops),
+        billed_tokens=int(billed_toks),
+        price_per_pflop=price_per_pflop,
+        metered_tokens_per_s=round(tps_armed, 1),
+        detail=f"{n_req} ragged requests, {slots} slots, analytic "
+               f"ledger delta at ${price_per_pflop:g}/PFLOP"
+               + (" [tiny dims]" if args.serve_tiny else ""),
+    )
+    if not was_armed:
+        cost_rec.update(
+            vs_baseline=round(tps_armed / tps_unset, 3),
+            vs_baseline_kind="metered_over_unmetered_tokens_per_s",
+            unmetered_tokens_per_s=round(tps_unset, 1))
+    MetricsLogger(stream=sink).emit_benchmark(**cost_rec)
     return 0
 
 

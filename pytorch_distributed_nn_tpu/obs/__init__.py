@@ -41,6 +41,12 @@ heartbeats) with:
   (queued/prefill/transfer/failover/restore/decode/stitch) that
   provably sums to end-to-end latency, plus the fleet rollup per SLO
   bucket (``scripts/obs_trace.py`` renders both);
+- :mod:`obs.meter` — Abacus per-tenant resource metering (ISSUE 17):
+  analytic FLOPs, refcount-weighted KV block-seconds, wire bytes, and
+  lifecycle wall time attributed to (tenant, request) pairs at the
+  engine/scheduler/pool/collective choke points; ledgers publish at
+  ``meter/<rank>`` for fleet rollup and feed ``scripts/obs_cost.py``'s
+  showback report; inert unless ``TPUNN_METER`` is set;
 - :mod:`obs.xray` — anomaly-triggered device profiling (ISSUE 10):
   bounded, rate-limited ``jax.profiler`` captures (page/interval/
   on-demand triggers), per-op MFU/roofline attribution, compile
@@ -57,6 +63,7 @@ heartbeats) with:
 
 from pytorch_distributed_nn_tpu.obs import critpath  # noqa: F401
 from pytorch_distributed_nn_tpu.obs import flight  # noqa: F401
+from pytorch_distributed_nn_tpu.obs import meter  # noqa: F401
 from pytorch_distributed_nn_tpu.obs import stats  # noqa: F401
 from pytorch_distributed_nn_tpu.obs import trace  # noqa: F401
 from pytorch_distributed_nn_tpu.obs import watchtower  # noqa: F401

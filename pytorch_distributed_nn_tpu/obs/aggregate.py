@@ -110,6 +110,42 @@ def collect_spans(client, ranks, *, timeout_ms: int = 1000) -> list[dict]:
     return out
 
 
+_METER_KEY_FMT = "meter/{rank}"
+
+
+def publish_ledgers(client, *, rank: int,
+                    ledgers: dict[str, dict]) -> str:
+    """Abacus ledger transport (obs/meter.py): write this process's
+    per-tenant ledgers under ``meter/<rank>`` — same store, same
+    last-writer-wins snapshot semantics as the metric snapshots.
+    Canonical sort_keys JSON (the byte-determinism contract)."""
+    key = _METER_KEY_FMT.format(rank=rank)
+    client.set(key, json.dumps(ledgers, sort_keys=True).encode())
+    return key
+
+
+def collect_ledgers(client, ranks, *,
+                    timeout_ms: int = 1000) -> dict[str, dict]:
+    """Coordinator pull: every published per-rank ledger, merged into
+    one per-tenant view by exact integer summation (absent ranks are
+    skipped — an unarmed worker that never published is not an
+    error)."""
+    from pytorch_distributed_nn_tpu.obs import meter
+
+    parts: list[dict] = []
+    for rank in ranks:
+        key = _METER_KEY_FMT.format(rank=rank)
+        try:
+            if not client.check(key):
+                continue
+            parts.append(json.loads(
+                client.get(key, timeout_ms=timeout_ms).decode()))
+        except (OSError, TimeoutError, ValueError) as e:
+            log.warning("meter ledger pull for rank %d failed: %s",
+                        rank, e)
+    return meter.merge_ledgers(parts)
+
+
 def merge_snapshots(snapshots: dict[int, dict]) -> dict:
     """{"summed": {metric: Σ across hosts}, "per_rank": {metric:
     {rank: value}}} — counters read from "summed", gauges from

@@ -672,14 +672,22 @@ def plan_capacity(spec: traffic.TrafficSpec, *,
                   make_run_rung: Callable[[int], Callable[..., dict]],
                   slos: Sequence[SloClass] = DEFAULT_SLOS,
                   seed: int = 0, target_rps: Optional[float] = None,
-                  chaos_spec: Optional[str] = None) -> dict:
+                  chaos_spec: Optional[str] = None,
+                  price_per_replica_hour: float = 0.0) -> dict:
     """The full capacity-planning sweep: replica counts x offered-load
     rungs x SLO classes → the frontier surface and the headline table
     "replicas needed per SLO per traffic shape" (min replica count
     whose frontier covers ``target_rps``, default the spec's base
     rate). Pure in (spec, seed, service model): generating the report
     twice yields identical JSON — the determinism contract tier-1
-    asserts."""
+    asserts.
+
+    ``price_per_replica_hour`` > 0 prices every rung (the Abacus
+    showback bridge, obs/meter.py): ``cost_per_1k_tokens = replicas x
+    price / 3600 x 1000 / goodput_tps`` — the planner's answer to
+    "which replica count serves this shape CHEAPEST per token while
+    holding the SLO", not just "which is smallest". Keys are absent at
+    the default 0.0 so unpriced reports stay byte-identical."""
     target = float(target_rps if target_rps is not None
                    else spec.base_rps)
     gauges = _skyline_gauges()
@@ -689,6 +697,12 @@ def plan_capacity(spec: traffic.TrafficSpec, *,
         rungs = sweep_rates(spec, rates=rates,
                             run_rung=make_run_rung(n), slos=slos,
                             seed=seed)
+        if price_per_replica_hour > 0:
+            for rung in rungs:
+                tps = rung["goodput_tps"]
+                rung["cost_per_1k_tokens"] = (
+                    round(n * price_per_replica_hour / 3600.0
+                          * 1000.0 / tps, 6) if tps > 0 else None)
         front = frontier_of(rungs, slos)
         sweeps[str(n)] = {"rungs": rungs, "frontier": front,
                           "knee_rps": knee_of(rungs)}
@@ -710,7 +724,7 @@ def plan_capacity(spec: traffic.TrafficSpec, *,
                   >= target]
         needed[s.name] = {"target_rps": round(target, 4),
                           "replicas": min(counts) if counts else None}
-    return {
+    report = {
         "shape": shape,
         "spec": spec.describe(),
         "seed": seed,
@@ -720,6 +734,10 @@ def plan_capacity(spec: traffic.TrafficSpec, *,
         "sweeps": sweeps,
         "replicas_needed": needed,
     }
+    if price_per_replica_hour > 0:
+        report["price_per_replica_hour"] = round(
+            float(price_per_replica_hour), 6)
+    return report
 
 
 def simulated_run_rung(replicas: int, *, slots: int = 4,

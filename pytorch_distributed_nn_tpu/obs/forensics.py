@@ -104,6 +104,14 @@ class RankDump:
         return [e for e in self.events if e.get("kind") == "fleet"]
 
     @property
+    def meter_events(self) -> list[dict]:
+        """Abacus charges (obs/meter.py) in this rank's ring — every
+        billed amount rides the ring (emit-first choke point), so a
+        post-mortem sees who was being billed for what right up to the
+        crash."""
+        return [e for e in self.events if e.get("kind") == "meter"]
+
+    @property
     def trace_events(self) -> list[dict]:
         """Causeway spans (obs/trace.py) in the ring before the dump —
         emit-first puts every completed segment here, so a post-mortem
@@ -278,6 +286,20 @@ def attribute(events: list[dict]) -> dict:
     if caps:
         note = str(caps[-1].get("note", ""))
         out["xray_capture"] = note.rsplit(" -> ", 1)[-1] if note else ""
+    # Abacus billing (obs/meter.py): name the top-billing tenant from
+    # the ring's FLOP charges — a cost_anomaly page lands here with the
+    # tenant that was spending the machine when it fired. Same
+    # conditional-key contract: unmetered rings stay byte-identical.
+    flops_by_tenant: dict[str, int] = {}
+    for e in events:
+        if e.get("kind") == "meter" and e.get("op") == "flops":
+            tenant = str(e.get("note", "")).rsplit(":", 1)[0]
+            flops_by_tenant[tenant] = (flops_by_tenant.get(tenant, 0)
+                                       + int(e.get("nbytes", 0)))
+    if flops_by_tenant:
+        top = max(sorted(flops_by_tenant), key=flops_by_tenant.get)
+        out["top_billing_tenant"] = top
+        out["top_billing_flops"] = flops_by_tenant[top]
     return out
 
 
@@ -338,6 +360,33 @@ def fleet_summary(dumps: dict[int, RankDump]) -> dict | None:
                                   "downs": coord_downs,
                                   "max_gap_s": max_gap_s}
     return summary
+
+
+def meter_summary(dumps: dict[int, RankDump]) -> dict | None:
+    """Abacus charges (obs/meter.py) across the dumps: per-kind billed
+    totals (the ring is the ledger's emit-first shadow) and the top-
+    billing tenant by FLOPs. None when no dump holds meter events
+    (TPUNN_METER unset stays meter-silent — the doctor's JSON is
+    byte-identical to pre-Abacus output)."""
+    events = [e for d in dumps.values() for e in d.meter_events]
+    if not events:
+        return None
+    by_kind: dict[str, int] = {}
+    flops_by_tenant: dict[str, int] = {}
+    for e in events:
+        op = str(e.get("op", ""))
+        amt = int(e.get("nbytes", 0))
+        by_kind[op] = by_kind.get(op, 0) + amt
+        if op == "flops":
+            tenant = str(e.get("note", "")).rsplit(":", 1)[0]
+            flops_by_tenant[tenant] = (flops_by_tenant.get(tenant, 0)
+                                       + amt)
+    out = {"charges": len(events), "by_kind": by_kind}
+    if flops_by_tenant:
+        top = max(sorted(flops_by_tenant), key=flops_by_tenant.get)
+        out["top_billing_tenant"] = top
+        out["top_billing_flops"] = flops_by_tenant[top]
+    return out
 
 
 def trace_summary(dumps: dict[int, RankDump]) -> dict | None:
@@ -638,6 +687,17 @@ def render_report(dumps: dict[int, RankDump],
                 f"{coord['ups']} up, max supervision gap "
                 f"{coord['max_gap_s']:.3f}s — replicas kept decoding "
                 f"through the gap; the successor adopted them")
+
+    ms = meter_summary(dumps)
+    if ms is not None:
+        out("")
+        out("abacus billing (obs/meter.py — charges in the ring):")
+        kinds = ", ".join(f"{k}={v}" for k, v in
+                          sorted(ms["by_kind"].items()))
+        out(f"  {ms['charges']} charge(s): {kinds}")
+        if "top_billing_tenant" in ms:
+            out(f"  top-billing tenant: {ms['top_billing_tenant']} "
+                f"({ms['top_billing_flops']} FLOPs)")
 
     hung = {r: d.incomplete() for r, d in dumps.items()
             if d.incomplete()}

@@ -1,0 +1,611 @@
+"""Abacus: per-tenant resource metering and cost attribution.
+
+Causeway (obs/trace.py) says *where time went*; Skyline (obs/capacity)
+says *how many replicas a load needs*; nothing below this module says
+*who consumed the machine*. Mosaic tenants share prefix blocks, LoRA
+banks, and DRR admission with zero accounting of the FLOPs, KV
+residency, or wire bytes each tenant actually burned — and production
+TPU serving is ultimately judged in cost-per-token. This module is the
+ledger: every unit of consumption is attributed to a (tenant, request)
+pair at choke points the repo already owns, and nowhere else.
+
+What gets billed, and where the hook sits:
+
+- **FLOPs** — analytic counts (:func:`utils.flops.fwd_flops` at batch
+  1, seq 1, cached per engine) at the :class:`serve.engine
+  .ServingEngine` round boundaries: prefill bills ``suffix_tokens x
+  flops_per_token`` per admission, each decode round bills one token
+  per active slot, split per-slot by tenant. Cached-prefix tokens the
+  engine did NOT recompute are credited as *savings* (``saved_flops``
+  / ``saved_tokens``) from the PrefixCache hit the admission carried.
+- **KV block-seconds** — settled on every :class:`serve.kv_pool
+  .KVPool` mutation (reserve/free/adopt/evict): the elapsed interval
+  is charged to every resident block, refcount-weighted — a block
+  shared by 5 tenants bills 1/5 to each (integer microseconds,
+  largest-remainder split, so the per-tenant charges sum EXACTLY to
+  the wall-clock block-seconds — the conservation property
+  tests/test_meter.py drills). Cached-ring blocks bill fully to the
+  tenant that donated them (streamed-in blocks to ``"-"``).
+- **Wire bytes** — the :func:`ops.collectives._record` fan-out
+  (collective payloads, unattributed ``"-"``) and ``kv_transfer``
+  (billed to the riding tenant the disagg fleet threads through).
+- **Queue / decode wall-seconds + tokens** — from the lifecycle
+  timestamps the engine already computes per finished request.
+
+Ledger values are INTEGERS (flops, microseconds, bytes, tokens):
+per-tenant ledgers sum to the global totals exactly, with no float
+associativity caveats — the ``scripts/obs_cost.py --selftest``
+acceptance gate.
+
+Arming: ``TPUNN_METER=`` (chaos-style spec grammar):
+
+    TPUNN_METER=1                 # defaults
+    TPUNN_METER=max_tenants=64    # ledger bound (overflow bills "-")
+
+Design contract (the chaos/watchtower/trace lint rules, enforced by
+tests/test_quality.py):
+
+- **Inert when unset.** Every ``on_*`` hook opens with the literal
+  ``if _meter is None: return`` — an unset ``TPUNN_METER`` costs one
+  global load + one comparison per hook and performs ZERO registry or
+  flight-ring writes (instruments are registered at arm time).
+- **Emit-first.** Every billing lands in the flight ring before the
+  ledger or the registry sees it (:meth:`Meter._account`'s first
+  statement).
+- **One choke point.** ALL billing flows through ``Meter._account``
+  (the ``_transition``/``_score``/``_account`` pattern): no ledger
+  field or meter counter moves anywhere else.
+
+Cross-process: ledgers publish at ``meter/<rank>`` over the native
+store (:func:`obs.aggregate.publish_ledgers`) so ProcessFleet and the
+disagg fleet roll up fleet-wide; a disagg request bills its prefill
+leg and its decode leg to the same tenant across the handoff (the
+fleet threads ``tenant=`` through both legs).
+
+Stdlib-only (no jax, no numpy): ``fleet_worker.py`` imports this
+before deciding whether to touch a backend.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import threading
+import time
+
+from pytorch_distributed_nn_tpu.obs import flight
+from pytorch_distributed_nn_tpu.obs.registry import get_registry
+
+log = logging.getLogger(__name__)
+
+ENV_METER = "TPUNN_METER"
+
+# every ledger field, all integers: flops (analytic), saved_flops /
+# saved_tokens (prefix-cache credit), kv_block_us (refcount-weighted
+# residency, microseconds), wire_bytes, queue_us / decode_us
+# (lifecycle wall time), tokens, requests
+LEDGER_FIELDS = ("flops", "saved_flops", "tokens", "saved_tokens",
+                 "kv_block_us", "wire_bytes", "queue_us", "decode_us",
+                 "requests")
+
+# the unattributed bucket: training collectives, streamed-in cache
+# warmth, and ledger overflow past max_tenants all bill here — the
+# machine's overhead line, never silently dropped
+UNATTRIBUTED = "-"
+
+
+@dataclasses.dataclass
+class MeterConfig:
+    """``TPUNN_METER`` spec knobs (chaos-grammar ``key=value:...``)."""
+
+    max_tenants: int = 256  # ledger bound; overflow bills UNATTRIBUTED
+
+
+_FIELD_TYPES = {f.name: f.type for f in dataclasses.fields(MeterConfig)}
+
+
+def parse_spec(spec: str) -> MeterConfig:
+    """``TPUNN_METER`` spec → :class:`MeterConfig`. ``"1"`` / ``"on"``
+    mean defaults; otherwise ``:``-separated ``key=value`` overrides.
+    Unknown keys raise (a typo'd meter spec must fail loudly, not
+    silently bill nothing — the chaos-spec contract)."""
+    cfg = MeterConfig()
+    spec = (spec or "").strip()
+    if spec in ("", "1", "on", "true"):
+        return cfg
+    for field in filter(None, spec.split(":")):
+        key, eq, value = field.partition("=")
+        key = key.strip()
+        if not eq or key not in _FIELD_TYPES:
+            raise ValueError(
+                f"unknown meter key {key!r} in {spec!r}; have "
+                f"{sorted(_FIELD_TYPES)}")
+        try:
+            kind = _FIELD_TYPES[key]
+            setattr(cfg, key,
+                    value if kind in (str, "str")
+                    else int(value) if kind in (int, "int")
+                    else float(value))
+        except ValueError:
+            raise ValueError(
+                f"bad value for meter key {key!r}: {value!r}") from None
+    if cfg.max_tenants < 1:
+        raise ValueError(
+            f"max_tenants must be >= 1, got {cfg.max_tenants}")
+    return cfg
+
+
+def merge_ledgers(parts) -> dict[str, dict[str, int]]:
+    """Sum per-tenant integer ledgers across processes/ranks — the
+    fleet rollup (and the exactness contract: integer addition is
+    associative, so any merge order yields identical totals)."""
+    out: dict[str, dict[str, int]] = {}
+    for ledgers in parts:
+        for tenant, led in ledgers.items():
+            dst = out.setdefault(str(tenant),
+                                 dict.fromkeys(LEDGER_FIELDS, 0))
+            for k in LEDGER_FIELDS:
+                dst[k] += int(led.get(k, 0))
+    return {t: out[t] for t in sorted(out)}
+
+
+def ledger_totals(ledgers: dict[str, dict[str, int]]) -> dict[str, int]:
+    """Global totals = the exact sum of the per-tenant rows."""
+    totals = dict.fromkeys(LEDGER_FIELDS, 0)
+    for led in ledgers.values():
+        for k in LEDGER_FIELDS:
+            totals[k] += int(led.get(k, 0))
+    return totals
+
+
+class Meter:
+    """Per-process billing engine. One instance per armed process
+    (module singleton); an in-process fleet's engines all bill the same
+    meter, and the store transport joins worker processes' ledgers."""
+
+    def __init__(self, config: MeterConfig, *, rank: int = 0,
+                 metrics=None) -> None:
+        self.cfg = config
+        self.rank = int(rank)
+        self.metrics = metrics  # MetricsLogger | None
+        self._lock = threading.Lock()
+        # tenant -> {field: int} — the product of this module
+        self.ledgers: dict[str, dict[str, int]] = {}
+        # KV residency model (settle-on-event):
+        #   block -> live sharer seq_ids (refcount-weighted split)
+        self._block_seqs: dict[int, set[str]] = {}
+        #   seq -> its reserved block table (reserve-time snapshot)
+        self._seq_blocks: dict[str, tuple[int, ...]] = {}
+        #   seq -> tenant (bound at the scheduler's QUEUED transition)
+        self._seq_tenant: dict[str, str] = {}
+        #   cached-ring block -> donating tenant
+        self._cached_owner: dict[int, str] = {}
+        # injectable clock (tests drive it): seconds, monotonic
+        self._clock = time.monotonic
+        self._last_us = self._now_us()
+        # independent conservation witness: sum over settles of
+        # dt x resident_blocks — the per-tenant kv_block_us charges
+        # must sum to this EXACTLY (tests/test_meter.py)
+        self._kv_wall_us = 0
+        self._accounts = 0   # _account call count (publish dedup)
+        self._published = 0
+        # registered HERE, not at import: TPUNN_METER unset must mean
+        # zero registry writes (tested)
+        reg = get_registry()
+        self._c_flops = reg.counter(
+            "meter_flops_total", "analytic FLOPs billed per tenant",
+            labels=("tenant",))
+        self._c_kvsec = reg.counter(
+            "meter_kv_block_seconds",
+            "refcount-weighted KV block residency per tenant",
+            labels=("tenant",))
+        self._c_wire = reg.counter(
+            "meter_wire_bytes_total",
+            "collective/kv_transfer wire bytes billed per tenant",
+            labels=("tenant",))
+
+    # -- clock -------------------------------------------------------------
+
+    def _now_us(self) -> int:
+        return int(self._clock() * 1e6)
+
+    # -- the single billing choke point ------------------------------------
+
+    def _account(self, kind: str, tenant: str, amount: int) -> None:
+        """EVERY billing funnels through here (lint-enforced): flight
+        ring first (a crash right after a charge must still show it
+        post-mortem), then the ledger, then the registry counters.
+        Caller holds the lock."""
+        flight.record("meter", kind, nbytes=int(amount),
+                      note=f"{tenant}:{amount}")
+        amount = int(amount)
+        if tenant not in self.ledgers \
+                and len(self.ledgers) >= self.cfg.max_tenants:
+            tenant = UNATTRIBUTED
+        led = self.ledgers.get(tenant)
+        if led is None:
+            led = self.ledgers[tenant] = dict.fromkeys(LEDGER_FIELDS, 0)
+        led[kind] += amount
+        self._accounts += 1
+        if kind == "flops":
+            self._c_flops.inc(amount, tenant=tenant)
+        elif kind == "kv_block_us":
+            self._c_kvsec.inc(amount / 1e6, tenant=tenant)
+        elif kind == "wire_bytes":
+            self._c_wire.inc(amount, tenant=tenant)
+
+    # -- KV residency (settle-on-event) ------------------------------------
+
+    def _settle(self) -> None:
+        """Charge the interval since the last pool event to every
+        resident block: live blocks split across their sharers'
+        tenants by largest-remainder integer division (a block shared
+        k ways bills dt/k each, remainders to the first sharers in
+        sorted order — the charges sum to dt EXACTLY); cached blocks
+        bill fully to their donating owner. Caller holds the lock."""
+        now = self._now_us()
+        dt = now - self._last_us
+        self._last_us = now
+        if dt <= 0 or not (self._block_seqs or self._cached_owner):
+            return
+        charges: dict[str, int] = {}
+        for seqs in self._block_seqs.values():
+            per, rem = divmod(dt, len(seqs))
+            for i, seq in enumerate(sorted(seqs)):
+                c = per + (1 if i < rem else 0)
+                if c:
+                    t = self._seq_tenant.get(seq, UNATTRIBUTED)
+                    charges[t] = charges.get(t, 0) + c
+            self._kv_wall_us += dt
+        for owner in self._cached_owner.values():
+            charges[owner] = charges.get(owner, 0) + dt
+            self._kv_wall_us += dt
+        for tenant in sorted(charges):
+            self._account("kv_block_us", tenant, charges[tenant])
+
+    # -- billing entry points (engine/scheduler/pool/wire hooks call
+    #    these through the module-level inert wrappers) ---------------------
+
+    def request_state(self, request_id: str, tenant: str,
+                      state: str) -> None:
+        """Scheduler ``_transition`` feed: QUEUED binds the tenant the
+        later pool reservations bill to; a terminal state on a request
+        that never reserved drops the binding (bounded memory)."""
+        with self._lock:
+            if state == "queued":
+                self._seq_tenant[request_id] = str(tenant)
+            elif state in ("done", "rejected", "failed") \
+                    and request_id not in self._seq_blocks:
+                self._seq_tenant.pop(request_id, None)
+
+    def prefill(self, request_id: str, tenant: str, *, new_tokens: int,
+                cached_tokens: int, flops_per_token: int) -> None:
+        with self._lock:
+            if flops_per_token > 0 and new_tokens > 0:
+                self._account("flops", tenant,
+                              new_tokens * flops_per_token)
+            if cached_tokens > 0:
+                self._account("saved_tokens", tenant, cached_tokens)
+                if flops_per_token > 0:
+                    self._account("saved_flops", tenant,
+                                  cached_tokens * flops_per_token)
+
+    def decode_round(self, slot_tenants, flops_per_token: int) -> None:
+        """One decode round: every active slot produced one token —
+        bill each tenant its slot count x flops_per_token."""
+        if flops_per_token <= 0:
+            return
+        counts: dict[str, int] = {}
+        for tenant in slot_tenants:
+            counts[tenant] = counts.get(tenant, 0) + 1
+        with self._lock:
+            for tenant in sorted(counts):
+                self._account("flops", tenant,
+                              counts[tenant] * flops_per_token)
+
+    def request_done(self, rec: dict, flops_per_token: int) -> None:
+        """A finished request's lifecycle charges (from the engine's
+        serve_request record) + the cost-anomaly feed."""
+        tenant = str(rec.get("tenant", "default"))
+        new = int(rec.get("new_tokens", 0))
+        wf = rec.get("waterfall", {})
+        queue_us = int(round(float(wf.get("queued_s", 0.0)) * 1e6))
+        decode_us = int(round(float(wf.get("decode_s", 0.0)) * 1e6))
+        with self._lock:
+            self._account("requests", tenant, 1)
+            if new:
+                self._account("tokens", tenant, new)
+            if queue_us:
+                self._account("queue_us", tenant, queue_us)
+            if decode_us:
+                self._account("decode_us", tenant, decode_us)
+        if self.metrics is not None:
+            self.metrics.emit(
+                "meter_request", tenant=tenant,
+                request_id=str(rec.get("request_id", "")),
+                tokens=new, flops=self._request_flops(rec,
+                                                      flops_per_token))
+        if flops_per_token > 0 and new > 0:
+            # per-request billed-FLOPs-per-token: the cost-anomaly
+            # detector's signal (unpriced proxy — a tenant whose cache
+            # hit-rate collapses pages before the bill does). Lazy
+            # import: watchtower never imports meter, so no cycle.
+            from pytorch_distributed_nn_tpu.obs import watchtower
+
+            watchtower.on_tenant_cost(
+                tenant,
+                self._request_flops(rec, flops_per_token) / new,
+                request_id=str(rec.get("request_id", "")))
+
+    @staticmethod
+    def _request_flops(rec: dict, flops_per_token: int) -> int:
+        """The analytic per-request total the round-boundary billing
+        sums to: (prompt suffix actually prefilled) + (decode rounds =
+        new_tokens - 1, the first token being prefill's)."""
+        prefilled = (int(rec.get("prompt_len", 0))
+                     - int(rec.get("cached_tokens", 0)))
+        decoded = max(int(rec.get("new_tokens", 0)) - 1, 0)
+        return max(prefilled + decoded, 0) * int(flops_per_token)
+
+    def kv_reserve(self, seq_id: str, blocks) -> None:
+        with self._lock:
+            self._settle()
+            for b in blocks:
+                # a cached block promoted to live leaves the donor's
+                # meter and starts splitting across its sharers
+                self._cached_owner.pop(b, None)
+                self._block_seqs.setdefault(int(b), set()).add(seq_id)
+            self._seq_blocks[seq_id] = tuple(int(b) for b in blocks)
+
+    def kv_free(self, seq_id: str, cached=()) -> None:
+        """``cached`` names the blocks the pool parked in the LRU ring
+        (the donation): they keep billing, to the donating tenant."""
+        with self._lock:
+            self._settle()
+            owner = self._seq_tenant.pop(seq_id, UNATTRIBUTED)
+            parked = {int(b) for b in cached}
+            for b in self._seq_blocks.pop(seq_id, ()):
+                seqs = self._block_seqs.get(b)
+                if seqs is None:
+                    continue
+                seqs.discard(seq_id)
+                if not seqs:
+                    del self._block_seqs[b]
+                    if b in parked:
+                        self._cached_owner[b] = owner
+            # a parked block shared with a still-live sequence stays in
+            # _block_seqs above; any parked block we never tracked
+            # (bare-pool edge) still bills, unattributed
+            for b in parked:
+                if b not in self._block_seqs \
+                        and b not in self._cached_owner:
+                    self._cached_owner[b] = owner
+
+    def kv_adopt(self, block: int) -> None:
+        """A streamed-in peer block parked in the cached ring: real
+        residency with no local donor — bills unattributed."""
+        with self._lock:
+            self._settle()
+            self._cached_owner[int(block)] = UNATTRIBUTED
+
+    def kv_evict(self, block: int) -> None:
+        with self._lock:
+            self._settle()
+            self._cached_owner.pop(int(block), None)
+
+    def wire(self, nbytes: int, tenant: str = UNATTRIBUTED) -> None:
+        if nbytes <= 0:
+            return
+        with self._lock:
+            self._account("wire_bytes", tenant or UNATTRIBUTED,
+                          int(nbytes))
+
+    # -- export ------------------------------------------------------------
+
+    def export_ledgers(self) -> dict[str, dict[str, int]]:
+        """Settle outstanding KV residency, then a sorted deep copy —
+        the canonical (JSON-stable) per-tenant view."""
+        with self._lock:
+            self._settle()
+            return {t: dict(self.ledgers[t])
+                    for t in sorted(self.ledgers)}
+
+    def summary(self) -> dict:
+        ledgers = self.export_ledgers()
+        return {"tenants": ledgers,
+                "totals": ledger_totals(ledgers),
+                "kv_wall_us": self._kv_wall_us,
+                "rank": self.rank}
+
+    def emit_ledgers(self) -> None:
+        """One ``meter_ledger`` JSONL record per tenant (last-wins in
+        the stream): the feed ``scripts/obs_cost.py`` and the Abacus
+        report section read back from a runs dir."""
+        if self.metrics is None:
+            return
+        for tenant, led in self.export_ledgers().items():
+            self.metrics.emit("meter_ledger", tenant=tenant, **led)
+
+
+# ---------------------------------------------------------------------------
+# Module singleton + the inert hooks (chaos-style lint contract)
+# ---------------------------------------------------------------------------
+
+_meter: Meter | None = None
+
+
+def maybe_init(spec: str | None = None, *, rank: int | None = None,
+               metrics=None,
+               config: MeterConfig | None = None) -> Meter | None:
+    """Arm the process meter from ``TPUNN_METER`` (or an explicit
+    ``spec``/``config``). No-op beyond one env read when unset or
+    ``"0"``; idempotent when armed."""
+    global _meter
+    if _meter is not None:
+        return _meter
+    spec = os.environ.get(ENV_METER) if spec is None else spec
+    if not spec or spec == "0":
+        return None
+    _meter = Meter(
+        config if config is not None else parse_spec(spec),
+        rank=flight.default_rank() if rank is None else rank,
+        metrics=metrics,
+    )
+    log.warning("meter armed: %s (rank %d)", spec, _meter.rank)
+    return _meter
+
+
+def enabled() -> bool:
+    return _meter is not None
+
+
+def meter() -> Meter | None:
+    return _meter
+
+
+def reset() -> None:
+    """Disarm (test isolation)."""
+    global _meter
+    _meter = None
+
+
+def attach_metrics(metrics) -> None:
+    """Late-bind the JSONL sink (engines/fleets construct after
+    arming). Not a hot-path hook, but still inert-guarded."""
+    if _meter is None:
+        return
+    if metrics is not None:
+        _meter.metrics = metrics
+
+
+def export_ledgers() -> dict[str, dict[str, int]]:
+    """This process's per-tenant ledgers; {} when unarmed."""
+    if _meter is None:
+        return {}
+    return _meter.export_ledgers()
+
+
+def summary() -> dict | None:
+    """Ledgers + exact totals + the KV conservation witness; None when
+    unarmed (consumers key their sections off the None)."""
+    if _meter is None:
+        return None
+    return _meter.summary()
+
+
+# -- billing hooks (every one: inert fast path, lint-enforced) --------------
+
+
+def on_request_state(request_id: str, tenant: str, state: str) -> None:
+    """Scheduler ``_transition`` feed (lint-pinned to that one choke
+    point): binds seq -> tenant before any pool reservation bills."""
+    if _meter is None:
+        return
+    _meter.request_state(request_id, tenant, state)
+
+
+def on_prefill(request_id: str, tenant: str, *, new_tokens: int,
+               cached_tokens: int, flops_per_token: int) -> None:
+    """Engine admission: bill the prefilled suffix, credit the cached
+    prefix the PrefixCache hit skipped."""
+    if _meter is None:
+        return
+    _meter.prefill(request_id, tenant, new_tokens=new_tokens,
+                   cached_tokens=cached_tokens,
+                   flops_per_token=flops_per_token)
+
+
+def on_decode_round(slot_tenants, flops_per_token: int) -> None:
+    """Engine round boundary (called from ``step()``, never from the
+    ``_decode_round`` hot loop — its lint bans extras): one token per
+    active slot, split by tenant."""
+    if _meter is None:
+        return
+    _meter.decode_round(slot_tenants, flops_per_token)
+
+
+def on_request_done(rec: dict, flops_per_token: int = 0) -> None:
+    """Engine ``_finish_record`` feed: lifecycle wall time, tokens,
+    the per-request JSONL record, and the cost-anomaly signal."""
+    if _meter is None:
+        return
+    _meter.request_done(rec, flops_per_token)
+
+
+def on_kv_reserve(seq_id: str, blocks) -> None:
+    """KVPool ``reserve`` succeeded: ``blocks`` is the sequence's full
+    table (shared prefix blocks + fresh)."""
+    if _meter is None:
+        return
+    _meter.kv_reserve(seq_id, blocks)
+
+
+def on_kv_free(seq_id: str, cached=()) -> None:
+    """KVPool ``free``: the sequence's residency ends; ``cached``
+    blocks were donated to the LRU ring and keep billing the donor."""
+    if _meter is None:
+        return
+    _meter.kv_free(seq_id, cached)
+
+
+def on_kv_adopt(block: int) -> None:
+    """KVPool ``adopt_cached``: a streamed-in block starts billing."""
+    if _meter is None:
+        return
+    _meter.kv_adopt(block)
+
+
+def on_kv_evict(block: int) -> None:
+    """KVPool ``release_cached``: a cached block's residency ends."""
+    if _meter is None:
+        return
+    _meter.kv_evict(block)
+
+
+def on_collective(op: str, nbytes: int) -> None:
+    """``ops.collectives._record`` fan-out: collective payload bytes,
+    billed to the unattributed bucket (no request rides a psum)."""
+    if _meter is None:
+        return
+    _meter.wire(nbytes)
+
+
+def on_transfer(nbytes: int, tenant: str = "") -> None:
+    """``ops.collectives.kv_transfer`` wire point: streamed KV bytes,
+    billed to the tenant the disagg fleet threads through (or the
+    unattributed bucket for untagged streams)."""
+    if _meter is None:
+        return
+    _meter.wire(nbytes, tenant or UNATTRIBUTED)
+
+
+def on_serve_summary() -> None:
+    """Engine/fleet ``summary()`` boundary: flush per-tenant
+    ``meter_ledger`` JSONL records so a finished run's stream carries
+    the final ledgers (the obs_cost/report feed)."""
+    if _meter is None:
+        return
+    _meter.emit_ledgers()
+
+
+def maybe_publish(client, *, rank: int) -> bool:
+    """Publish this process's ledgers through the store (the
+    :func:`obs.aggregate.publish_ledgers` transport). Inert no-op when
+    unarmed or nothing billed since the last publish; never raises
+    into the serve loop."""
+    if _meter is None:
+        return False
+    n = _meter._accounts
+    if n == 0 or n == _meter._published:
+        return False
+    from pytorch_distributed_nn_tpu.obs import aggregate
+
+    try:
+        aggregate.publish_ledgers(client, rank=rank,
+                                  ledgers=_meter.export_ledgers())
+        _meter._published = n
+        return True
+    except (OSError, TimeoutError) as e:
+        log.warning("meter ledger publish failed: %s", e)
+        return False

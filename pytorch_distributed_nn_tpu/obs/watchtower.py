@@ -36,7 +36,13 @@ loop) and to the metric registry, and raises structured
 - ``recompile_storm`` — compile-telemetry feed (obs/xray.py): the same
   jitted function re-compiling ``recompile_min`` times inside
   ``recompile_window_s`` mid-run (shape churn, cache-key drift) warns
-  with the re-traced function named and the seconds lost.
+  with the re-traced function named and the seconds lost;
+- ``cost_anomaly`` — Abacus feed (obs/meter.py): a tenant whose billed
+  FLOPs-per-token jumps ``cost_band_k``x above its own EWMA — a
+  runaway decode budget or a prefix-cache-miss regression showing up
+  as money before it shows up as latency. Warns with the tenant and
+  the triggering request named; ``meter_cost_anomalies_total{tenant}``
+  counts fires.
 
 Page-severity alerts also start one bounded :mod:`obs.xray` profiler
 capture when ``TPUNN_XRAY`` is armed — the alert's attribution then
@@ -98,7 +104,7 @@ PAGE = "page"
 ALERT_KINDS = ("step_time_outlier", "loss_spike", "loss_nonfinite",
                "straggler_drift", "queue_pressure", "kv_pressure",
                "slo_burn_rate", "goodput_drop", "replica_down",
-               "recompile_storm")
+               "recompile_storm", "cost_anomaly")
 
 
 @dataclasses.dataclass
@@ -136,6 +142,10 @@ class WatchConfig:
     # recompile storm (compile-telemetry feed from obs/xray.py)
     recompile_min: int = 3         # same-function compiles to alert
     recompile_window_s: float = 120.0  # trailing window per function
+    # cost anomaly (Abacus feed from obs/meter.py: billed FLOPs/token)
+    cost_warmup: int = 8           # requests per tenant before judging
+    cost_ewma_alpha: float = 0.2
+    cost_band_k: float = 4.0       # threshold as a multiple of the EWMA
 
 
 _FIELD_TYPES = {f.name: f.type for f in dataclasses.fields(WatchConfig)}
@@ -258,6 +268,15 @@ class Watchtower:
         self._drifting: set[int] = set()
         # function name -> trailing (t, seconds) compile events
         self._compile_hist: dict[str, collections.deque] = {}
+        # tenant -> billed-FLOPs-per-token EWMA (Abacus cost band);
+        # the fires counter lives HERE, not in obs/meter — the meter
+        # stays a pure ledger, the tower owns anomaly judgment
+        self._cost_ewma: dict[str, Ewma] = {}
+        self._cost_high: set[str] = set()
+        self._c_cost_anomalies = reg.counter(
+            "meter_cost_anomalies_total",
+            "per-tenant cost-per-token anomalies (Abacus band breaks)",
+            labels=("tenant",))
         # recent finished requests, worst-TTFT-first attribution feed
         self._recent_reqs: collections.deque[dict] = collections.deque(
             maxlen=32)
@@ -560,6 +579,40 @@ class Watchtower:
             attribution={"function": name, "count": n,
                          "compile_seconds": round(total_s, 4)})
 
+    def _obs_tenant_cost(self, ev: dict) -> None:
+        """Abacus feed: one finished request's billed FLOPs-per-token
+        vs the tenant's own EWMA. A tenant is its own baseline — a
+        genuinely expensive tenant settles into a high center and stays
+        quiet; the alert is for a *change* (decode-budget runaway,
+        prefix-cache-miss regression). Hysteresis per tenant: re-arms
+        only after the cost falls back to the center."""
+        cfg, t = self.cfg, float(ev["t"])
+        tenant = str(ev.get("tenant", "default"))
+        cost = float(ev["cost_per_token"])
+        ew = self._cost_ewma.setdefault(tenant, Ewma(cfg.cost_ewma_alpha))
+        center = ew.value
+        if (center is not None and center > 0
+                and ew.count >= cfg.cost_warmup):
+            thr = cfg.cost_band_k * center
+            if cost > thr and tenant not in self._cost_high:
+                self._cost_high.add(tenant)
+                self._c_cost_anomalies.inc(tenant=tenant)
+                self._raise(
+                    "cost_anomaly", WARN, t, value=cost, threshold=thr,
+                    detail=f"tenant {tenant!r} billed {cost:.0f} "
+                           f"FLOPs/token vs its EWMA {center:.0f} "
+                           f"(>{cfg.cost_band_k:g}x band) — runaway "
+                           f"budget or cache-miss regression",
+                    attribution={
+                        "tenant": tenant,
+                        "request_id": str(ev.get("request_id", "")),
+                        "cost_per_token": round(cost, 4),
+                        "ewma_cost_per_token": round(center, 4)})
+            elif cost <= center:
+                self._cost_high.discard(tenant)  # re-arm on recovery
+        # update AFTER the check: an anomaly must not mask itself
+        ew.update(cost)
+
     _HANDLERS = {
         "train_step": _obs_train_step,
         "loss": _obs_loss,
@@ -571,6 +624,7 @@ class Watchtower:
         "rank_progress": _obs_rank_progress,
         "replica_down": _obs_replica_down,
         "compile": _obs_compile,
+        "tenant_cost": _obs_tenant_cost,
     }
 
     # -- burn-rate core --------------------------------------------------
@@ -713,6 +767,16 @@ def events_from_jsonl(rec: dict) -> list[dict]:
                     "replica": int(rec.get("replica", -1)),
                     "reason": rec.get("reason", ""),
                     "stranded": rec.get("stranded", [])})
+    elif ev == "meter_request":
+        # Abacus replay: a recorded run's per-request billing drives
+        # the cost band exactly as the live on_tenant_cost hook did
+        toks = int(rec.get("tokens", 0))
+        if toks > 0:
+            out.append({"ev": "tenant_cost", "t": t,
+                        "tenant": rec.get("tenant", "default"),
+                        "cost_per_token": float(rec.get("flops", 0))
+                        / toks,
+                        "request_id": rec.get("request_id", "")})
     return out
 
 
@@ -858,3 +922,17 @@ def on_compile(name: str, seconds: float) -> None:
         return
     _tower.observe({"ev": "compile", "t": time.time(),
                     "name": str(name), "seconds": float(seconds)})
+
+
+def on_tenant_cost(tenant: str, cost_per_token: float,
+                   request_id: str = "") -> None:
+    """Abacus hook (obs/meter.py request accounting): one finished
+    request's billed FLOPs-per-token feeds the per-tenant cost band.
+    Both layers armed independently — metering without watching (pure
+    showback) and watching without metering (no cost feed) are valid."""
+    if _tower is None:
+        return
+    _tower.observe({"ev": "tenant_cost", "t": time.time(),
+                    "tenant": str(tenant),
+                    "cost_per_token": float(cost_per_token),
+                    "request_id": str(request_id)})

@@ -804,7 +804,8 @@ class XrayEngine:
 
 # substrings that mark a lower-is-better metric; everything else
 # (throughput, MFU, bandwidth, accuracy) regresses downward
-_LOWER_IS_BETTER = ("nll", "latency", "ttft", "_ms", " ms", "seconds")
+_LOWER_IS_BETTER = ("nll", "latency", "ttft", "_ms", " ms", "seconds",
+                    "cost")
 
 
 def metric_direction(name: str) -> str:

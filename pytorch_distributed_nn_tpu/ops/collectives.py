@@ -30,6 +30,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from pytorch_distributed_nn_tpu.obs import flight as _flight
+from pytorch_distributed_nn_tpu.obs import meter as _meter
 from pytorch_distributed_nn_tpu.obs import trace as _trace
 from pytorch_distributed_nn_tpu.runtime import chaos as _chaos
 
@@ -144,13 +145,17 @@ def _record(op: str, x, axis: AxisName) -> None:
     # op/axis/bytes/shape in the flight recorder (obs/flight.py)
     _flight.on_collective(op, axis=str(axis), nbytes=payload,
                           shape=tuple(x.shape), dtype=str(x.dtype))
+    # Abacus wire metering (obs/meter.py, inert unless TPUNN_METER):
+    # ring-algorithm wire bytes, billed to the unattributed bucket —
+    # no request rides a training psum
+    _meter.on_collective(op, int(_WIRE[op](payload, n)))
     # chaos hook (runtime/chaos.py): an injected hang blocks HERE, the
     # same program point a real deadlocked collective wedges
     _chaos.on_collective(op)
 
 
 def kv_transfer(blocks, *, src: str, dst: str, src_index: int = -1,
-                dst_index: int = -1, trace=None):
+                dst_index: int = -1, trace=None, tenant: str = ""):
     """Host-side KV block-streaming choke point (disaggregated
     serving, :mod:`serve.disagg`): ship a pytree of paged KV blocks
     (leading axis = block id) from replica ``src`` to replica ``dst``
@@ -184,6 +189,10 @@ def kv_transfer(blocks, *, src: str, dst: str, src_index: int = -1,
     # mark BEFORE the chaos hook so a killed wire still shows the
     # transfer on the trace it was serving
     _trace.on_transfer(trace, src=src, dst=dst, nbytes=payload)
+    # Abacus wire metering: streamed KV bytes bill the tenant riding
+    # the transfer (the disagg fleet threads it through); BEFORE the
+    # chaos hook — a killed wire already burned its bytes
+    _meter.on_transfer(payload, tenant)
     # chaos hook (runtime/chaos.py): kill_transfer raises HERE, after
     # the bytes are on the books — a real mid-transfer death also
     # burned the wire before the receiver noticed

@@ -185,10 +185,12 @@ class DisaggFleet(Fleet):
         if ticket.stage == "decode":
             # best-effort: a failed/absent stream just means a cold
             # suffix prefill on h — never a correctness event
-            self._warm_peer(h, prompt, trace_ctx=ticket.trace)
+            self._warm_peer(h, prompt, trace_ctx=ticket.trace,
+                            tenant=ticket.tenant)
         req = h.engine.submit(
             prompt, leg_budget, deadline_s=ticket.deadline_s,
             request_id=ticket.request_id, resubmit=resubmit,
+            tenant=ticket.tenant,
             trace_ctx=ticket.trace, t_origin=ticket.t_submit,
             t_first_origin=ticket.t_first_token)
         ticket._attempt = (h.index, req)
@@ -254,7 +256,8 @@ class DisaggFleet(Fleet):
     # -- KV block streaming ------------------------------------------------
 
     def _warm_peer(self, dst: ReplicaHandle, prompt,
-                   adapter: int = 0, trace_ctx=None) -> int:
+                   adapter: int = 0, trace_ctx=None,
+                   tenant: str = "") -> int:
         """Pull the longest resident prefix chain for ``prompt`` from
         the peer that owns it into ``dst``'s cache, if any peer beats
         what ``dst`` already holds. Returns blocks ingested (0: nobody
@@ -275,11 +278,12 @@ class DisaggFleet(Fleet):
         if best is None:
             return 0
         return self._stream_blocks(best, dst, best_match, prompt,
-                                   adapter, trace_ctx=trace_ctx)
+                                   adapter, trace_ctx=trace_ctx,
+                                   tenant=tenant)
 
     def _stream_blocks(self, src: ReplicaHandle, dst: ReplicaHandle,
                        match, prompt, adapter: int = 0, *,
-                       trace_ctx=None) -> int:
+                       trace_ctx=None, tenant: str = "") -> int:
         """THE transfer path (lint-enforced, tests/test_quality.py):
         pin the chain on the source, export its block rows, ship them
         through :func:`ops.collectives.kv_transfer` (wire bytes →
@@ -311,7 +315,7 @@ class DisaggFleet(Fleet):
             collectives.kv_transfer(
                 host, src=src.name, dst=dst.name,
                 src_index=src.index, dst_index=dst.index,
-                trace=trace_ctx)
+                trace=trace_ctx, tenant=tenant)
             bs = pool.block_size
             ingested = dst.engine.ingest_blocks(
                 prompt[:len(blocks) * bs], host, adapter)

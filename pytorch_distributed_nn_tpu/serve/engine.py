@@ -48,7 +48,13 @@ from pytorch_distributed_nn_tpu.inference.generate import (
     init_cache,
 )
 from pytorch_distributed_nn_tpu.nn.lora import num_adapters
-from pytorch_distributed_nn_tpu.obs import flight, trace, watchtower, xray
+from pytorch_distributed_nn_tpu.obs import (
+    flight,
+    meter,
+    trace,
+    watchtower,
+    xray,
+)
 from pytorch_distributed_nn_tpu.runtime import chaos
 from pytorch_distributed_nn_tpu.serve import autoscale
 from pytorch_distributed_nn_tpu.serve.kv_pool import KVPool
@@ -266,6 +272,12 @@ class ServingEngine:
         # Causeway: give an armed tracer the JSONL sink (no-op when
         # TPUNN_TRACE is unset — zero writes, lint contract)
         trace.attach_metrics(metrics)
+        # Abacus: same contract for an armed meter (TPUNN_METER)
+        meter.attach_metrics(metrics)
+        # analytic FLOPs per token (utils/flops.py XLA count at batch
+        # 1, seq 1): computed lazily on first metered billing, never
+        # when the meter is unarmed; 0 = no cost model reachable
+        self._flops_per_token: Optional[int] = None
         # per-request LoRA: stacked (n, L, ...) factor bank
         # (nn/lora.py); requests pick an adapter at submit and each
         # batch row applies its own deltas in the shared forward
@@ -404,6 +416,14 @@ class ServingEngine:
         # xray capture clock (serving-side): rounds advance an active
         # capture window / interval trigger, same placement rule
         xray.on_serve_round(sched.round)
+        # Abacus decode billing: one token per active slot this round,
+        # split by tenant — here, NOT in _decode_round (hot-loop lint).
+        # enabled() gate so the slot scan + FLOPs lookup never run on
+        # an unarmed process (the armed-vs-unset A/B contract)
+        if meter.enabled():
+            meter.on_decode_round(
+                [s.req.tenant for s in self._slots if s is not None],
+                self.flops_per_token())
         retired = self._collect(host_tok)
         if retired:
             self._sync_slots()
@@ -511,6 +531,12 @@ class ServingEngine:
         flight.record("serve", "admit", step=self.scheduler.round,
                       note=f"{req.request_id} slot={slot} L={L} "
                            f"cached={m}")
+        # Abacus prefill billing: the suffix actually computed, plus
+        # the cached-prefix FLOPs the restore SKIPPED as a credit
+        if meter.enabled():
+            meter.on_prefill(req.request_id, req.tenant,
+                             new_tokens=T, cached_tokens=m,
+                             flops_per_token=self.flops_per_token())
 
     def _decode_round(self):
         """THE hot loop body (see module docstring for the lint
@@ -678,6 +704,10 @@ class ServingEngine:
         if self.metrics is not None:
             self.metrics.emit("serve_request", **rec)
         watchtower.on_serve_request(rec)
+        # Abacus lifecycle charges (queue/decode wall time, tokens,
+        # the per-request JSONL record, the cost-anomaly feed)
+        if meter.enabled():
+            meter.on_request_done(rec, self.flops_per_token())
         # Causeway segments, retroactive from the scheduler's
         # lifecycle timestamps — the decode hot loop stays untouched
         # (its lint bans extras); resubmit legs ride the ctx the fleet
@@ -720,8 +750,33 @@ class ServingEngine:
         self._d_active = jnp.asarray(self._h_active)
         self._d_adapter = jnp.asarray(self._h_adapter)
 
+    def flops_per_token(self) -> int:
+        """Analytic forward FLOPs of ONE token through this model
+        (:func:`utils.flops.fwd_flops` at batch 1, seq 1) — the unit
+        every Abacus billing multiplies. Integer (exact per-tenant
+        sums), computed once per engine, 0 when no backend with a cost
+        model is reachable (billing then meters tokens/residency/wire
+        only). Only metered paths call this, so an unarmed process
+        never pays the lowering."""
+        if self._flops_per_token is None:
+            from pytorch_distributed_nn_tpu.utils.flops import (
+                CostModelUnavailable,
+                fwd_flops,
+            )
+
+            try:
+                self._flops_per_token = int(round(
+                    fwd_flops(self.model, (1, 1), jnp.int32)))
+            except (CostModelUnavailable, RuntimeError):
+                self._flops_per_token = 0
+        return self._flops_per_token
+
     def summary(self) -> dict:
         """Engine-lifetime aggregates (bench + serve_summary JSONL)."""
+        # flush per-tenant meter_ledger JSONL records (inert no-op
+        # unless TPUNN_METER armed): a finished run's stream carries
+        # the final ledgers for obs_cost/obs_report
+        meter.on_serve_summary()
         rounds = len(self.round_seconds)
         occ = self._occ_sum / max(rounds * self.max_slots, 1)
         out = dict(

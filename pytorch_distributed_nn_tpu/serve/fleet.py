@@ -59,7 +59,7 @@ from typing import Optional
 import numpy as np
 
 from pytorch_distributed_nn_tpu.launch import RestartPolicy
-from pytorch_distributed_nn_tpu.obs import flight, trace, watchtower
+from pytorch_distributed_nn_tpu.obs import flight, meter, trace, watchtower
 from pytorch_distributed_nn_tpu.obs.registry import get_registry
 from pytorch_distributed_nn_tpu.runtime import chaos, failure
 from pytorch_distributed_nn_tpu.serve.engine import ServingEngine
@@ -92,11 +92,16 @@ class FleetTicket:
 
     def __init__(self, request_id: str, prompt: np.ndarray,
                  max_new_tokens: int,
-                 deadline_s: Optional[float]) -> None:
+                 deadline_s: Optional[float],
+                 tenant: str = "default") -> None:
         self.request_id = request_id
         self.prompt = prompt
         self.max_new_tokens = int(max_new_tokens)
         self.deadline_s = deadline_s
+        # Abacus (obs/meter.py): the billing identity every leg of this
+        # logical request carries — a disagg prefill leg and its decode
+        # leg, or a failover re-admission, all bill the same tenant
+        self.tenant = str(tenant)
         self.t_submit = time.monotonic()
         self.t_first_token = 0.0
         self.t_done = 0.0
@@ -505,14 +510,15 @@ class Fleet:
 
     def submit(self, prompt, max_new_tokens: int, *,
                deadline_s: Optional[float] = None,
-               request_id: Optional[str] = None) -> FleetTicket:
+               request_id: Optional[str] = None,
+               tenant: str = "default") -> FleetTicket:
         """Admit once, place once (router-scored), journal for
         failover. Always returns a ticket; a rejected one is already
         terminal."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         ticket = FleetTicket(
             request_id or f"freq-{next(_ids)}", prompt,
-            max_new_tokens, deadline_s)
+            max_new_tokens, deadline_s, tenant=tenant)
         # Causeway mint point: the context outlives every per-replica
         # Request this ticket will spawn
         ticket.trace = trace.on_submit(ticket.request_id)
@@ -570,6 +576,7 @@ class Fleet:
         req = h.engine.submit(
             prompt, max_new, deadline_s=ticket.deadline_s,
             request_id=ticket.request_id, resubmit=resubmit,
+            tenant=ticket.tenant,
             trace_ctx=ticket.trace, t_origin=ticket.t_submit,
             t_first_origin=ticket.t_first_token)
         ticket._attempt = (h.index, req)
@@ -958,7 +965,7 @@ class Fleet:
                 budget_restarts=h.policy.budget_restarts,
                 preempt_restarts=h.policy.preempt_restarts,
                 stop_reason=h.stop_reason, **eng))
-        return dict(
+        out = dict(
             replicas=len(self._replicas),
             live=self.live_replicas,
             requests_done=len(self.completed),
@@ -968,3 +975,8 @@ class Fleet:
                                for r in self.completed)),
             per_replica=per_replica,
         )
+        if meter.enabled():
+            # Abacus rollup: all in-process engines share one module
+            # meter, so the singleton's ledgers already cover the fleet
+            out["meter"] = meter.summary()
+        return out

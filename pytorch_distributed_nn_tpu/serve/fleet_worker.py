@@ -48,7 +48,7 @@ import os
 import sys
 import time
 
-from pytorch_distributed_nn_tpu.obs import trace
+from pytorch_distributed_nn_tpu.obs import meter, trace
 from pytorch_distributed_nn_tpu.runtime import chaos, failure
 from pytorch_distributed_nn_tpu.runtime.platform import (
     apply_platform_overrides,
@@ -143,7 +143,8 @@ class _EngineBackend:
             self._np.asarray(rec["prompt"], self._np.int32),
             int(rec["max_new_tokens"]),
             request_id=rec["request_id"],
-            resubmit=bool(rec.get("life", 0)))
+            resubmit=bool(rec.get("life", 0)),
+            tenant=rec.get("tenant", "default"))
         self._reqs.append((rec, req))
 
     def step(self) -> tuple[list, list]:
@@ -278,6 +279,7 @@ def _serve_loop(args, ps, idx: int, reporter, backend) -> int:
             trace.on_worker_done(rec, toks, status, host=idx)
             _publish_done(ps, rec, toks, status)
         trace.maybe_publish(ps, rank=idx)
+        meter.maybe_publish(ps, rank=idx)
         _publish(ps, f"gauge/{idx}", dict(
             queue_depth=len(queue), max_queue=args.max_queue,
             pid=os.getpid(), round=rounds, draining=draining,
@@ -328,6 +330,9 @@ def main(argv=None) -> int:
     # arm tracing from TPUNN_TRACE (inherited via worker_env) — each
     # worker process owns its own span ring, published at trace/<idx>
     trace.maybe_init(rank=idx)
+    # arm metering from TPUNN_METER (inherited via worker_env) — each
+    # worker process bills its own engine, published at meter/<idx>
+    meter.maybe_init(rank=idx)
     reporter = failure.HeartbeatReporter(
         ps, rank=idx, incarnation=0,
         interval_s=args.hb_interval,

@@ -56,6 +56,7 @@ import threading
 from collections import OrderedDict
 from typing import Iterable
 
+from pytorch_distributed_nn_tpu.obs import meter
 from pytorch_distributed_nn_tpu.obs.registry import get_registry
 
 
@@ -155,11 +156,14 @@ class KVPool:
                     self._ref[b] = 1
                 else:
                     self._ref[b] = self._ref.get(b, 1) + 1
-            self._tables[seq_id] = shared + [
+            table = self._tables[seq_id] = shared + [
                 self._free.pop() for _ in range(n_fresh)]
             self._used_tokens[seq_id] = 0
             self._publish_locked()
-            return True
+        # Abacus residency start (outside the lock: the meter has its
+        # own; inert one-comparison no-op unless TPUNN_METER armed)
+        meter.on_kv_reserve(seq_id, table)
+        return True
 
     def extend(self, seq_id: str, tokens: int) -> None:
         """Advance a sequence's written-token high-water mark. Never
@@ -196,6 +200,7 @@ class KVPool:
             if not table:
                 return 0
             released = []
+            parked = []
             for b in table:
                 if b in self._ref:
                     self._ref[b] -= 1
@@ -205,11 +210,15 @@ class KVPool:
                 if b in retain:
                     self._cached[b] = None
                     self._cached.move_to_end(b)
+                    parked.append(b)
                 else:
                     released.append(b)
             self._free.extend(reversed(released))
             self._publish_locked()
-            return len(released)
+        # Abacus residency end: parked (donated) blocks keep billing
+        # the donating tenant from the cached ring
+        meter.on_kv_free(seq_id, cached=tuple(parked))
+        return len(released)
 
     # -- cached-LRU ring ---------------------------------------------------
 
@@ -262,7 +271,8 @@ class KVPool:
             self._cached[b] = None
             self._cached.move_to_end(b)
             self._publish_locked()
-            return b
+        meter.on_kv_adopt(b)
+        return b
 
     def release_cached(self, block: int) -> bool:
         """Evict one cached block to the free list. False — and no
@@ -275,7 +285,8 @@ class KVPool:
             del self._cached[block]
             self._free.append(block)
             self._publish_locked()
-            return True
+        meter.on_kv_evict(block)
+        return True
 
     # -- introspection -----------------------------------------------------
 

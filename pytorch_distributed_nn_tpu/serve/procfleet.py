@@ -62,7 +62,7 @@ from typing import Optional
 import numpy as np
 
 from pytorch_distributed_nn_tpu.launch import RestartPolicy, worker_env
-from pytorch_distributed_nn_tpu.obs import flight, trace, watchtower
+from pytorch_distributed_nn_tpu.obs import flight, meter, trace, watchtower
 from pytorch_distributed_nn_tpu.obs.registry import get_registry
 from pytorch_distributed_nn_tpu.runtime import chaos, failure
 from pytorch_distributed_nn_tpu.serve import autoscale as _autoscale
@@ -89,11 +89,12 @@ class ProcTicket:
     replica failover AND coordinator replacement: everything needed to
     rebuild it lives in the store journal."""
 
-    def __init__(self, request_id: str, prompt: list, max_new_tokens: int
-                 ) -> None:
+    def __init__(self, request_id: str, prompt: list, max_new_tokens: int,
+                 tenant: str = "default") -> None:
         self.request_id = request_id
         self.prompt = [int(t) for t in prompt]
         self.max_new_tokens = int(max_new_tokens)
+        self.tenant = str(tenant)  # Abacus billing identity (obs/meter)
         self.t_submit = time.monotonic()
         self.t_first_token = 0.0
         self.t_done = 0.0
@@ -483,7 +484,8 @@ class ProcessFleet:
             ev = rec.get("event")
             if ev == "submit":
                 t = ProcTicket(rec["request_id"], rec["prompt"],
-                               rec["max_new_tokens"])
+                               rec["max_new_tokens"],
+                               tenant=rec.get("tenant", "default"))
                 tickets[t.request_id] = t
             elif ev == "place":
                 t = tickets.get(rec["request_id"])
@@ -531,7 +533,8 @@ class ProcessFleet:
     # -- client surface --------------------------------------------------
 
     def submit(self, prompt, max_new_tokens: int, *,
-               request_id: Optional[str] = None) -> ProcTicket:
+               request_id: Optional[str] = None,
+               tenant: str = "default") -> ProcTicket:
         """Admit once fleet-wide; journaled BEFORE dispatch so no
         coordinator death can lose it. Unplaceable requests (no READY
         replica yet, store blip) stay pending and are re-placed by the
@@ -540,16 +543,21 @@ class ProcessFleet:
         ticket = ProcTicket(
             request_id
             or f"preq-{self.incarnation}-{next(_ids)}",
-            prompt, int(max_new_tokens))
+            prompt, int(max_new_tokens), tenant=tenant)
         ticket.trace = trace.on_submit(ticket.request_id)
         with self._lock:
             self._tickets[ticket.request_id] = ticket
             try:
-                self.journal.append({
+                journal_rec = {
                     "event": "submit",
                     "request_id": ticket.request_id,
                     "prompt": ticket.prompt,
-                    "max_new_tokens": ticket.max_new_tokens})
+                    "max_new_tokens": ticket.max_new_tokens}
+                # key ABSENT for the default tenant so single-tenant
+                # journals stay byte-identical to pre-Abacus ones
+                if ticket.tenant != "default":
+                    journal_rec["tenant"] = ticket.tenant
+                self.journal.append(journal_rec)
             except (OSError, TimeoutError):
                 failure.count_store_error("coord_journal")
             self._place(ticket)
@@ -574,6 +582,11 @@ class ProcessFleet:
         # unarmed so the wire bytes are unchanged byte-for-byte
         if ticket.trace is not None:
             rec["trace"] = ticket.trace.to_wire()
+        # Abacus: same key-absent discipline — default-tenant dispatch
+        # records carry no tenant key, so the wire is unchanged unless
+        # a caller actually names a tenant
+        if ticket.tenant != "default":
+            rec["tenant"] = ticket.tenant
         try:
             self.journal.append({
                 "event": "place", "request_id": ticket.request_id,
@@ -1047,7 +1060,7 @@ class ProcessFleet:
                 budget_restarts=h.policy.budget_restarts,
                 preempt_restarts=h.policy.preempt_restarts,
                 stop_reason=h.stop_reason))
-        return dict(
+        out = dict(
             coordinator_incarnation=self.incarnation,
             gap_s=round(self.gap_s, 3),
             replicas=len(self._replicas),
@@ -1060,3 +1073,17 @@ class ProcessFleet:
             recovery=dict(self.recovery),
             per_replica=per_replica,
         )
+        if meter.enabled():
+            # Abacus fleet rollup: worker processes publish their
+            # ledgers at meter/<rank> (fleet_worker serve loop); merge
+            # them with the coordinator's own (wire-byte) ledger
+            from pytorch_distributed_nn_tpu.obs import aggregate
+            ledgers = aggregate.collect_ledgers(
+                self._ns, [h.index for h in self._replicas])
+            local = meter.export_ledgers()
+            if local:
+                ledgers = meter.merge_ledgers([ledgers, local])
+            out["meter"] = dict(
+                ledgers=ledgers,
+                totals=meter.ledger_totals(ledgers))
+        return out

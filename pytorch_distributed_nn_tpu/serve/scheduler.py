@@ -71,7 +71,7 @@ from typing import Optional
 
 import numpy as np
 
-from pytorch_distributed_nn_tpu.obs import flight, trace, watchtower
+from pytorch_distributed_nn_tpu.obs import flight, meter, trace, watchtower
 from pytorch_distributed_nn_tpu.obs.registry import get_registry
 from pytorch_distributed_nn_tpu.runtime import chaos
 from pytorch_distributed_nn_tpu.serve.kv_pool import KVPool
@@ -204,6 +204,11 @@ class Scheduler:
         # this one choke point
         trace.on_transition(req.trace, state,
                             request_id=req.request_id)
+        # Abacus tenant binding (inert unless TPUNN_METER armed, same
+        # contract): QUEUED binds request_id -> tenant BEFORE the
+        # admission pass's pool reservation bills any block-seconds,
+        # lint-pinned to this one choke point like the trace mark above
+        meter.on_request_state(req.request_id, req.tenant, state)
         # fleet re-admission idempotency: a request re-submitted with
         # the same id after a replica death already counted its
         # queued/running transitions in its first life — one logical

@@ -92,6 +92,10 @@ def _analyze(paths_or_dir, expect_ranks: int | None, last: int,
             # handle scripts/obs_trace.py pulls waterfalls by; None
             # for runs with TPUNN_TRACE unset
             "traces": forensics.trace_summary(dumps),
+            # Abacus charges (obs/meter.py) in the rings — per-kind
+            # billed totals + the top-billing tenant by FLOPs; None
+            # for runs with TPUNN_METER unset
+            "meter": forensics.meter_summary(dumps),
             # profiler captures (obs/xray.py) that fired before the
             # dump — the landing dir per rank, so a post-mortem can go
             # straight from the incident to the device trace covering

@@ -384,6 +384,49 @@ def print_trace_table(events: list[dict], last: int) -> bool:
     return True
 
 
+def print_cost_table(events: list[dict], last: int) -> bool:
+    """Abacus section (obs/meter.py): the per-tenant resource bill —
+    FLOPs, KV block-seconds, wire bytes, queue/decode wall time —
+    from the ``meter_ledger`` records the meter flushes at every
+    summary boundary (cumulative, so last-per-tenant wins), plus the
+    costliest individual requests from the ``meter_request`` tail.
+    Silently skipped when the file has no ledger records (TPUNN_METER
+    unset). Pricing + the full showback: ``scripts/obs_cost.py``."""
+    from pytorch_distributed_nn_tpu.obs.meter import (
+        LEDGER_FIELDS, UNATTRIBUTED, ledger_totals)
+    ledgers: dict[str, dict[str, int]] = {}
+    for e in events:
+        if e.get("event") != "meter_ledger":
+            continue
+        ledgers[str(e.get("tenant", UNATTRIBUTED))] = {
+            k: int(e.get(k, 0)) for k in LEDGER_FIELDS}
+    if not ledgers:
+        return False
+    print("\n== tenant billing (Abacus) ==")
+    print(f"{'tenant':>12} {'reqs':>5} {'tokens':>7} {'GFLOPs':>10} "
+          f"{'kv_blk_s':>9} {'wire_MB':>8} {'decode_s':>9}")
+    rows = sorted(ledgers.items(),
+                  key=lambda kv: -kv[1]["flops"])
+    totals = ledger_totals(ledgers)
+    for tenant, led in rows + [("TOTAL", totals)]:
+        print(f"{tenant:>12} {led['requests']:>5} {led['tokens']:>7} "
+              f"{led['flops'] / 1e9:>10.3f} "
+              f"{led['kv_block_us'] / 1e6:>9.3f} "
+              f"{led['wire_bytes'] / 1e6:>8.3f} "
+              f"{led['decode_us'] / 1e6:>9.3f}")
+    if totals["saved_tokens"]:
+        print(f"prefix-cache savings: {totals['saved_tokens']} "
+              f"token(s) / {totals['saved_flops'] / 1e9:.3f} GFLOPs "
+              f"not recomputed")
+    reqs = [e for e in events if e.get("event") == "meter_request"]
+    for e in sorted(reqs, key=lambda e: -_num(e, "flops"))[:last]:
+        print(f"  {e.get('tenant', UNATTRIBUTED):>12} "
+              f"{str(e.get('request_id', '')):>8} "
+              f"{_num(e, 'flops') / 1e9:10.3f} GFLOPs "
+              f"{int(_num(e, 'tokens'))} token(s)")
+    return True
+
+
 def print_capacity_table(events: list[dict], last: int,
                          requested: bool = False) -> bool:
     """Skyline capacity-planning section (obs/capacity.py): the
@@ -582,7 +625,7 @@ def main(argv=None) -> int:
                     ("serve_request", "serve_summary", "fleet_state",
                      "fleet_replica_down", "fleet_failover",
                      "fleet_reload", "fleet_handoff", "kv_transfer",
-                     "trace_span", "capacity_rung",
+                     "trace_span", "meter_ledger", "capacity_rung",
                      "capacity_frontier", "capacity_plan",
                      "autoscale_decision")
                     for e in events)
@@ -591,14 +634,15 @@ def main(argv=None) -> int:
     serve_ok = print_serving_table(events, args.last)
     fleet_ok = print_fleet_table(events, args.last)
     trace_ok = print_trace_table(events, args.last)
+    cost_ok = print_cost_table(events, args.last)
     cap_ok = print_capacity_table(events, args.last,
                                   requested=args.capacity)
     helm_ok = print_autoscale_table(events, args.last,
                                     requested=args.autoscale)
     xray_ok = print_xray_table(args.xray or None, args.last)
     print_metric_tail(events, args.last)
-    return 0 if (ok or serve_ok or fleet_ok or trace_ok or cap_ok
-                 or helm_ok or xray_ok) else 1
+    return 0 if (ok or serve_ok or fleet_ok or trace_ok or cost_ok
+                 or cap_ok or helm_ok or xray_ok) else 1
 
 
 if __name__ == "__main__":

@@ -1,0 +1,306 @@
+"""Abacus metering engine (obs/meter.py, ISSUE 17): the spec grammar,
+the inert-when-unset contract (zero registry AND flight-ring writes),
+exact integer ledger algebra (merge/totals, max_tenants overflow), the
+KVPool conservation property — randomized reserve/free/adopt/evict
+traffic under a fake clock, with ``free + live + cached == num_blocks``
+after every op and the refcount-weighted per-tenant block-time charges
+summing EXACTLY to the settle clock's wall witness — and the store
+publish/dedup transport the fleet workers run."""
+
+import random
+
+import pytest
+
+from pytorch_distributed_nn_tpu import obs
+from pytorch_distributed_nn_tpu.obs import flight, meter
+from pytorch_distributed_nn_tpu.obs.meter import (
+    LEDGER_FIELDS,
+    UNATTRIBUTED,
+    MeterConfig,
+    ledger_totals,
+    merge_ledgers,
+    parse_spec,
+)
+from pytorch_distributed_nn_tpu.serve.kv_pool import KVPool
+
+
+@pytest.fixture(autouse=True)
+def _isolated():
+    meter.reset()
+    flight.reset_recorder(enabled=True)
+    obs.reset_registry()
+    yield
+    meter.reset()
+
+
+def _arm(**kw):
+    m = meter.maybe_init("1", rank=0, **kw)
+    assert m is not None
+    return m
+
+
+# ---------------------------------------------------------------------------
+# spec grammar (the chaos-spec contract: typos fail loudly)
+# ---------------------------------------------------------------------------
+
+
+def test_parse_spec_defaults():
+    for spec in ("1", "on", "true", ""):
+        assert parse_spec(spec) == MeterConfig()
+
+
+def test_parse_spec_overrides():
+    assert parse_spec("max_tenants=64").max_tenants == 64
+
+
+def test_parse_spec_rejects_unknown_key_and_bad_values():
+    with pytest.raises(ValueError, match="unknown meter key"):
+        parse_spec("max_tenant=64")  # typo'd knob must not bill nothing
+    with pytest.raises(ValueError, match="bad value"):
+        parse_spec("max_tenants=lots")
+    with pytest.raises(ValueError, match="max_tenants"):
+        parse_spec("max_tenants=0")
+
+
+def test_maybe_init_unset_and_idempotent(monkeypatch):
+    monkeypatch.delenv(meter.ENV_METER, raising=False)
+    assert meter.maybe_init() is None and not meter.enabled()
+    m = _arm()
+    assert meter.maybe_init("max_tenants=3") is m  # armed wins
+
+
+# ---------------------------------------------------------------------------
+# inert when unset: zero registry writes, zero ring writes
+# ---------------------------------------------------------------------------
+
+
+def test_unarmed_hooks_write_nothing():
+    """TPUNN_METER unset: every hook is a one-comparison no-op — the
+    registry gains no meter instruments, the flight ring gains no
+    events, and the exports are empty/None."""
+    assert not meter.enabled()
+    before = [i.name for i in obs.get_registry().instruments()]
+    meter.on_request_state("r0", "acme", "queued")
+    meter.on_prefill("r0", "acme", new_tokens=8, cached_tokens=4,
+                     flops_per_token=1000)
+    meter.on_decode_round(["acme", "globex"], 1000)
+    meter.on_request_done({"tenant": "acme", "new_tokens": 4}, 1000)
+    meter.on_kv_reserve("r0", (0, 1))
+    meter.on_kv_free("r0", cached=(1,))
+    meter.on_kv_adopt(2)
+    meter.on_kv_evict(2)
+    meter.on_collective("all_reduce", 4096)
+    meter.on_transfer(4096, "acme")
+    meter.on_serve_summary()
+    meter.attach_metrics(object())
+    assert [i.name for i in obs.get_registry().instruments()] == before
+    assert not any(i.name.startswith("meter_")
+                   for i in obs.get_registry().instruments())
+    assert flight.get_recorder().snapshot() == []
+    assert meter.export_ledgers() == {}
+    assert meter.summary() is None
+
+
+def test_armed_registers_instruments_and_emits_ring_first():
+    m = _arm()
+    names = {i.name for i in obs.get_registry().instruments()}
+    assert {"meter_flops_total", "meter_kv_block_seconds",
+            "meter_wire_bytes_total"} <= names
+    meter.on_transfer(4096, "acme")
+    evs = [e for e in flight.get_recorder().snapshot()
+           if e["kind"] == "meter"]
+    assert len(evs) == 1 and evs[0]["op"] == "wire_bytes"
+    assert evs[0]["nbytes"] == 4096
+    assert evs[0]["note"] == "acme:4096"
+    assert m.ledgers["acme"]["wire_bytes"] == 4096
+    assert m._c_wire.value(tenant="acme") == 4096
+
+
+# ---------------------------------------------------------------------------
+# ledger algebra: integer exactness, merge, overflow
+# ---------------------------------------------------------------------------
+
+
+def test_merge_ledgers_and_totals_exact():
+    a = {"acme": dict.fromkeys(LEDGER_FIELDS, 3)}
+    b = {"acme": dict.fromkeys(LEDGER_FIELDS, 4),
+         "zeta": dict.fromkeys(LEDGER_FIELDS, 1)}
+    merged = merge_ledgers([a, b])
+    assert list(merged) == ["acme", "zeta"]  # sorted
+    assert all(merged["acme"][k] == 7 for k in LEDGER_FIELDS)
+    totals = ledger_totals(merged)
+    for k in LEDGER_FIELDS:
+        assert totals[k] == sum(led[k] for led in merged.values())
+    # merge order never changes the totals (integer associativity)
+    assert ledger_totals(merge_ledgers([b, a])) == totals
+
+
+def test_max_tenants_overflow_bills_unattributed():
+    _arm(config=MeterConfig(max_tenants=2))
+    meter.on_transfer(10, "acme")
+    meter.on_transfer(10, "globex")
+    meter.on_transfer(10, "initech")  # past the bound: overflow bucket
+    led = meter.export_ledgers()
+    assert set(led) == {"acme", "globex", UNATTRIBUTED}
+    assert led[UNATTRIBUTED]["wire_bytes"] == 10
+    assert ledger_totals(led)["wire_bytes"] == 30  # never dropped
+
+
+def test_decode_round_splits_by_slot_tenant():
+    _arm()
+    meter.on_decode_round(["acme", "acme", "globex"], 100)
+    led = meter.export_ledgers()
+    assert led["acme"]["flops"] == 200
+    assert led["globex"]["flops"] == 100
+
+
+def test_prefill_bills_suffix_and_credits_cached_prefix():
+    _arm()
+    meter.on_prefill("r0", "acme", new_tokens=6, cached_tokens=10,
+                     flops_per_token=100)
+    led = meter.export_ledgers()["acme"]
+    assert led["flops"] == 600
+    assert led["saved_tokens"] == 10
+    assert led["saved_flops"] == 1000
+
+
+# ---------------------------------------------------------------------------
+# KV conservation property: randomized pool traffic, fake clock
+# ---------------------------------------------------------------------------
+
+
+def _pool_partition(pool: KVPool, live_tables: dict) -> None:
+    """The pool invariant after EVERY op: the free list, the live
+    reservations, and the cached ring partition the block space —
+    disjoint, and together exactly ``num_blocks``."""
+    free = set(pool._free)
+    cached = set(pool._cached)
+    live = {b for t in live_tables.values() for b in t}
+    assert free.isdisjoint(cached)
+    assert free.isdisjoint(live)
+    assert cached.isdisjoint(live)
+    assert len(free) + len(cached) + len(live) == pool.num_blocks
+    assert pool.free_blocks == len(free)
+    assert pool.cached_blocks == len(cached)
+
+
+def test_kv_conservation_under_randomized_traffic():
+    m = _arm()
+    t_us = [0]
+    m._clock = lambda: t_us[0] / 1e6
+    m._last_us = m._now_us()  # re-anchor onto the fake clock
+    pool = KVPool(num_blocks=16, block_size=4)
+    rng = random.Random(1234)
+    tenants = ("acme", "globex", "initech")
+    live: dict[str, tuple[int, ...]] = {}
+    seq_n = 0
+    for _ in range(400):
+        t_us[0] += rng.randrange(1, 5000)
+        op = rng.random()
+        if op < 0.40:  # reserve, sometimes riding cached prefix blocks
+            seq_id = f"s{seq_n}"
+            seq_n += 1
+            tenant = rng.choice(tenants)
+            meter.on_request_state(seq_id, tenant, "queued")
+            tokens = rng.randrange(1, 5 * pool.block_size)
+            shared = []
+            ring = pool.cached_lru()
+            k = pool.blocks_for(tokens)
+            if ring and rng.random() < 0.5:
+                shared = ring[:rng.randrange(1, min(len(ring), k) + 1)]
+            if pool.reserve(seq_id, tokens, shared=shared):
+                live[seq_id] = pool.block_table(seq_id)
+                pool.extend(seq_id, rng.randrange(tokens + 1))
+            else:
+                meter.on_request_state(seq_id, tenant, "failed")
+        elif op < 0.70 and live:  # free, sometimes donating the table
+            seq_id = rng.choice(sorted(live))
+            table = live.pop(seq_id)
+            retain = frozenset(
+                b for b in table if rng.random() < 0.4)
+            pool.free(seq_id, retain=retain)
+        elif op < 0.85:  # streamed-in warmth (disagg receive side)
+            pool.adopt_cached()
+        else:  # eviction scan
+            ring = pool.cached_lru()
+            if ring:
+                pool.release_cached(rng.choice(ring))
+        _pool_partition(pool, live)
+    for seq_id in sorted(live):  # drain: all residency ends billed
+        pool.free(seq_id)
+        live.pop(seq_id)
+    _pool_partition(pool, live)
+    t_us[0] += 777  # a tail interval with only cached blocks resident
+    ledgers = meter.export_ledgers()  # final settle
+    billed = sum(led["kv_block_us"] for led in ledgers.values())
+    assert m._kv_wall_us > 0
+    # the conservation property: refcount-weighted per-tenant charges
+    # sum EXACTLY to the independent dt x resident-blocks wall witness
+    assert billed == m._kv_wall_us
+    assert set(ledgers) <= set(tenants) | {UNATTRIBUTED}
+
+
+def test_kv_shared_block_splits_exactly_across_sharers():
+    """One block shared 3 ways for 100us bills ceil/floor shares that
+    sum to exactly 100us (largest-remainder split)."""
+    m = _arm()
+    t_us = [0]
+    m._clock = lambda: t_us[0] / 1e6
+    m._last_us = m._now_us()
+    for i, tenant in enumerate(("a", "b", "c")):
+        meter.on_request_state(f"s{i}", tenant, "queued")
+        meter.on_kv_reserve(f"s{i}", (7,))  # same block, 3 sharers
+    t_us[0] += 100
+    for i in range(3):
+        meter.on_kv_free(f"s{i}")
+        ledgers = meter.export_ledgers()
+    shares = sorted(led["kv_block_us"] for led in ledgers.values())
+    assert sum(shares) == 100 == m._kv_wall_us
+    assert shares == [33, 33, 34]
+
+
+# ---------------------------------------------------------------------------
+# store publish transport (the fleet worker's loop)
+# ---------------------------------------------------------------------------
+
+
+def test_maybe_publish_dedup_and_unarmed(tmp_path):
+    from pytorch_distributed_nn_tpu.serve.store import MemStore
+
+    store = MemStore()
+    assert meter.maybe_publish(store, rank=0) is False  # unarmed
+    assert not store.check("meter/0")
+    _arm()
+    assert meter.maybe_publish(store, rank=0) is False  # nothing billed
+    meter.on_transfer(64, "acme")
+    assert meter.maybe_publish(store, rank=0) is True
+    assert store.check("meter/0")
+    assert meter.maybe_publish(store, rank=0) is False  # deduped
+    meter.on_transfer(64, "acme")
+    assert meter.maybe_publish(store, rank=0) is True  # new billing
+
+
+def test_request_done_feeds_cost_anomaly_detector():
+    """The per-request billed-FLOPs-per-token signal reaches an armed
+    watchtower, and a band-breaking tenant raises cost_anomaly with
+    the tenant named in the attribution."""
+    from pytorch_distributed_nn_tpu.obs import watchtower
+
+    watchtower.reset()
+    tower = watchtower.maybe_init("1", rank=0)
+    assert tower is not None
+    _arm()
+    rec = {"tenant": "acme", "request_id": "r", "new_tokens": 4,
+           "prompt_len": 8, "cached_tokens": 4,
+           "waterfall": {"queued_s": 0.001, "decode_s": 0.002}}
+    for _ in range(tower.cfg.cost_warmup + 1):
+        meter.on_request_done(rec, 100)
+    hot = dict(rec, cached_tokens=0, prompt_len=800)  # cache collapse
+    meter.on_request_done(hot, 100)
+    alerts = [a for a in tower.alerts if a.kind == "cost_anomaly"]
+    assert len(alerts) == 1
+    assert alerts[0].attribution["tenant"] == "acme"
+    led = meter.export_ledgers()["acme"]
+    assert led["requests"] == tower.cfg.cost_warmup + 2
+    assert led["queue_us"] == 1000 * (tower.cfg.cost_warmup + 2)
+    watchtower.reset()

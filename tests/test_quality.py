@@ -1025,3 +1025,194 @@ def test_obs_trace_selftest_smoke():
     )
     assert proc.returncode == 0, proc.stderr or proc.stdout
     assert "trace selftest ok" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# Abacus metering (ISSUE 17): the inert/emit-first/choke-point lint
+# contract for obs/meter.py, plus the showback acceptance drill
+# ---------------------------------------------------------------------------
+
+_METER = (Path(__file__).parent.parent / "pytorch_distributed_nn_tpu"
+          / "obs" / "meter.py")
+
+
+def test_meter_hooks_are_provably_inert_when_unset():
+    """ISSUE 17 lint: every public ``on_*`` hook in obs/meter.py must
+    open with the literal ``if _meter is None: return`` fast path (the
+    chaos/watchtower/trace contract) — these hooks sit inside the
+    scheduler's transition path, the engine's round loop, the KVPool's
+    mutators, and the collective record fan-out, so an unset
+    ``TPUNN_METER`` must cost one global load + one comparison per
+    hook, nothing more."""
+    tree = ast.parse(_METER.read_text())
+    hooks = [n for n in tree.body if isinstance(n, ast.FunctionDef)
+             and n.name.startswith("on_")]
+    assert len(hooks) >= 11, (
+        "expected request_state/prefill/decode_round/request_done/"
+        "kv_reserve/kv_free/kv_adopt/kv_evict/collective/transfer/"
+        "serve_summary")
+    for fn in hooks:
+        first = fn.body[0]
+        if isinstance(first, ast.Expr) and isinstance(
+                first.value, ast.Constant):  # docstring
+            first = fn.body[1]
+        ok = (isinstance(first, ast.If)
+              and isinstance(first.test, ast.Compare)
+              and isinstance(first.test.left, ast.Name)
+              and first.test.left.id == "_meter"
+              and len(first.test.ops) == 1
+              and isinstance(first.test.ops[0], ast.Is)
+              and isinstance(first.test.comparators[0], ast.Constant)
+              and first.test.comparators[0].value is None
+              and len(first.body) == 1
+              and isinstance(first.body[0], ast.Return))
+        assert ok, (f"meter.{fn.name} must start with "
+                    f"'if _meter is None: return' (the disabled "
+                    f"fast path)")
+
+
+def test_meter_billing_is_counted_and_emits_ring_first():
+    """ISSUE 17 lint: (a) ``Meter._account``'s FIRST statement is the
+    flight-ring record — a crash right after a charge must still show
+    it post-mortem (the watchtower/trace emit-first contract); (b)
+    ALL billing flows through ``_account``: no other Meter method
+    subscript-assigns a ledger field or bumps a ``_c_*`` meter
+    counter (the ``_transition``/``_score`` choke-point pattern); (c)
+    the choke point feeds all three per-tenant counters."""
+    tree = ast.parse(_METER.read_text())
+    cls = next(n for n in tree.body if isinstance(n, ast.ClassDef)
+               and n.name == "Meter")
+    methods = {n.name: n for n in cls.body
+               if isinstance(n, ast.FunctionDef)}
+    account = methods["_account"]
+    first = account.body[0]
+    if isinstance(first, ast.Expr) and isinstance(
+            first.value, ast.Constant):  # docstring
+        first = account.body[1]
+    is_flight_record = (
+        isinstance(first, ast.Expr)
+        and isinstance(first.value, ast.Call)
+        and isinstance(first.value.func, ast.Attribute)
+        and first.value.func.attr == "record"
+        and isinstance(first.value.func.value, ast.Name)
+        and first.value.func.value.id == "flight"
+        and isinstance(first.value.args[0], ast.Constant)
+        and first.value.args[0].value == "meter")
+    assert is_flight_record, (
+        "Meter._account must call flight.record('meter', ...) FIRST")
+
+    def bills_outside_choke(fn) -> bool:
+        for node in ast.walk(fn):
+            # led[kind] += amount — a ledger write
+            if isinstance(node, ast.AugAssign) and isinstance(
+                    node.target, ast.Subscript):
+                return True
+            # self._c_flops.inc(...) — a meter counter bump
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Attribute)
+                    and node.func.attr == "inc"
+                    and isinstance(node.func.value, ast.Attribute)
+                    and node.func.value.attr.startswith("_c_")):
+                return True
+        return False
+
+    offenders = [f"Meter.{name}" for name, fn in methods.items()
+                 if name != "_account" and bills_outside_choke(fn)]
+    assert not offenders, (
+        f"billing outside the Meter._account choke point: {offenders}")
+    # every billing entry point actually funnels through it
+    for name in ("_settle", "prefill", "decode_round", "request_done",
+                 "wire"):
+        assert "_account" in _calls_in(methods[name]), (
+            f"Meter.{name} must bill through _account")
+    incremented = {
+        node.func.value.attr for node in ast.walk(account)
+        if isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "inc"
+        and isinstance(node.func.value, ast.Attribute)}
+    assert {"_c_flops", "_c_kvsec", "_c_wire"} <= incremented, (
+        f"_account must feed all meter counters, found "
+        f"{sorted(incremented)}")
+
+
+def test_meter_tenant_pinned_at_choke_points():
+    """ISSUE 17 lint: billing identity propagates at the named choke
+    points — (a) ``Scheduler._transition`` binds seq -> tenant, (b)
+    ``collectives.kv_transfer`` bills streamed bytes to the riding
+    tenant, (c) ``DisaggFleet._stream_blocks`` threads ``tenant=``
+    into that wire call (both legs bill the submitter), (d)
+    ``ProcessFleet._place`` injects the ``"tenant"`` key into the
+    store dispatch record. Moving any of these silently strands
+    consumption in the unattributed bucket — so pin them."""
+
+    def func(tree, cls_name, fn_name):
+        for n in tree.body:
+            if cls_name is None and isinstance(n, ast.FunctionDef) \
+                    and n.name == fn_name:
+                return n
+            if isinstance(n, ast.ClassDef) and n.name == cls_name:
+                for m in n.body:
+                    if isinstance(m, ast.FunctionDef) \
+                            and m.name == fn_name:
+                        return m
+        raise AssertionError(f"{cls_name}.{fn_name} not found")
+
+    def dotted(fn):
+        return {f"{node.func.value.id}.{node.func.attr}"
+                for node in ast.walk(fn)
+                if isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Name)}
+
+    sched = ast.parse((_SERVE / "scheduler.py").read_text())
+    assert "meter.on_request_state" in dotted(
+        func(sched, "Scheduler", "_transition")), \
+        "Scheduler._transition must bind the tenant on the meter"
+
+    coll = ast.parse(
+        (_SERVE.parent / "ops" / "collectives.py").read_text())
+    assert "_meter.on_transfer" in dotted(
+        func(coll, None, "kv_transfer")), \
+        "collectives.kv_transfer must bill the riding tenant"
+
+    disagg = ast.parse((_SERVE / "disagg.py").read_text())
+    stream = func(disagg, "DisaggFleet", "_stream_blocks")
+    xfer_kwargs = {
+        kw.arg for node in ast.walk(stream)
+        if isinstance(node, ast.Call)
+        and isinstance(node.func, ast.Attribute)
+        and node.func.attr == "kv_transfer"
+        for kw in node.keywords}
+    assert "tenant" in xfer_kwargs, \
+        "_stream_blocks must pass tenant= into kv_transfer"
+
+    proc = ast.parse((_SERVE / "procfleet.py").read_text())
+    place = func(proc, "ProcessFleet", "_place")
+    injects = any(
+        isinstance(node, ast.Assign)
+        and any(isinstance(t, ast.Subscript)
+                and isinstance(t.slice, ast.Constant)
+                and t.slice.value == "tenant"
+                for t in node.targets)
+        for node in ast.walk(place))
+    assert injects, ("ProcessFleet._place must inject the 'tenant' "
+                     "key into the store dispatch record")
+
+
+def test_obs_cost_selftest_smoke():
+    """The Abacus acceptance drill (ISSUE 17 tentpole), run exactly as
+    CI would: a 3-tenant mixed-prefix workload through a disaggregated
+    fleet with the meter armed — billed FLOPs reconcile with the
+    analytic per-request counts within 1%, per-tenant ledgers sum to
+    the global totals exactly, KV charges sum to the wall witness
+    exactly, report JSON byte-identical across two renders."""
+    repo = Path(__file__).parent.parent
+    proc = subprocess.run(
+        [sys.executable, str(repo / "scripts" / "obs_cost.py"),
+         "--selftest"],
+        capture_output=True, text=True, timeout=300, cwd=repo,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr or proc.stdout
+    assert "cost selftest ok" in proc.stdout

@@ -221,3 +221,51 @@ def test_trace_spans_publish_and_collect_through_store(store):
     aggregate.publish_spans(store, rank=1, spans=s1)
     got = aggregate.collect_spans(store, ranks=range(3))
     assert got == s0 + s1  # rank 2 never published — skipped
+
+
+# ---------------------------------------------------------------------------
+# Abacus cross-process meter continuity (ISSUE 17 satellite): worker
+# ledgers must survive the store trip byte-identically and merge into
+# one exact fleet-wide view through BOTH backends — and a worker whose
+# TPUNN_METER is unset must publish nothing at all.
+# ---------------------------------------------------------------------------
+
+
+def test_meter_ledgers_publish_and_collect_through_store(store):
+    import json
+
+    from pytorch_distributed_nn_tpu.obs import aggregate, meter
+
+    led0 = {"acme": dict.fromkeys(meter.LEDGER_FIELDS, 2),
+            "globex": dict.fromkeys(meter.LEDGER_FIELDS, 5)}
+    led1 = {"acme": dict.fromkeys(meter.LEDGER_FIELDS, 3)}
+    key = aggregate.publish_ledgers(store, rank=0, ledgers=led0)
+    aggregate.publish_ledgers(store, rank=1, ledgers=led1)
+    # the wire form is canonical sort_keys JSON, byte-identical
+    assert key == "meter/0"
+    assert store.get("meter/0", timeout_ms=1000) == \
+        json.dumps(led0, sort_keys=True).encode()
+    merged = aggregate.collect_ledgers(store, range(3))  # rank 2 absent
+    assert set(merged) == {"acme", "globex"}
+    assert all(merged["acme"][k] == 5 for k in meter.LEDGER_FIELDS)
+    assert all(merged["globex"][k] == 5 for k in meter.LEDGER_FIELDS)
+    # exactness through the trip: totals == sum of the published parts
+    totals = meter.ledger_totals(merged)
+    assert totals == meter.ledger_totals(meter.merge_ledgers(
+        [led0, led1]))
+
+
+def test_unarmed_worker_publishes_no_meter_key(store):
+    from pytorch_distributed_nn_tpu.obs import meter
+
+    meter.reset()  # TPUNN_METER unset for this worker
+    assert meter.maybe_publish(store, rank=7) is False
+    assert not store.check("meter/7")
+    # an armed worker that billed nothing stays silent too (dedup)
+    m = meter.maybe_init("1", rank=7)
+    assert m is not None
+    try:
+        assert meter.maybe_publish(store, rank=7) is False
+        assert not store.check("meter/7")
+    finally:
+        meter.reset()
