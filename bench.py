@@ -152,6 +152,11 @@ _METRIC_NAMES = {
     # series — the unified-fleet baseline rides in vs_baseline, and
     # mixing pool topologies into one band would mask either
     "disagg": "disagg fleet serving tokens/sec (llama3_8b_zero)",
+    # process-backed disaggregation (serve/procfleet.py pools with the
+    # KV handoff streamed through serve/kv_wire.py): its own series —
+    # store-wire round-trips + pump overlap are a different regime
+    # from both the thread-disagg and the unified process-fleet bands
+    "disagg_procs": "process-disagg serving tokens/sec (tiny)",
     # Abacus showback (obs/meter.py): dollars per 1k generated tokens
     # at the nominal tariff, from the armed meter's analytic ledger —
     # "cost" in the name makes the ledger gate an INCREASE
@@ -1101,6 +1106,110 @@ def _bench_fleet_procs(args) -> int:
     return 0
 
 
+def _bench_fleet_disagg_procs(args) -> int:
+    """--fleet --disagg-procs: the deployment-shaped disaggregation —
+    prefill and decode pools of real subprocesses (CI-scale tiny
+    engine each) over the real native store, every KV handoff
+    streamed cross-process through serve/kv_wire.py and placed by the
+    coordinator's transfer pump. ``vs_baseline`` is the split pools
+    over a unified process fleet of the same total size, plus p99
+    TTFT with and without a mid-push ``kill_transfer@`` drill (the
+    source dies INSIDE the push; the decode leg re-prefills cold).
+    Its own ledger series — the wire round-trips and the pump overlap
+    are exactly what this number must keep honest."""
+    import numpy as np
+
+    from pytorch_distributed_nn_tpu.serve import ragged_prompt_sampler
+    from pytorch_distributed_nn_tpu.serve.procfleet import ProcessFleet
+
+    slots = args.per_chip_batch or 4
+    n_pre = max(args.fleet_prefill, 1)
+    n_dec = max(args.fleet_decode, 1)
+    n_rep = n_pre + n_dec
+    n_req = max(args.serve_requests, slots * n_rep)
+    max_seq = 64
+    budget_cycle = (2, 8, 32)
+    budgets = [budget_cycle[i % len(budget_cycle)]
+               for i in range(n_req)]
+    sampler = ragged_prompt_sampler(
+        1024, min_len=4, max_len=max_seq - max(budget_cycle) - 1,
+        seed=0)
+    prompts = [sampler() for _ in range(n_req)]
+    period = 1.0 / args.serve_rate if args.serve_rate > 0 else 0.0
+
+    def run(prefill: int, decode: int, kill: str | None):
+        extra = {"TPUNN_CHAOS": kill or ""}
+        pools = (dict(prefill=prefill, decode=decode) if prefill
+                 else dict(replicas=decode))
+        fleet = ProcessFleet(
+            backend="tiny", max_slots=slots, max_queue=n_req,
+            max_seq_len=max_seq, heartbeat_interval_s=0.1,
+            heartbeat_timeout_s=10.0,
+            # headroom for the kill run: every prefill life re-arms
+            # the chaos fuse, so one replica may crash several times
+            max_restarts=10,
+            worker_extra_env=extra, **pools)
+        fleet.start()
+        fleet.wait_ready(prefill + decode, timeout=300.0)
+        t0 = time.perf_counter()
+        t_next = t0
+        tickets = []
+        for p, n in zip(prompts, budgets):
+            wait = t_next - time.perf_counter()
+            if wait > 0:
+                time.sleep(wait)
+            t_next += period
+            tickets.append(fleet.submit(p, n))
+        for t in tickets:
+            t.wait(300.0)
+        wall = time.perf_counter() - t0
+        done = list(fleet.completed)
+        failovers = fleet.failovers
+        pump_events = fleet._pump.events
+        fleet.stop()
+        toks = sum(c["new_tokens"] for c in done)
+        ttfts = np.array([c["ttft_s"] for c in done
+                          if c["ttft_s"] >= 0.0])
+        return dict(tps=toks / wall, ttfts=ttfts,
+                    completed=len(done), failovers=failovers,
+                    pump_events=pump_events)
+
+    unified = run(0, n_rep, None)
+    steady = run(n_pre, n_dec, None)
+    chaotic = run(n_pre, n_dec, "kill_transfer@step=5")
+
+    def p99(xs):
+        return float(np.percentile(xs, 99)) if len(xs) else 0.0
+
+    import jax
+
+    from pytorch_distributed_nn_tpu.utils.metrics import MetricsLogger
+
+    MetricsLogger(stream=sys.stdout).emit_benchmark(
+        metric=_METRIC_NAMES["disagg_procs"],
+        value=round(steady["tps"], 1), unit="tokens/sec",
+        vs_baseline=round(steady["tps"] / unified["tps"], 3),
+        vs_baseline_kind=(f"disagg_{n_pre}p{n_dec}d_over_unified_"
+                          f"{n_rep}_procs"),
+        backend=jax.default_backend(),
+        prefill=n_pre, decode=n_dec, requests=n_req,
+        completed=steady["completed"],
+        unified_tokens_per_s=round(unified["tps"], 1),
+        pump_events=steady["pump_events"],
+        ttft_p99_ms=round(p99(steady["ttfts"]) * 1e3, 2),
+        ttft_p99_with_kill_ms=round(p99(chaotic["ttfts"]) * 1e3, 2),
+        kill_tokens_per_s=round(chaotic["tps"], 1),
+        kill_completed=chaotic["completed"],
+        kill_failovers=chaotic["failovers"],
+        detail=f"open-loop {args.serve_rate:g} req/s, {n_req} ragged "
+               f"requests, {slots} slots/replica, {n_pre} prefill + "
+               f"{n_dec} decode subprocess pools vs unified {n_rep} "
+               f"over the native store, KV handoff via serve/kv_wire; "
+               f"kill drill: kill_transfer@step=5",
+    )
+    return 0
+
+
 def bench_fleet(args) -> int:
     """Replica-fleet serving (serve/fleet.py): the SAME open-loop
     ragged workload through 1 replica and through N replicas behind
@@ -1111,6 +1220,8 @@ def bench_fleet(args) -> int:
     their emitted prefix, and the record carries p99 TTFT with and
     without the kill — the failover tax the paper's robustness story
     must bound (acceptance: < 2x the steady-state p99)."""
+    if args.disagg_procs:
+        return _bench_fleet_disagg_procs(args)
     if args.disagg:
         return _bench_fleet_disagg(args)
     if args.fleet_procs:
@@ -2092,6 +2203,136 @@ def _disagg_selftest() -> int:
     return 0
 
 
+def _disagg_procs_selftest() -> int:
+    """--fleet --disagg-procs --selftest: the process-disaggregation
+    gate (tier-1 via tests/test_quality.py). No backend in THIS
+    process — stub prefill/decode subprocess pools over a REAL native
+    store, the KV handoff streamed through serve/kv_wire.py. Asserts
+    the fault-tolerant-wire invariants end to end:
+
+    1. disagg output is bit-identical to the stub reference, the
+       decode legs warm (journal ``kv_pull`` dispositions, written by
+       the decode WORKER into the coordinator's journal) and the
+       transfer pump overlapping the poll loop (pump flight events);
+    2. ``corrupt_wire@seq=0`` tears one chunk — one bounded re-pull,
+       still warm, still bit-identical;
+    3. ``corrupt_wire@p=1.0`` re-tears every attempt — re-pulls
+       exhaust and the decode leg degrades to a COLD re-prefill,
+       still bit-identical (a torn wire never wedges a request);
+    4. ``store_partition@ms=800:window=transfer`` blacks out ONLY the
+       kvwire ops mid-stream — the counted retries ride it out with
+       ZERO replica failovers, still bit-identical;
+    5. ``kill_transfer@step=1`` kills the prefill worker INSIDE the
+       push (done already published) — the decode leg re-prefills
+       cold, still bit-identical;
+    6. the coordinator dies between handoff and final — the successor
+       adopts the workers pid-for-pid, rediscovers the disaggregation
+       from live roles, replays the handoff from the journal, and the
+       stitched output is STILL bit-identical."""
+    from pytorch_distributed_nn_tpu.runtime import chaos
+    from pytorch_distributed_nn_tpu.serve.procfleet import ProcessFleet
+    from pytorch_distributed_nn_tpu.serve.stub import stub_decode
+
+    budget = 32
+    prompts = [[31 + i, 7, 2] for i in range(3)]
+    golden = [stub_decode(p, budget) for p in prompts]
+
+    def run(worker_chaos: str = "", n: int = 1):
+        chaos.reset()
+        fleet = ProcessFleet(
+            prefill=1, decode=1, backend="stub",
+            heartbeat_interval_s=0.05, heartbeat_timeout_s=10.0,
+            token_ms=2.0,
+            worker_extra_env={"TPUNN_CHAOS": worker_chaos})
+        fleet.start()
+        assert fleet.wait_ready(2, timeout=120), "workers never joined"
+        tickets = [fleet.submit(p, budget) for p in prompts[:n]]
+        assert fleet.wait_all(tickets, timeout=120), \
+            f"requests wedged under {worker_chaos or 'no chaos'!r}"
+        outs = [list(t.tokens) for t in tickets]
+        pulls = [r for r in fleet.journal.read_all()
+                 if r.get("event") == "kv_pull"]
+        pump = fleet._pump.events
+        failovers = fleet.failovers
+        fleet.stop()
+        return outs, pulls, pump, failovers
+
+    # 1. steady: warm wire, pump overlapping the poll loop
+    outs, pulls, pump, _ = run(n=3)
+    assert outs == golden, f"disagg output diverged:\n{outs}\n{golden}"
+    assert len(pulls) == 3 and all(
+        p["outcome"] == "warm" for p in pulls), pulls
+    assert pump > 0, "transfer pump emitted no flight events"
+
+    # 2. one torn chunk -> bounded re-pull -> warm
+    outs, pulls, _, _ = run("corrupt_wire@seq=0")
+    assert outs == golden[:1], f"re-pull broke bit-identity: {outs}"
+    assert pulls and pulls[0]["outcome"] == "warm", pulls
+
+    # 3. every re-pull torn -> graceful cold re-prefill, never a wedge
+    outs, pulls, _, _ = run("corrupt_wire@p=1.0")
+    assert outs == golden[:1], f"cold path broke bit-identity: {outs}"
+    assert pulls and pulls[0]["outcome"] == "cold", pulls
+
+    # 4. kvwire-scoped partition mid-stream: counted retries ride it
+    # out; replica health (heartbeats, done polls) never notices
+    outs, _, _, failovers = run("store_partition@ms=800:window=transfer")
+    assert outs == golden[:1], f"partition broke bit-identity: {outs}"
+    assert failovers == 0, \
+        f"transfer-window partition leaked into replica health: " \
+        f"{failovers} failovers"
+
+    # 5. source killed inside the push -> decode re-prefills cold
+    outs, pulls, _, _ = run("kill_transfer@step=1")
+    assert outs == golden[:1], f"transfer kill broke bit-identity: {outs}"
+    assert pulls and pulls[0]["outcome"] == "cold", pulls
+
+    # 6. coordinator dies mid-handoff: pid-for-pid adoption, the
+    # successor replays the handoff from the journal
+    chaos.reset()
+    f1 = ProcessFleet(prefill=1, decode=1, backend="stub",
+                      heartbeat_interval_s=0.05,
+                      heartbeat_timeout_s=10.0, token_ms=6.0)
+    f1.start()
+    assert f1.wait_ready(2, timeout=120), "workers never joined"
+    t0 = f1.submit(prompts[0], budget)
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline and not any(
+            r.get("event") == "handoff" for r in f1.journal.read_all()):
+        time.sleep(0.01)
+    assert any(r.get("event") == "handoff"
+               for r in f1.journal.read_all()), "handoff never journaled"
+    pids = {h.index: h.pid for h in f1.replicas
+            if h.state in ("ready", "draining")}
+    f1.abandon()
+
+    f2 = ProcessFleet.recover_from(
+        store_endpoint=f1.store_endpoint,
+        heartbeat_interval_s=0.05, heartbeat_timeout_s=10.0,
+        token_ms=6.0)
+    assert f2.disagg, "successor lost the disaggregation"
+    adopted = {h.index: h.pid for h in f2.replicas if h.adopted}
+    assert adopted and all(pids.get(i) == p
+                           for i, p in adopted.items()), \
+        f"adoption restarted live workers: {pids} -> {adopted}"
+    f2.start()
+    assert f2.wait_all(list(f2.recovered_tickets.values()),
+                       timeout=120), "handoff replay never finished"
+    t = f2.recovered_tickets[t0.request_id]
+    assert list(t.tokens) == golden[0], \
+        "mid-handoff takeover broke bit-identity"
+    f2.stop()
+    try:
+        f1._client.close()
+    except OSError:
+        pass
+    if f1._server is not None:
+        f1._server.stop()
+    chaos.reset()
+    print("disagg-procs selftest ok")
+    return 0
+
+
 def _ledger_selftest() -> int:
     """End-to-end gate check on synthetic trajectories (tier-1 smoke,
     tests/test_quality.py): an in-band series must pass, a regressed
@@ -2266,10 +2507,22 @@ def main(argv=None) -> int:
                          "kill_transfer@ mid-stream drill (with "
                          "--selftest: the CPU-scale bit-identity + "
                          "chaos gate)")
+    ap.add_argument("--disagg-procs", action="store_true",
+                    help="fleet metric: disaggregated PROCESS fleet — "
+                         "prefill/decode subprocess pools "
+                         "(--fleet-prefill/--fleet-decode) over the "
+                         "real native store, the KV handoff streamed "
+                         "through serve/kv_wire.py; records tokens/s "
+                         "+ p99 TTFT with and without a mid-push "
+                         "kill_transfer@ drill (with --selftest: the "
+                         "bit-identity + partition/corrupt-wire/kill "
+                         "chaos drill gate)")
     ap.add_argument("--fleet-prefill", type=int, default=2,
-                    help="--disagg: prefill-pool replica count")
+                    help="--disagg/--disagg-procs: prefill-pool "
+                         "replica count")
     ap.add_argument("--fleet-decode", type=int, default=2,
-                    help="--disagg: decode-pool replica count")
+                    help="--disagg/--disagg-procs: decode-pool "
+                         "replica count")
     ap.add_argument("--fleet-procs", type=int, default=0,
                     help="fleet metric: run the PROCESS-backed fleet "
                          "instead — this many replica subprocesses "
@@ -2389,6 +2642,10 @@ def main(argv=None) -> int:
     if args.metric == "autoscale" and args.selftest:
         return _autoscale_selftest()  # pure: no backend, no probe
     if args.metric == "fleet" and args.selftest:
+        if args.disagg_procs:
+            # process-disagg gate: stub subprocess pools over a real
+            # native store, KV-wire chaos drills + takeover replay
+            return _disagg_procs_selftest()
         if args.disagg:
             # CPU-scale gate: disagg bit-identity + kill_transfer drill
             return _disagg_selftest()

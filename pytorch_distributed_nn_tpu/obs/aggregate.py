@@ -68,14 +68,33 @@ def collect_snapshots(client, ranks, *, incarnation: int = 0,
     out: dict[int, dict] = {}
     for rank in ranks:
         key = _KEY_FMT.format(incarnation=incarnation, rank=rank)
+        raw = _counted_pull(client, key, op="collect_snapshot",
+                            timeout_ms=timeout_ms)
+        if raw is None:
+            continue
         try:
-            if not client.check(key):
-                continue
-            out[rank] = json.loads(
-                client.get(key, timeout_ms=timeout_ms).decode())
-        except (OSError, TimeoutError, ValueError) as e:
-            log.warning("snapshot pull for rank %d failed: %s", rank, e)
+            out[rank] = json.loads(raw.decode())
+        except ValueError as e:
+            log.warning("snapshot for rank %d undecodable: %s", rank, e)
     return out
+
+
+def _counted_pull(client, key: str, *, op: str, timeout_ms: int):
+    """One coordinator-side store read through the counted retry
+    helper (:func:`runtime.failure.store_call`): a partition degrades
+    the pull to an absent entry (skipped, ``store_errors_total{op}``
+    bumped per failure) — an aggregation sweep never dies of an
+    uncounted store error, and never wedges past its deadline."""
+    from pytorch_distributed_nn_tpu.runtime import failure
+
+    def read():
+        if not client.check(key):
+            return None
+        return client.get(key, timeout_ms=timeout_ms)
+
+    return failure.store_call(
+        read, op=op, deadline_s=max(timeout_ms / 1000.0, 0.5),
+        fallback=None)
 
 
 _TRACE_KEY_FMT = "trace/{rank}"
@@ -99,13 +118,14 @@ def collect_spans(client, ranks, *, timeout_ms: int = 1000) -> list[dict]:
     out: list[dict] = []
     for rank in ranks:
         key = _TRACE_KEY_FMT.format(rank=rank)
+        raw = _counted_pull(client, key, op="collect_spans",
+                            timeout_ms=timeout_ms)
+        if raw is None:
+            continue
         try:
-            if not client.check(key):
-                continue
-            out.extend(json.loads(
-                client.get(key, timeout_ms=timeout_ms).decode()))
-        except (OSError, TimeoutError, ValueError) as e:
-            log.warning("trace span pull for rank %d failed: %s",
+            out.extend(json.loads(raw.decode()))
+        except ValueError as e:
+            log.warning("trace spans for rank %d undecodable: %s",
                         rank, e)
     return out
 
@@ -135,13 +155,14 @@ def collect_ledgers(client, ranks, *,
     parts: list[dict] = []
     for rank in ranks:
         key = _METER_KEY_FMT.format(rank=rank)
+        raw = _counted_pull(client, key, op="collect_ledgers",
+                            timeout_ms=timeout_ms)
+        if raw is None:
+            continue
         try:
-            if not client.check(key):
-                continue
-            parts.append(json.loads(
-                client.get(key, timeout_ms=timeout_ms).decode()))
-        except (OSError, TimeoutError, ValueError) as e:
-            log.warning("meter ledger pull for rank %d failed: %s",
+            parts.append(json.loads(raw.decode()))
+        except ValueError as e:
+            log.warning("meter ledger for rank %d undecodable: %s",
                         rank, e)
     return meter.merge_ledgers(parts)
 

@@ -600,12 +600,17 @@ def maybe_publish(client, *, rank: int) -> bool:
     if n == 0 or n == _meter._published:
         return False
     from pytorch_distributed_nn_tpu.obs import aggregate
+    from pytorch_distributed_nn_tpu.runtime import failure
 
-    try:
-        aggregate.publish_ledgers(client, rank=rank,
-                                  ledgers=_meter.export_ledgers())
-        _meter._published = n
-        return True
-    except (OSError, TimeoutError) as e:
-        log.warning("meter ledger publish failed: %s", e)
+    # counted retry (store_errors_total{op="meter_publish"}): same
+    # degrade-not-die contract as the heartbeat reporter — the ledger
+    # stays local and the next tick republished the full state
+    out = failure.store_call(
+        lambda: aggregate.publish_ledgers(
+            client, rank=rank, ledgers=_meter.export_ledgers()),
+        op="meter_publish", deadline_s=0.5, fallback=None)
+    if out is None:
+        log.warning("meter ledger publish failed past deadline")
         return False
+    _meter._published = n
+    return True

@@ -458,16 +458,22 @@ def maybe_publish(client, *, rank: int) -> bool:
     if n == _tracer._published:
         return False
     from pytorch_distributed_nn_tpu.obs import aggregate
+    from pytorch_distributed_nn_tpu.runtime import failure
 
-    try:
-        aggregate.publish_spans(client, rank=rank,
-                                spans=_tracer.export_spans())
-        _tracer._published = n
-        return True
-    except (OSError, TimeoutError) as e:
+    # counted retry (store_errors_total{op="trace_publish"}): a blip
+    # retries within the bounded deadline; a real outage degrades to a
+    # dropped publish the NEXT tick retries naturally — the daemon
+    # thread calling this can never die of an uncounted store error
+    out = failure.store_call(
+        lambda: aggregate.publish_spans(
+            client, rank=rank, spans=_tracer.export_spans()),
+        op="trace_publish", deadline_s=0.5, fallback=None)
+    if out is None:
         _tracer._c_dropped.inc(reason="store_error")
-        log.warning("trace span publish failed: %s", e)
+        log.warning("trace span publish failed past deadline")
         return False
+    _tracer._published = n
+    return True
 
 
 # ---------------------------------------------------------------------------

@@ -170,8 +170,10 @@ def kv_transfer(blocks, *, src: str, dst: str, src_index: int = -1,
     :class:`runtime.chaos.TransferKillError` with the payload
     half-shipped — the caller owns that failover. Lint-enforced
     (tests/test_quality.py): every KV byte moved between replica
-    engines passes through here, and the only serve-package caller is
-    ``DisaggFleet._stream_blocks``."""
+    engines passes through here, and the only serve-package callers
+    are ``DisaggFleet._stream_blocks`` (thread fleet, host arrays ARE
+    the wire) and ``serve.kv_wire.push`` (process fleet, the tree is
+    billed here FIRST, then chunked into the store wire)."""
     leaves = [x for x in jax.tree.leaves(blocks)
               if getattr(x, "ndim", 0) >= 2]
     payload = int(sum(x.size * x.dtype.itemsize for x in leaves))

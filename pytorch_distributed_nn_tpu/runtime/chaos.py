@@ -93,6 +93,29 @@ Spec grammar (faults joined by ``;``)::
                                          (serve/disagg.py): the request
                                          must re-prefill on a survivor,
                                          output bit-identical
+    corrupt_wire@seq=N[:p=...]           declare KV wire chunk seq N
+                                         torn on the pull side
+                                         (checksum-failed). seq= alone
+                                         fires ONCE — the bounded
+                                         re-pull succeeds; with p= the
+                                         chunk re-tears with
+                                         probability p on every
+                                         attempt (p=1: re-pulls
+                                         exhaust and the decode
+                                         replica degrades to a cold
+                                         re-prefill); p= alone tears
+                                         each chunk with probability p
+                                         (seeded) — the torn-wire
+                                         drill for serve/kv_wire.py
+    store_partition@ms=500:window=transfer
+                                         narrow the partition to the
+                                         KV transfer window: only
+                                         kvwire/* store ops raise, the
+                                         window opening on the first
+                                         such op — the mid-stream
+                                         partition drill (bounded
+                                         re-pull then cold re-prefill,
+                                         never a wedged request)
 
 ``rank`` / ``inc`` (incarnation, from ``TPUNN_RESTART``) are optional
 filters; a fault without them fires in every process / incarnation.
@@ -144,11 +167,16 @@ DEFAULT_HANG_MS = 3_600_000.0
 FAULT_KINDS = ("crash", "hang", "slow", "preempt", "corrupt_ckpt",
                "store_flaky", "serve_reject", "kill_replica",
                "hang_replica", "kill_coordinator", "store_partition",
-               "evict_prefix", "tenant_flood", "kill_transfer")
+               "evict_prefix", "tenant_flood", "kill_transfer",
+               "corrupt_wire")
 
-_INT_KEYS = ("step", "rank", "inc", "replica")
+_INT_KEYS = ("step", "rank", "inc", "replica", "seq")
 _FLOAT_KEYS = ("ms", "p", "after_s", "rps")
-_STR_KEYS = ("collective", "tenant")
+_STR_KEYS = ("collective", "tenant", "window")
+
+# store_partition window= values: which store-op slice the partition
+# covers ("" = every op; "transfer" = only kvwire/* keys)
+_PARTITION_WINDOWS = ("transfer",)
 
 
 class ReplicaKillError(RuntimeError):
@@ -190,6 +218,8 @@ class Fault:
     after_s: float = 0.0
     tenant: str = ""
     rps: float = 0.0
+    seq: int | None = None
+    window: str = ""
 
 
 def parse_spec(spec: str) -> list[Fault]:
@@ -246,7 +276,7 @@ def _validate(fault: Fault) -> None:
         "kill_replica": ("replica",), "hang_replica": ("replica",),
         "kill_coordinator": ("after_s",), "store_partition": ("ms",),
         "evict_prefix": ("p",), "tenant_flood": ("tenant", "rps"),
-        "kill_transfer": ("step",),
+        "kill_transfer": ("step",), "corrupt_wire": (),
     }[fault.kind]
     for key in need:
         missing = (getattr(fault, key) in (None, "", 0.0)
@@ -265,6 +295,22 @@ def _validate(fault: Fault) -> None:
     if fault.kind == "tenant_flood" and fault.rps < 0.0:
         raise ValueError(
             f"tenant_flood rps must be > 0, got {fault.rps}")
+    if fault.kind == "corrupt_wire":
+        if fault.seq is None and not fault.p:
+            raise ValueError(
+                f"chaos fault {fault.spec!r} needs seq= or p= "
+                f"(e.g. corrupt_wire@seq=1)")
+        if fault.p and not 0.0 < fault.p <= 1.0:
+            raise ValueError(
+                f"corrupt_wire p must be in (0, 1], got {fault.p}")
+    if fault.window and fault.kind != "store_partition":
+        raise ValueError(
+            f"chaos key window= only applies to store_partition, "
+            f"not {fault.kind!r}")
+    if fault.window and fault.window not in _PARTITION_WINDOWS:
+        raise ValueError(
+            f"unknown store_partition window {fault.window!r}; "
+            f"have {_PARTITION_WINDOWS}")
 
 
 class ChaosEngine:
@@ -362,6 +408,10 @@ class ChaosEngine:
                 if self._rng.random() < fault.p:
                     self._inject_store_flaky(fault, op, key)
             elif fault.kind == "store_partition":
+                if fault.window == "transfer" and "kvwire/" not in key:
+                    # narrowed partition: only the KV transfer wire is
+                    # unreachable; coordination traffic flows
+                    continue
                 now = time.monotonic()
                 if fault.after_s and now - self._t0 < fault.after_s:
                     continue
@@ -465,6 +515,29 @@ class ChaosEngine:
             self._fired.add(i)
             self._inject_kill_transfer(fault, src, dst)
 
+    def wire_chunk(self, seq: int) -> bool:
+        """KV wire pull-side hook (corrupt_wire): True = treat this
+        chunk read as torn (checksum-failed). ``seq=`` alone fires
+        once (the re-pull succeeds); with ``p=`` the chunk re-tears
+        with probability p per attempt; ``p=`` alone tears any chunk
+        with probability p (seeded)."""
+        for i, fault in enumerate(self.faults):
+            if fault.kind != "corrupt_wire" or not self._matches(fault):
+                continue
+            if fault.seq is not None and fault.seq != seq:
+                continue
+            if fault.p:
+                if self._rng.random() < fault.p:
+                    self._inject_corrupt_wire(fault, seq)
+                    return True
+                continue
+            if i in self._fired:
+                continue
+            self._fired.add(i)
+            self._inject_corrupt_wire(fault, seq)
+            return True
+        return False
+
     # -- injections (each one _emits first: lint-enforced) ---------------
 
     def _inject_crash(self, fault: Fault) -> None:
@@ -540,6 +613,12 @@ class ChaosEngine:
         self._emit(fault, note=f"{fault.spec} [r{src}->r{dst}]")
         raise TransferKillError(
             f"chaos: injected kill mid-transfer r{src}->r{dst}")
+
+    def _inject_corrupt_wire(self, fault: Fault, seq: int) -> None:
+        # emit-first (lint): the torn read itself is kv_wire.pull's to
+        # handle (bounded re-pull, then cold re-prefill) — the flight
+        # ring must already hold the injection when it does
+        self._emit(fault, note=f"{fault.spec} [chunk {seq}]")
 
     def _inject_hang_replica(self, fault: Fault, replica: int) -> None:
         self._emit(fault, note=f"{fault.spec} [replica {replica}]")
@@ -697,6 +776,17 @@ def on_transfer(src: int = -1, dst: int = -1) -> None:
     if _engine is None:
         return
     _engine.transfer(src, dst)
+
+
+def on_wire_chunk(seq: int) -> bool:
+    """``serve.kv_wire`` pull-side hook (corrupt_wire).
+
+    True when chaos says this chunk read is torn (checksum-failed);
+    kv_wire owns the response — a bounded re-pull, then graceful
+    degradation to a cold re-prefill on the decode replica."""
+    if _engine is None:
+        return False
+    return _engine.wire_chunk(seq)
 
 
 def on_replica_round(replica: int, round_: int) -> None:

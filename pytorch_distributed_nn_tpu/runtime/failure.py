@@ -40,6 +40,7 @@ from __future__ import annotations
 
 import logging
 import os
+import random
 import signal
 import threading
 import time
@@ -61,6 +62,57 @@ def count_store_error(op: str) -> None:
         "store_errors_total",
         "transient store failures absorbed as counted retries",
         labels=("op",)).inc(op=op)
+
+
+_RAISE = object()  # store_call sentinel: re-raise on deadline
+
+
+def store_call(fn, *, op: str, deadline_s: float = 5.0,
+               base_s: float = 0.01, max_s: float = 0.25,
+               seed: int = 0, on_retry=None, fallback=_RAISE):
+    """THE counted retry helper for one store operation on a path that
+    must survive a partition window (the KV transfer wire, daemon
+    publish loops): call ``fn()`` until it returns, retrying
+    ``OSError``/``TimeoutError`` with exponential backoff + seeded
+    jitter, each failure counted in ``store_errors_total{op}``.
+
+    Semantics:
+
+    - every failed attempt bumps ``store_errors_total{op}`` and (when
+      given) calls ``on_retry()`` — the hook kv_wire uses to bump its
+      own ``kv_wire_retries_total{op}`` without a second ``except``
+      site (the lint contract: this function is the only
+      ``except OSError`` on the transfer path);
+    - backoff is ``min(base_s * 2**attempt, max_s)`` scaled by a
+      jitter factor in ``[0.5, 1.5)`` drawn from a ``random.Random``
+      seeded by ``(seed, op)`` — deterministic per (seed, op) stream,
+      so a rerun retries on the same schedule;
+    - ``deadline_s`` bounds the whole call: once it elapses the last
+      error re-raises to the caller — or, when ``fallback=`` is given,
+      returns that value instead, which is how callers own graceful
+      degradation (kv_wire's pull passes ``fallback=None`` and turns a
+      dead wire into a cold re-prefill — a bounded failure, never a
+      wedged request) without growing a second ``except`` site.
+    """
+    rng = random.Random((int(seed) << 16) ^ (hash(op) & 0xFFFF))
+    deadline = time.monotonic() + float(deadline_s)
+    attempt = 0
+    while True:
+        try:
+            return fn()
+        except (OSError, TimeoutError):
+            count_store_error(op)
+            if on_retry is not None:
+                on_retry()
+            now = time.monotonic()
+            if now >= deadline:
+                if fallback is not _RAISE:
+                    return fallback
+                raise
+            delay = min(base_s * (2.0 ** attempt), max_s)
+            delay *= 0.5 + rng.random()
+            time.sleep(min(delay, max(deadline - now, 0.0)))
+            attempt += 1
 
 # Environment contract between the elastic agent and its workers.
 ENV_STORE_PORT = "TPUNN_STORE_PORT"

@@ -44,11 +44,18 @@ Layering (each module's docstring carries its own contract):
 - :mod:`serve.procfleet` — the deployment shape (ISSUE 13): replica
   subprocesses (:mod:`serve.fleet_worker`) supervised over the real
   native store, with a crash-recoverable coordinator (adoption, not
-  restart; journal continuity across incarnations).
+  restart; journal continuity across incarnations); Breakwater (ISSUE
+  18) adds role-tagged pools (``ProcessFleet(prefill=P, decode=D)``)
+  and cross-host enrollment through a ``ProcessFleetProvisioner``;
+- :mod:`serve.kv_wire` — Breakwater's fault-tolerant KV handoff wire
+  (ISSUE 18): versioned, checksummed ``kvwire/<req>/<seq>`` chunk
+  records streamed through the store, every op on a counted retry
+  helper (:func:`runtime.failure.store_call`), torn chunks re-pulled
+  then degraded to a cold re-prefill — a request never wedges.
 
 CLI: ``scripts/serve.py``, ``scripts/fleet_deploy.py``; load test:
 ``bench.py --serve`` / ``bench.py --fleet [--fleet-procs N]`` /
-``bench.py --fleet --disagg``; docs: ``docs/serving.md``.
+``bench.py --fleet --disagg[-procs]``; docs: ``docs/serving.md``.
 """
 
 from pytorch_distributed_nn_tpu.serve.autoscale import (  # noqa: F401
@@ -81,9 +88,12 @@ from pytorch_distributed_nn_tpu.serve.prefix_cache import (  # noqa: F401
     PrefixCache,
     PrefixMatch,
 )
+from pytorch_distributed_nn_tpu.serve import kv_wire  # noqa: F401
 from pytorch_distributed_nn_tpu.serve.procfleet import (  # noqa: F401
     ProcessFleet,
+    ProcessFleetProvisioner,
     ProcTicket,
+    TemplateProvisioner,
 )
 from pytorch_distributed_nn_tpu.serve.router import (  # noqa: F401
     DEAD,

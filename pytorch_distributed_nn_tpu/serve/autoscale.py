@@ -72,6 +72,11 @@ SCALE_DOWN = "scale_down"
 HOLD = "hold"
 ACTIONS = (SCALE_UP, SCALE_DOWN, HOLD)
 
+# the pool a pool-less decision governs: the decode pool IS the
+# unified pool (pre-disagg journals replay unchanged — an absent
+# "pool" field means decode)
+DEFAULT_POOL = "decode"
+
 
 @dataclasses.dataclass
 class AutoscaleConfig:
@@ -160,6 +165,11 @@ class Decision:
     # CONTINUES the journal (seq keeps counting, state chains) rather
     # than forking it, and this field marks where the boundary fell
     coordinator_incarnation: int = 0
+    # which replica pool this decision sizes: "decode" (the unified
+    # pool's name — legacy journals replay unchanged) or "prefill" on
+    # a disaggregated process fleet (Breakwater). Hysteresis state
+    # chains per pool; seq stays contiguous across pools.
+    pool: str = DEFAULT_POOL
 
     def as_dict(self) -> dict:
         return dataclasses.asdict(self)
@@ -291,6 +301,12 @@ class Autoscaler:
         self._last_eval_t: Optional[float] = None
         self._queue_frac = 0.0
         self._kv_free_frac = 1.0
+        # non-default pools (disagg prefill): each carries its own
+        # hysteresis state, debounce anchor, and pressure sample; the
+        # attributes above remain the DEFAULT_POOL's (back-compat)
+        self._pool_states: dict[str, dict] = {}
+        self._pool_eval_t: dict[str, float] = {}
+        self._pool_pressure: dict[str, tuple] = {}
         # instruments register lazily on the first decision so an
         # armed-but-idle Helm leaves the registry untouched
         self._g_target = None
@@ -314,53 +330,83 @@ class Autoscaler:
                 self._kv_free_frac = (float(ev["kv_free"])
                                       / float(ev["kv_total"]))
 
-    def set_pressure(self, *, queue_frac: float,
-                     kv_free_frac: float) -> None:
+    def set_pressure(self, *, queue_frac: float, kv_free_frac: float,
+                     pool: str = DEFAULT_POOL) -> None:
         """Authoritative fleet-wide pressure (from
         :func:`serve.router.fleet_pressure`) — overrides the last
-        single-replica ``serve_round`` sample."""
-        self._queue_frac = float(queue_frac)
-        self._kv_free_frac = float(kv_free_frac)
+        single-replica ``serve_round`` sample. ``pool=`` scopes the
+        sample to one disaggregated pool's evidence stream."""
+        if pool == DEFAULT_POOL:
+            self._queue_frac = float(queue_frac)
+            self._kv_free_frac = float(kv_free_frac)
+        else:
+            self._pool_pressure[pool] = (float(queue_frac),
+                                         float(kv_free_frac))
+
+    def _state_for(self, pool: str) -> dict:
+        if pool == DEFAULT_POOL:
+            return self.state
+        return self._pool_states.setdefault(pool, _fresh_state())
+
+    def _set_state_for(self, pool: str, state: dict) -> None:
+        if pool == DEFAULT_POOL:
+            self.state = state
+        else:
+            self._pool_states[pool] = state
 
     # -- evaluation --------------------------------------------------------
 
-    def maybe_evaluate(self, t: float, *, ready: int,
-                       target: int) -> Optional[Decision]:
+    def maybe_evaluate(self, t: float, *, ready: int, target: int,
+                       pool: str = DEFAULT_POOL) -> Optional[Decision]:
         """Debounced :meth:`evaluate` — at most one decision per
-        ``eval_interval_s`` of *event* time. Returns None between
-        evaluations."""
-        if (self._last_eval_t is not None
-                and t - self._last_eval_t < self.cfg.eval_interval_s):
+        ``eval_interval_s`` of *event* time *per pool*. Returns None
+        between evaluations."""
+        last = (self._last_eval_t if pool == DEFAULT_POOL
+                else self._pool_eval_t.get(pool))
+        if last is not None and t - last < self.cfg.eval_interval_s:
             return None
-        self._last_eval_t = t
-        return self.evaluate(t, ready=ready, target=target)
+        if pool == DEFAULT_POOL:
+            self._last_eval_t = t
+        else:
+            self._pool_eval_t[pool] = t
+        return self.evaluate(t, ready=ready, target=target, pool=pool)
 
-    def evaluate(self, t: float, *, ready: int,
-                 target: int) -> Decision:
+    def evaluate(self, t: float, *, ready: int, target: int,
+                 pool: str = DEFAULT_POOL) -> Decision:
         """Snapshot the evidence, run :func:`decide`, journal and emit
         the outcome. The journaled ``state`` is the PRE-decision
-        hysteresis state so the record replays standalone."""
+        hysteresis state so the record replays standalone; on a
+        disaggregated fleet each pool chains its own state while seq
+        stays contiguous across pools (one journal, interleaved)."""
         burn = (self._tower.burn_rates(t)
                 if self._tower is not None else {})
+        if pool == DEFAULT_POOL:
+            queue_frac, kv_free_frac = self._queue_frac, \
+                self._kv_free_frac
+        else:
+            queue_frac, kv_free_frac = self._pool_pressure.get(
+                pool, (0.0, 1.0))
         evidence = {
             "burn": burn,
-            "queue_frac": round(self._queue_frac, 6),
-            "kv_free_frac": round(self._kv_free_frac, 6),
+            "queue_frac": round(queue_frac, 6),
+            "kv_free_frac": round(kv_free_frac, 6),
             "ready": int(ready),
             "target": int(target),
             "forecast_replicas": self.forecast_replicas,
         }
-        pre_state = dict(self.state)
+        state = self._state_for(pool)
+        pre_state = dict(state)
         action, reason, to, new_state = decide(
-            self.cfg, evidence, self.state, t)
-        self.state = new_state
+            self.cfg, evidence, state, t)
+        self._set_state_for(pool, new_state)
         d = Decision(
             seq=self.seq_offset + len(self.decisions),
             t=round(float(t), 6),
             action=action, reason=reason, from_replicas=int(ready),
             to_replicas=int(to), evidence=evidence, state=pre_state,
             spec=self.spec,
-            coordinator_incarnation=self.coordinator_incarnation)
+            coordinator_incarnation=self.coordinator_incarnation,
+            pool=pool)
         self.decisions.append(d)
         self._emit(d)
         return d
@@ -381,13 +427,19 @@ class Autoscaler:
         post-state across the restart boundary."""
         if not records:
             return
-        last = records[-1]
-        cfg = parse_spec(last.get("spec", ""))
-        _, _, _, post = decide(cfg, last["evidence"], last["state"],
-                               float(last["t"]))
-        self.state = post
-        self.seq_offset = int(last["seq"]) + 1
-        self._last_eval_t = float(last["t"])
+        by_pool: dict[str, dict] = {}
+        for rec in records:  # last record per pool wins
+            by_pool[rec.get("pool", DEFAULT_POOL)] = rec
+        for pool, last in by_pool.items():
+            cfg = parse_spec(last.get("spec", ""))
+            _, _, _, post = decide(cfg, last["evidence"],
+                                   last["state"], float(last["t"]))
+            self._set_state_for(pool, post)
+            if pool == DEFAULT_POOL:
+                self._last_eval_t = float(last["t"])
+            else:
+                self._pool_eval_t[pool] = float(last["t"])
+        self.seq_offset = max(int(r["seq"]) for r in records) + 1
 
     def _emit(self, d: Decision) -> None:
         """Every decision lands in the flight ring FIRST (lint-
@@ -495,18 +547,55 @@ class FleetAutoscaler:
     def step(self, now: Optional[float] = None) -> Optional[Decision]:
         """One control tick; returns the decision (None when
         debounced). ``now`` defaults to wall time for live use; pass
-        trace-relative time for deterministic replays."""
+        trace-relative time for deterministic replays. On a
+        disaggregated fleet this is the first of :meth:`step_all`'s
+        per-pool decisions — callers that journal every decision
+        should use :meth:`step_all`."""
+        decisions = self.step_all(now)
+        return decisions[0] if decisions else None
+
+    def step_all(self, now: Optional[float] = None) -> list:
+        """One control tick across every scalable pool; returns the
+        decisions made (empty when every pool debounced).
+
+        Fleets that expose ``scalable_pools()`` (the disaggregated
+        process fleet) get one decision per pool — each from that
+        pool's own :func:`serve.router.fleet_pressure` evidence and
+        hysteresis chain, applied through
+        ``scale_to(n, reason=, pool=)`` (the Breakwater satellite:
+        prefill queue-depth pressure grows the prefill pool). Fleets
+        without pools keep the legacy single-target path unchanged."""
         t = time.time() if now is None else now
-        pressure = _router.fleet_pressure(self.fleet.replicas)
-        self.scaler.set_pressure(
-            queue_frac=pressure["queue_frac"],
-            kv_free_frac=pressure["kv_free_frac"])
-        d = self.scaler.maybe_evaluate(
-            t, ready=pressure["ready"],
-            target=self.fleet.target_replicas)
-        if d is not None and d.action != HOLD:
-            self.fleet.scale_to(d.to_replicas, reason=d.reason)
-        return d
+        pools_fn = getattr(self.fleet, "scalable_pools", None)
+        pools = list(pools_fn()) if pools_fn is not None else []
+        if not pools:
+            pressure = _router.fleet_pressure(self.fleet.replicas)
+            self.scaler.set_pressure(
+                queue_frac=pressure["queue_frac"],
+                kv_free_frac=pressure["kv_free_frac"])
+            d = self.scaler.maybe_evaluate(
+                t, ready=pressure["ready"],
+                target=self.fleet.target_replicas)
+            if d is not None and d.action != HOLD:
+                self.fleet.scale_to(d.to_replicas, reason=d.reason)
+            return [d] if d is not None else []
+        decisions = []
+        for pool in pools:
+            pressure = _router.fleet_pressure(self.fleet.replicas,
+                                              role=pool)
+            self.scaler.set_pressure(
+                queue_frac=pressure["queue_frac"],
+                kv_free_frac=pressure["kv_free_frac"], pool=pool)
+            d = self.scaler.maybe_evaluate(
+                t, ready=pressure["ready"],
+                target=self.fleet.pool_target(pool), pool=pool)
+            if d is None:
+                continue
+            if d.action != HOLD:
+                self.fleet.scale_to(d.to_replicas, reason=d.reason,
+                                    pool=pool)
+            decisions.append(d)
+        return decisions
 
 
 # -- process-global arming (mirrors obs.watchtower / runtime.chaos) --------

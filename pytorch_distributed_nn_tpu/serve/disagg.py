@@ -52,18 +52,21 @@ today opts in with two keywords:
 Scaling: :meth:`Fleet.scale_to` on a disaggregated fleet targets the
 **decode** pool (``_scalable``) — decode is the KV/bandwidth-bound
 class whose pressure the Helm autoscaler actually measures; the prefill
-pool is sized at construction. Thread-fleet only: the process-backed
-fleet (:mod:`serve.procfleet`) keeps unified replicas — streaming
-host-side KV pytrees across process boundaries needs a wire format the
-store protocol doesn't carry yet.
+pool is sized at construction. The process-backed fleet
+(:mod:`serve.procfleet`) runs the same two-pool topology across real
+process boundaries: host-side KV pytrees travel through the store on
+the versioned, checksummed :mod:`serve.kv_wire` format, and its Helm
+edition scales BOTH pools independently
+(:meth:`serve.procfleet.ProcessFleet.scale_to` with ``pool=``).
 
 Observability: ``serve_kv_transfer_bytes`` / ``serve_kv_transfer_seconds``
 / ``serve_kv_transfer_total{outcome}`` and per-class
 ``serve_fleet_replicas{role}`` gauges, plus ``handoff`` / ``kv_transfer``
-flight-ring events. Lint-enforced (tests/test_quality.py): the ONLY
-serve-package caller of :func:`ops.collectives.kv_transfer` is
-:meth:`DisaggFleet._stream_blocks`, so every streamed KV byte is on the
-books.
+flight-ring events. Lint-enforced (tests/test_quality.py): the only
+serve-package callers of :func:`ops.collectives.kv_transfer` are
+:meth:`DisaggFleet._stream_blocks` (thread fleet) and
+:func:`serve.kv_wire.push` (process fleet), so every streamed KV byte
+is on the books.
 """
 
 from __future__ import annotations

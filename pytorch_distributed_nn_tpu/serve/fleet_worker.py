@@ -19,7 +19,26 @@ word between them travels through the store:
   hold, exit ``GRACEFUL_EXIT_CODE``) or ``stop`` (fleet shutdown);
 - ``hb/0/<idx>``      — the REAL :class:`runtime.failure.HeartbeatReporter`
   beating through the same store (progress-watchdog mode, so a wedged
-  decode loop reads as a hang even while the beat thread lives).
+  decode loop reads as a hang even while the beat thread lives);
+- ``enroll/<idx>``    — the worker's birth certificate (pid, host,
+  role), written once at startup. A locally-spawned worker's record is
+  redundant (the coordinator holds the ``Popen``); a worker spawned on
+  another host through a :class:`serve.procfleet.TemplateProvisioner`
+  has NO process object on the coordinator — this record is how the
+  coordinator learns its pid/host at all (``_check_enrollment``);
+- ``kvwire/<rid>/*``  — the versioned, checksummed KV handoff wire
+  (:mod:`serve.kv_wire`): a ``--role prefill`` worker pushes the
+  request's KV tree here after publishing ``done`` (done FIRST — a
+  death mid-push is exactly a crash after completion, the coordinator
+  hands off and the decode leg runs cold); a ``--role decode`` worker
+  pulls it at admit and ingests warm, or re-prefills cold when the
+  wire is absent/torn past its bounded deadline. Never wedges.
+
+Roles (``--role prefill|decode|unified``) do not change how this
+process serves — the coordinator's stage-aware router is what routes
+legs to pools — but a prefill worker pushes the wire on completion and
+a decode worker pulls it at admission, and the role rides the enroll
+record and the coordinator's ``serve_fleet_replicas{role}`` gauge.
 
 Exit codes are the elastic-agent contract: ``0`` on ``stop``,
 ``failure.GRACEFUL_EXIT_CODE`` (83) on drain/SIGTERM,
@@ -30,7 +49,11 @@ exactly like the training agent does.
 Backends: ``stub`` decodes with :func:`serve.stub.stub_next_token`
 (deterministic, model-free — restart drills and tier-1); ``tiny``
 builds the same deterministic tiny model ``bench.py --serve-tiny``
-uses and drives a real :class:`serve.engine.ServingEngine`.
+uses and drives a real :class:`serve.engine.ServingEngine`;
+``preset`` builds a REAL model from a named :data:`config.PRESETS`
+entry (``--preset``, validated with an error naming every available
+preset) with optional Orbax params at ``--ckpt``, behind the same
+engine loop.
 
 Store failures (``store_partition`` / ``store_flaky`` chaos, a real
 blip) degrade to counted retries (``store_errors_total{op}``) — the
@@ -45,15 +68,23 @@ import argparse
 import json
 import logging
 import os
+import socket
 import sys
 import time
+
+import numpy as np
 
 from pytorch_distributed_nn_tpu.obs import meter, trace
 from pytorch_distributed_nn_tpu.runtime import chaos, failure
 from pytorch_distributed_nn_tpu.runtime.platform import (
     apply_platform_overrides,
 )
-from pytorch_distributed_nn_tpu.serve.store import PrefixStore, make_store
+from pytorch_distributed_nn_tpu.serve import kv_wire
+from pytorch_distributed_nn_tpu.serve.store import (
+    PrefixStore,
+    StoreJournal,
+    make_store,
+)
 from pytorch_distributed_nn_tpu.serve.stub import stub_next_token
 
 # entrypoint contract: honor JAX_PLATFORMS before first backend use —
@@ -110,6 +141,20 @@ class _StubBackend:
         return {"free_blocks": self.slots_free,
                 "num_blocks": self.max_slots, "block_size": 1}
 
+    def export_kv(self, rec: dict, toks: list) -> dict:
+        """The stub's 'KV state' is just the token stream —
+        :func:`stub_next_token` is a pure function of the prefix, so
+        warm and cold decode legs are bit-identical by construction.
+        The tree still rides the real wire (chunking, checksums, chaos
+        tears) so every drill exercises the full transfer path. Shaped
+        ``(1, N)`` — ``kv_transfer`` bills ndim>=2 leaves (the paged
+        block convention), so even the stub's bytes are on the books."""
+        return {"tokens": np.asarray(
+            list(rec["prompt"]) + list(toks), np.int32).reshape(1, -1)}
+
+    def ingest_kv(self, rec: dict, tree: dict) -> int:
+        return 0  # nothing to warm; the pull outcome is the point
+
 
 class _EngineBackend:
     """A real :class:`serve.engine.ServingEngine` over the
@@ -118,13 +163,13 @@ class _EngineBackend:
     is bit-identical across replicas and coordinator lives."""
 
     def __init__(self, *, max_slots: int, max_seq_len: int,
-                 block_size: int, max_queue: int, tag: str) -> None:
-        import numpy as np
-
+                 block_size: int, max_queue: int, tag: str,
+                 model=None, params=None) -> None:
         from pytorch_distributed_nn_tpu.serve.engine import ServingEngine
 
         self._np = np
-        model, params = build_tiny_model()
+        if model is None:
+            model, params = build_tiny_model()
         self.engine = ServingEngine(
             model, params, max_slots=max_slots, max_seq_len=max_seq_len,
             block_size=block_size, max_queue=max_queue, tag=tag)
@@ -174,6 +219,40 @@ class _EngineBackend:
                 "num_blocks": pool.num_blocks,
                 "block_size": pool.block_size}
 
+    def export_kv(self, rec: dict, toks: list) -> dict:
+        """Host-side KV tree for the wire: the request's resident
+        prefix chain exported from this engine's block store
+        (:meth:`serve.engine.ServingEngine.export_blocks` — the same
+        source the threaded DisaggFleet streams from). Single-threaded
+        serve loop: nothing can evict between the chain match and the
+        export, so no pin window is needed here."""
+        tokens = np.asarray(list(rec["prompt"]) + list(toks), np.int32)
+        tree: dict = {"tokens": tokens}
+        pc = self.engine.prefix_cache
+        if pc is None:
+            return tree
+        adapter = int(rec.get("adapter", 0))
+        m = pc.resident_chain(tokens, adapter)
+        blocks = list(m.blocks)
+        if blocks:
+            tree["kv"] = self.engine.export_blocks(blocks)
+            tree["nblk"] = np.asarray(len(blocks), np.int32)
+        return tree
+
+    def ingest_kv(self, rec: dict, tree: dict) -> int:
+        """Warm this engine from a pulled wire tree: adopt prefix-cache
+        blocks for the shipped tokens and scatter the streamed rows in
+        (:meth:`serve.engine.ServingEngine.ingest_blocks`). Returns
+        blocks written; 0 means the decode leg prefills cold anyway —
+        warmth is an optimization, never a correctness input."""
+        if "kv" not in tree or self.engine.prefix_cache is None:
+            return 0
+        tokens = np.asarray(tree["tokens"], np.int32)
+        bs = self.engine.scheduler.pool.block_size
+        n = int(np.asarray(tree["nblk"]).reshape(-1)[0])
+        return int(self.engine.ingest_blocks(
+            tokens[:n * bs], tree["kv"], int(rec.get("adapter", 0))))
+
 
 def build_tiny_model():
     """The deterministic tiny decoder every process-backed replica
@@ -197,6 +276,46 @@ def build_tiny_model():
     params = model.init(jax.random.key(0),
                         jnp.zeros((1, 8), jnp.int32),
                         train=False)["params"]
+    return model, params
+
+
+def build_preset_model(preset: str, ckpt: str = ""):
+    """``--backend preset``: a REAL model from the named
+    :data:`config.PRESETS` entry, seed-0 params or an Orbax
+    params-tree checkpoint at ``ckpt`` (a ``StandardSave`` of the
+    params pytree — the serving analogue of the trainer's ``arrays``
+    item). Config validation is loud and names every available preset,
+    so a typo in a deploy script fails the worker at spawn with the
+    fix in the message, not with a silent stub."""
+    import jax
+    import jax.numpy as jnp
+
+    from pytorch_distributed_nn_tpu.config import PRESETS, get_config
+    from pytorch_distributed_nn_tpu.models import get_model
+
+    if not preset:
+        raise SystemExit(
+            "fleet-worker: --backend preset needs --preset NAME; "
+            f"available presets: {', '.join(sorted(PRESETS))}")
+    if preset not in PRESETS:
+        raise SystemExit(
+            f"fleet-worker: unknown --preset {preset!r}; available "
+            f"presets: {', '.join(sorted(PRESETS))}")
+    cfg = get_config(preset)
+    model = get_model(cfg.model)
+    params = model.init(jax.random.key(0),
+                        jnp.zeros((1, 8), jnp.int32),
+                        train=False)["params"]
+    if ckpt:
+        from pathlib import Path
+
+        import orbax.checkpoint as ocp
+
+        path = Path(ckpt).absolute()
+        if not path.exists():
+            raise SystemExit(
+                f"fleet-worker: --ckpt {ckpt!r} does not exist")
+        params = ocp.StandardCheckpointer().restore(path, target=params)
     return model, params
 
 
@@ -230,7 +349,42 @@ def _publish_done(ps, rec: dict, tokens: list, status: str,
     log.warning("giving up publishing %s after %d retries", key, retries)
 
 
+def _push_wire(ps, idx: int, rec: dict, toks: list, backend) -> None:
+    """Prefill leg completed: stream its KV tree to the store wire.
+
+    Called strictly AFTER :func:`_publish_done` — the done record is
+    the correctness commit; the wire is warmth. A death anywhere in
+    here (``kill_transfer@`` chaos fires inside ``kv_transfer``, a real
+    SIGKILL) is therefore exactly a crash after completion: the
+    coordinator's handoff proceeds from the done payload and the
+    decode leg pulls a dead wire — cold re-prefill, identical tokens."""
+    tree = backend.export_kv(rec, toks)
+    ctx = None
+    if "trace" in rec:  # Causeway: the transfer bills to this leg
+        ctx = trace.TraceContext.from_wire(rec["trace"])
+    kv_wire.push(ps, rec["request_id"], tree,
+                 src=f"r{idx}", dst="decode",
+                 src_index=idx, dst_index=-1,
+                 trace=ctx, tenant=rec.get("tenant", ""))
+
+
+def _pull_wire(ps, idx: int, rec: dict, backend, journal) -> None:
+    """Decode leg admitted: pull the prefill leg's KV tree and warm
+    this backend, or fall through cold. The warm/cold disposition is
+    journaled (counted write — a partitioned journal never blocks the
+    admission) so drills and ``obs_doctor`` can see which path ran."""
+    tree = kv_wire.pull(ps, rec["request_id"])
+    outcome = "warm" if tree is not None else "cold"
+    blocks = backend.ingest_kv(rec, tree) if tree is not None else 0
+    failure.store_call(
+        lambda: journal.append({
+            "event": "kv_pull", "request_id": rec["request_id"],
+            "replica": idx, "outcome": outcome, "blocks": blocks}),
+        op="worker_journal", deadline_s=1.0, fallback=None)
+
+
 def _serve_loop(args, ps, idx: int, reporter, backend) -> int:
+    journal = StoreJournal(ps, "journal")
     queue: list[dict] = []
     next_k = args.start_k
     draining = False
@@ -265,6 +419,11 @@ def _serve_loop(args, ps, idx: int, reporter, backend) -> int:
             # Causeway: stamp the admit time for this leg's decode
             # span before the backend owns the record
             trace.on_worker_admit(rec0, host=idx)
+            if rec0.get("stage") == "decode":
+                # warm from the handoff wire, or prefill cold — the
+                # pull is bounded (deadline + counted re-pulls), so
+                # a dead/torn wire can never wedge the admission
+                _pull_wire(ps, idx, rec0, backend, journal)
             backend.admit(rec0)
         progress, completed = backend.step()
         for rec, toks in progress:
@@ -277,7 +436,11 @@ def _serve_loop(args, ps, idx: int, reporter, backend) -> int:
                          op="worker_prog")
         for rec, toks, status in completed:
             trace.on_worker_done(rec, toks, status, host=idx)
+            # done FIRST, then the wire: the coordinator's handoff
+            # rests on the done record alone — see _push_wire
             _publish_done(ps, rec, toks, status)
+            if rec.get("stage") == "prefill" and status == "done":
+                _push_wire(ps, idx, rec, toks, backend)
         trace.maybe_publish(ps, rank=idx)
         meter.maybe_publish(ps, rank=idx)
         _publish(ps, f"gauge/{idx}", dict(
@@ -298,7 +461,20 @@ def _parse(argv=None) -> argparse.Namespace:
                    help="store endpoint, host:port")
     p.add_argument("--namespace", default="fleet")
     p.add_argument("--replica-index", type=int, required=True)
-    p.add_argument("--backend", choices=("stub", "tiny"), default="stub")
+    p.add_argument("--backend", choices=("stub", "tiny", "preset"),
+                   default="stub")
+    p.add_argument("--role", choices=("unified", "prefill", "decode"),
+                   default="unified",
+                   help="this replica's disaggregation pool — routing "
+                        "is the coordinator's job; the role drives the "
+                        "KV wire push (prefill) / pull (decode) and "
+                        "rides the enroll record")
+    p.add_argument("--preset", default="",
+                   help="config.PRESETS name for --backend preset "
+                        "(validated; the error names every preset)")
+    p.add_argument("--ckpt", default="",
+                   help="optional Orbax params checkpoint for "
+                        "--backend preset")
     p.add_argument("--max-slots", type=int, default=4)
     p.add_argument("--max-queue", type=int, default=64)
     p.add_argument("--max-seq-len", type=int, default=256)
@@ -341,10 +517,20 @@ def main(argv=None) -> int:
         backend = _StubBackend(max_slots=args.max_slots,
                                token_ms=args.token_ms)
     else:
+        model = params = None
+        if args.backend == "preset":
+            model, params = build_preset_model(args.preset, args.ckpt)
         backend = _EngineBackend(
             max_slots=args.max_slots, max_seq_len=args.max_seq_len,
             block_size=args.block_size, max_queue=args.max_queue,
-            tag=f"r{idx}")
+            tag=f"r{idx}", model=model, params=params)
+    # enrollment handshake: tell the coordinator who actually
+    # materialized behind this index — for a cross-host spawn
+    # (TemplateProvisioner) this record is the ONLY way it learns
+    # the pid/host; for a local spawn it is a harmless echo
+    _publish(ps, f"enroll/{idx}", dict(
+        pid=os.getpid(), host=socket.gethostname(), role=args.role),
+        op="worker_enroll")
     code = chaos.CRASH_EXIT_CODE
     try:
         code = _serve_loop(args, ps, idx, reporter, backend)

@@ -1,0 +1,410 @@
+"""Breakwater KV wire (ISSUE 18): the versioned, checksummed format
+KV blocks ride when a prefill->decode handoff crosses a *process*
+boundary through the native store.
+
+The in-process disaggregated fleet (:mod:`serve.disagg`) hands host
+arrays straight to the decode engine — the arrays ARE the wire. The
+process fleet cannot: the prefill worker and the decode worker share
+nothing but the coordination store, so the blocks must serialize into
+store records that can tear, stall, and vanish mid-transfer. This
+module is the ONE place that format exists (lint-enforced by
+tests/test_quality.py: no other serve file touches a ``kvwire/*``
+key), and it is robust by construction:
+
+- **key layout**: ``kvwire/<request_id>/<seq>`` chunk records plus a
+  ``kvwire/<request_id>/meta`` commit point written LAST — a reader
+  that sees meta knows every chunk landed at least once; a reader that
+  never sees meta within its deadline degrades, it does not wedge;
+- **chunk record**: a fixed ``!4sIIII`` header (magic ``KVW1``, wire
+  version, seq, CRC32 of the payload slice, slice length) followed by
+  the slice — torn writes and version skew are *detected*, loudly;
+- **every store op** on the transfer path goes through
+  :func:`runtime.failure.store_call` — deadline + exponential backoff
+  + seeded jitter, each failed attempt counted in
+  ``store_errors_total{op}`` and ``kv_wire_retries_total{op}`` (the
+  helper is the sole ``except OSError`` site on this path,
+  lint-enforced);
+- **torn chunks** (checksum mismatch, bad magic, or an injected
+  ``corrupt_wire@`` fault) trigger a bounded re-pull; exhaustion
+  degrades to ``None`` — the decode replica re-prefills cold and the
+  request finishes bit-identical, never wedged;
+- **accounting rides the existing fan-out**: :func:`push` feeds the
+  whole tree through :func:`ops.collectives.kv_transfer` once, so wire
+  bytes (CommRecorder + flight ring), tenant billing (Abacus), trace
+  context (Causeway), and the ``kill_transfer`` chaos hook all see a
+  cross-process transfer exactly as they see an in-process one.
+
+With chaos/meter/trace env unset the encoded bytes are byte-identical
+run to run (canonical sort_keys JSON meta, deterministic chunking) and
+this module writes nothing to the registry or the flight ring on the
+happy path — counters move only when a retry or a degradation actually
+happens.
+
+Stdlib + numpy only at import time (workers arm this before touching
+the backend); :mod:`ops.collectives` — and through it jax — imports
+lazily inside :func:`push`.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import struct
+import zlib
+
+import numpy as np
+
+from pytorch_distributed_nn_tpu.obs import flight
+from pytorch_distributed_nn_tpu.obs.registry import get_registry
+from pytorch_distributed_nn_tpu.runtime import chaos
+from pytorch_distributed_nn_tpu.runtime.failure import store_call
+
+log = logging.getLogger(__name__)
+
+MAGIC = b"KVW1"
+WIRE_VERSION = 1
+_HEADER = struct.Struct("!4sIIII")  # magic, version, seq, crc32, length
+
+# one store record per chunk; sized so a few chunks cover a tiny-model
+# handoff while real block tables still split (re-pull granularity)
+DEFAULT_CHUNK_BYTES = 1 << 18
+
+# a torn chunk re-pulls at most this many times before the pull
+# degrades to a cold re-prefill
+DEFAULT_MAX_REPULLS = 3
+
+
+class WireError(RuntimeError):
+    """Base for KV wire format violations."""
+
+
+class WireVersionError(WireError):
+    """Chunk or meta written by an incompatible wire version — loud on
+    purpose: version skew is an operator error, not a transient."""
+
+
+class TornChunkError(WireError):
+    """Chunk failed its checksum / header validation — the torn-write
+    shape :func:`pull` absorbs with a bounded re-pull."""
+
+
+def chunk_key(request_id: str, seq: int) -> str:
+    return f"kvwire/{request_id}/{seq}"
+
+
+def meta_key(request_id: str) -> str:
+    return f"kvwire/{request_id}/meta"
+
+
+def _count_retry(op: str) -> None:
+    get_registry().counter(
+        "kv_wire_retries_total",
+        "KV wire store ops retried on the transfer path",
+        labels=("op",)).inc(op=op)
+
+
+# ---------------------------------------------------------------------------
+# Pytree <-> bytes (spec + one concatenated payload)
+# ---------------------------------------------------------------------------
+
+
+def encode_tree(tree) -> tuple[dict, bytes]:
+    """Flatten a host pytree (dict/list/tuple of array-likes, Nones,
+    and JSON scalars) into a JSON-able spec plus one concatenated
+    payload. Leaves serialize as raw C-order bytes with their exact
+    dtype string (endianness included), so the round trip is
+    byte-identical."""
+    payload: list[bytes] = []
+
+    def enc(node):
+        if node is None:
+            return {"t": "n"}
+        if isinstance(node, dict):
+            keys = sorted(node)
+            return {"t": "d", "k": keys,
+                    "c": [enc(node[k]) for k in keys]}
+        if isinstance(node, (list, tuple)):
+            return {"t": "l" if isinstance(node, list) else "t",
+                    "c": [enc(x) for x in node]}
+        if isinstance(node, (bool, int, float, str)):
+            return {"t": "v", "v": node}
+        arr = np.ascontiguousarray(node)
+        spec = {"t": "a", "i": len(payload), "d": arr.dtype.str,
+                "s": list(arr.shape)}
+        payload.append(arr.tobytes())
+        return spec
+
+    return enc(tree), b"".join(payload)
+
+
+def decode_tree(spec: dict, payload: bytes):
+    """Inverse of :func:`encode_tree`."""
+    leaves: dict[int, tuple[str, list]] = {}
+
+    def scan(node):
+        if node["t"] == "a":
+            leaves[node["i"]] = (node["d"], node["s"])
+        elif node["t"] in ("d", "l", "t"):
+            for c in node["c"]:
+                scan(c)
+
+    scan(spec)
+    offsets: dict[int, int] = {}
+    off = 0
+    for i in sorted(leaves):
+        dtype, shape = leaves[i]
+        nbytes = int(np.dtype(dtype).itemsize * int(np.prod(shape,
+                                                            dtype=np.int64)))
+        offsets[i] = off
+        off += nbytes
+    if off != len(payload):
+        raise WireError(
+            f"payload length {len(payload)} does not match spec "
+            f"({off} bytes of leaves)")
+
+    def dec(node):
+        t = node["t"]
+        if t == "n":
+            return None
+        if t == "v":
+            return node["v"]
+        if t == "d":
+            return {k: dec(c) for k, c in zip(node["k"], node["c"])}
+        if t in ("l", "t"):
+            seq = [dec(c) for c in node["c"]]
+            return seq if t == "l" else tuple(seq)
+        dtype, shape = node["d"], node["s"]
+        nbytes = int(np.dtype(dtype).itemsize * int(np.prod(shape,
+                                                            dtype=np.int64)))
+        start = offsets[node["i"]]
+        arr = np.frombuffer(payload[start:start + nbytes],
+                            dtype=np.dtype(dtype))
+        return arr.reshape(shape).copy()
+
+    return dec(spec)
+
+
+# ---------------------------------------------------------------------------
+# Chunk records
+# ---------------------------------------------------------------------------
+
+
+def encode_chunk(seq: int, data: bytes) -> bytes:
+    """One ``kvwire/<req>/<seq>`` store record: header + payload
+    slice."""
+    return _HEADER.pack(MAGIC, WIRE_VERSION, seq,
+                        zlib.crc32(data) & 0xFFFFFFFF, len(data)) + data
+
+
+def decode_chunk(blob: bytes) -> tuple[int, bytes]:
+    """Validate and open one chunk record. Raises
+    :class:`TornChunkError` on torn/garbled bytes (retryable) and
+    :class:`WireVersionError` on a version-skewed peer (loud)."""
+    if len(blob) < _HEADER.size:
+        raise TornChunkError(f"chunk truncated at {len(blob)} bytes")
+    magic, version, seq, crc, length = _HEADER.unpack_from(blob)
+    if magic != MAGIC:
+        raise TornChunkError(f"bad chunk magic {magic!r}")
+    if version != WIRE_VERSION:
+        raise WireVersionError(
+            f"KV wire version mismatch: chunk is v{version}, this "
+            f"process speaks v{WIRE_VERSION} — upgrade the fleet in "
+            f"lockstep")
+    data = blob[_HEADER.size:]
+    if len(data) != length:
+        raise TornChunkError(
+            f"chunk {seq} torn: header says {length} bytes, "
+            f"got {len(data)}")
+    if zlib.crc32(data) & 0xFFFFFFFF != crc:
+        raise TornChunkError(f"chunk {seq} failed checksum")
+    return seq, data
+
+
+def split_chunks(payload: bytes,
+                 chunk_bytes: int = DEFAULT_CHUNK_BYTES) -> list[bytes]:
+    """Deterministic chunking: fixed-size slices, one (possibly empty)
+    chunk minimum so even an empty tree has a record to commit."""
+    if not payload:
+        return [b""]
+    return [payload[i:i + chunk_bytes]
+            for i in range(0, len(payload), chunk_bytes)]
+
+
+def join_chunks(chunks: dict[int, bytes], n: int) -> bytes:
+    """Order-independent reassembly: chunks arrive keyed by seq (pulls
+    may interleave and re-pull out of order); missing seq is loud."""
+    missing = [i for i in range(n) if i not in chunks]
+    if missing:
+        raise WireError(f"missing chunks {missing} of {n}")
+    return b"".join(chunks[i] for i in range(n))
+
+
+# ---------------------------------------------------------------------------
+# push / pull (the transfer path)
+# ---------------------------------------------------------------------------
+
+
+_ABANDON = object()  # push-internal: a write the deadline gave up on
+
+
+def push(store, request_id: str, tree, *, src: str = "prefill",
+         dst: str = "store", src_index: int = -1, dst_index: int = -1,
+         trace=None, tenant: str = "",
+         chunk_bytes: int = DEFAULT_CHUNK_BYTES,
+         deadline_s: float = 5.0, seed: int = 0):
+    """Serialize ``tree`` and commit it to the store under
+    ``kvwire/<request_id>/*``. Returns the meta record, or ``None``
+    when the store stayed unreachable past the deadline — the wire is
+    simply never committed (no meta) and the decode leg runs cold; a
+    partition degrades the push, it never kills the worker.
+
+    Ordering is the contract: the tree feeds
+    :func:`ops.collectives.kv_transfer` FIRST (wire bytes, tenant
+    billing, trace context, and the ``kill_transfer`` chaos hook all
+    fire before a byte lands — a killed transfer burned its bytes,
+    exactly like a real mid-push death), then every chunk, then meta
+    LAST as the commit point. Every store op goes through
+    :func:`runtime.failure.store_call`."""
+    from pytorch_distributed_nn_tpu.ops import collectives
+
+    spec, payload = encode_tree(tree)
+    collectives.kv_transfer(tree, src=src, dst=dst,
+                            src_index=src_index, dst_index=dst_index,
+                            trace=trace, tenant=tenant)
+    chunks = split_chunks(payload, chunk_bytes)
+    for seq, data in enumerate(chunks):
+        blob = encode_chunk(seq, data)
+        out = store_call(
+            lambda k=chunk_key(request_id, seq), b=blob: store.set(k, b),
+            op="kv_push", deadline_s=deadline_s, seed=seed,
+            on_retry=lambda: _count_retry("push"), fallback=_ABANDON)
+        if out is _ABANDON:
+            flight.record("kvwire", "push_abandoned",
+                          note=f"{request_id}: chunk {seq} unreachable "
+                               f"past {deadline_s:.1f}s — wire never "
+                               f"committed")
+            log.warning("kv_wire: %s push abandoned at chunk %d — "
+                        "decode leg will run cold", request_id, seq)
+            return None
+    meta = {"version": WIRE_VERSION, "chunks": len(chunks),
+            "bytes": len(payload),
+            "crc": zlib.crc32(payload) & 0xFFFFFFFF, "spec": spec}
+    out = store_call(
+        lambda: store.set(meta_key(request_id),
+                          json.dumps(meta, sort_keys=True).encode()),
+        op="kv_push_meta", deadline_s=deadline_s, seed=seed,
+        on_retry=lambda: _count_retry("push_meta"), fallback=_ABANDON)
+    if out is _ABANDON:
+        flight.record("kvwire", "push_abandoned",
+                      note=f"{request_id}: meta unreachable past "
+                           f"{deadline_s:.1f}s — wire never committed")
+        log.warning("kv_wire: %s push abandoned at meta — decode leg "
+                    "will run cold", request_id)
+        return None
+    return meta
+
+
+def pull(store, request_id: str, *, deadline_s: float = 2.0,
+         max_repulls: int = DEFAULT_MAX_REPULLS, seed: int = 0):
+    """Pull and decode ``kvwire/<request_id>/*``; ``None`` means the
+    wire is cold — the caller re-prefills, it never wedges.
+
+    Degradation ladder: meta absent past the (bounded) deadline ->
+    ``None``; a torn chunk (checksum, truncation, or an injected
+    ``corrupt_wire@``) re-pulls up to ``max_repulls`` times, then
+    ``None``; a version-skewed peer raises
+    :class:`WireVersionError` loudly (skew is operator error, not a
+    transient). Reassembly is order-independent by seq. Every
+    degradation lands a ``kvwire`` flight event so the drill's
+    disposition is visible post-mortem."""
+    raw = store_call(
+        lambda: store.get(meta_key(request_id),
+                          timeout_ms=int(deadline_s * 250)),
+        op="kv_pull_meta", deadline_s=deadline_s, seed=seed,
+        on_retry=lambda: _count_retry("pull_meta"), fallback=None)
+    if raw is None:
+        flight.record("kvwire", "cold_fallback",
+                      note=f"{request_id}: meta absent past deadline")
+        log.warning("kv_wire: %s meta absent past %.1fs deadline — "
+                    "cold re-prefill", request_id, deadline_s)
+        return None
+    meta = json.loads(raw.decode())
+    if meta.get("version") != WIRE_VERSION:
+        raise WireVersionError(
+            f"KV wire version mismatch: meta is "
+            f"v{meta.get('version')}, this process speaks "
+            f"v{WIRE_VERSION} — upgrade the fleet in lockstep")
+    got: dict[int, bytes] = {}
+    for seq in range(int(meta["chunks"])):
+        data = None
+        for attempt in range(1 + max_repulls):
+            blob = store_call(
+                lambda k=chunk_key(request_id, seq): store.get(
+                    k, timeout_ms=int(deadline_s * 250)),
+                op="kv_pull", deadline_s=deadline_s, seed=seed,
+                on_retry=lambda: _count_retry("pull"), fallback=None)
+            if blob is None:
+                continue  # absent/unreachable counts against repulls
+            try:
+                rseq, data = decode_chunk(blob)
+            except TornChunkError as e:
+                flight.record("kvwire", "torn_chunk",
+                              note=f"{request_id}/{seq}: {e} "
+                                   f"(attempt {attempt + 1})")
+                data = None
+                continue
+            if rseq != seq:
+                flight.record("kvwire", "torn_chunk",
+                              note=f"{request_id}/{seq}: header says "
+                                   f"seq {rseq}")
+                data = None
+                continue
+            if chaos.on_wire_chunk(seq):
+                # injected tear: identical disposition to a real one
+                data = None
+                continue
+            break
+        if data is None:
+            flight.record("kvwire", "cold_fallback",
+                          note=f"{request_id}: chunk {seq} torn after "
+                               f"{1 + max_repulls} pulls")
+            log.warning("kv_wire: %s chunk %d unrecoverable after %d "
+                        "pulls — cold re-prefill", request_id, seq,
+                        1 + max_repulls)
+            return None
+        got[seq] = data
+    payload = join_chunks(got, int(meta["chunks"]))
+    if zlib.crc32(payload) & 0xFFFFFFFF != int(meta["crc"]) \
+            or len(payload) != int(meta["bytes"]):
+        flight.record("kvwire", "cold_fallback",
+                      note=f"{request_id}: reassembled payload failed "
+                           f"whole-transfer checksum")
+        log.warning("kv_wire: %s reassembled payload failed checksum "
+                    "— cold re-prefill", request_id)
+        return None
+    return decode_tree(meta["spec"], payload)
+
+
+def cleanup(store, request_id: str, *, deadline_s: float = 1.0,
+            seed: int = 0) -> None:
+    """Best-effort wire GC after a successful ingest: drop the chunk
+    records and meta so the store does not accumulate dead blocks. A
+    partition here is absorbed (counted) and abandoned — GC must never
+    block serving."""
+    raw = store_call(
+        lambda: store.get(meta_key(request_id), timeout_ms=50),
+        op="kv_gc", deadline_s=deadline_s, seed=seed, fallback=None)
+    if raw is None:
+        return
+    try:
+        n = int(json.loads(raw.decode()).get("chunks", 0))
+    except (ValueError, UnicodeDecodeError):
+        n = 0
+    for seq in range(n):
+        store_call(
+            lambda k=chunk_key(request_id, seq): store.delete(k),
+            op="kv_gc", deadline_s=deadline_s, seed=seed,
+            fallback=None)
+    store_call(lambda: store.delete(meta_key(request_id)),
+               op="kv_gc", deadline_s=deadline_s, seed=seed,
+               fallback=None)

@@ -41,6 +41,33 @@ the fleet:
   a window, which both sides absorb as counted retries
   (``store_errors_total{op}``).
 
+Breakwater (ISSUE 18) adds disaggregation and cross-host provisioning:
+
+- **roles** — replicas spawn ``role=prefill|decode|unified``
+  (``fleet_worker --role``); the ``members`` record carries the role,
+  the ``serve_fleet_replicas{role}`` gauge tracks READY counts per
+  pool, and the UNMODIFIED :meth:`serve.router.Router.place` routes
+  stage-aware over the store-fed gauges;
+- **cross-process KV handoff** — a finished prefill leg pushes its KV
+  state through :mod:`serve.kv_wire` (versioned, checksummed
+  ``kvwire/<req>/<seq>`` chunks; every store op counted-retried), the
+  coordinator's :class:`_TransferPump` thread places the decode leg
+  while the transfer is still in flight (the poll loop never blocks on
+  a wire), and the decode worker's bounded pull degrades to a cold
+  re-prefill on a dead wire — stitched output bit-identical either
+  way, never a wedged request;
+- **per-pool Helm** — ``scale_to(n, pool=)`` grows/drains one role's
+  pool; :meth:`scalable_pools` / :meth:`pool_target` feed
+  :meth:`serve.autoscale.FleetAutoscaler.step_all`, so prefill
+  queue-depth pressure scales the prefill pool and the journaled
+  decision carries the pool;
+- **provisioning** — :class:`ProcessFleetProvisioner` hooks the spawn:
+  the default :class:`LocalProvisioner` keeps ``subprocess.Popen``;
+  :class:`TemplateProvisioner` formats a spawn-command template (e.g.
+  ``ssh host {cmd}``) and the coordinator learns the worker's pid/host
+  from the ``enroll/<idx>`` store handshake instead of the child
+  handle.
+
 Same lint-enforced contracts as the thread fleet: every replica state
 change goes through :meth:`ProcessFleet._set_state` (counted +
 flight-visible), every placement through the shared
@@ -53,6 +80,8 @@ import itertools
 import json
 import logging
 import os
+import queue
+import shlex
 import subprocess
 import sys
 import threading
@@ -66,6 +95,7 @@ from pytorch_distributed_nn_tpu.obs import flight, meter, trace, watchtower
 from pytorch_distributed_nn_tpu.obs.registry import get_registry
 from pytorch_distributed_nn_tpu.runtime import chaos, failure
 from pytorch_distributed_nn_tpu.serve import autoscale as _autoscale
+from pytorch_distributed_nn_tpu.serve import kv_wire
 from pytorch_distributed_nn_tpu.serve.router import (
     DEAD,
     DRAINING,
@@ -102,6 +132,13 @@ class ProcTicket:
         self.prefix: list[int] = []
         self.failovers: list[dict] = []
         self.life = 0  # placement generation; workers echo it back
+        # disaggregated leg: "" (unified), "prefill", or "decode";
+        # the handoff flips prefill -> decode after the first token
+        self.stage = ""
+        # True while the transfer pump owns placement (between the
+        # handoff and the pump's place attempt) — _retry_unplaced must
+        # not double-dispatch a leg the pump is about to place
+        self.pumping = False
         self.status = "pending"  # pending | done | rejected | failed
         self.reject_reason = ""
         self.tokens: Optional[np.ndarray] = None
@@ -155,7 +192,8 @@ class ProcReplica:
     by :meth:`ProcessFleet._set_state` (the fleet.py lint contract)."""
 
     def __init__(self, index: int, policy: RestartPolicy,
-                 max_queue: int, max_slots: int) -> None:
+                 max_queue: int, max_slots: int,
+                 role: str = "unified") -> None:
         self.index = index
         self.name = f"p{index}"
         self.policy = policy
@@ -167,19 +205,179 @@ class ProcReplica:
         self.restart_at: Optional[float] = None
         self.stop_reason = ""
         self.retiring = False
-        # the process fleet keeps unified replicas: disaggregated
-        # prefill/decode pools (serve/disagg.py) are thread-fleet only
-        # until the store protocol carries a KV-block wire format
-        self.role = "unified"
+        # disaggregated pool membership: the router's stage-aware
+        # place() reads this straight off the handle
+        self.role = role
+        # provisioned on another host (TemplateProvisioner): no child
+        # handle — pid/host arrive through the enroll/<idx> handshake
+        # and liveness is the heartbeat detector's job
+        self.remote = False
+        self.host = ""
         self.adopted = False  # inherited live from a dead coordinator
         self.spawned_at = time.monotonic()
         self.gauge_round = -1
+
+
+class ProcessFleetProvisioner:
+    """Spawn hook: how one replica worker process comes to exist.
+
+    The coordinator builds the worker command + env (the
+    ``worker_env`` contract) and hands them here. :meth:`spawn`
+    returns the child ``Popen`` when the coordinator owns the process
+    directly, or ``None`` for a remotely-provisioned worker — the
+    coordinator then learns its pid/host from the worker's own
+    ``enroll/<idx>`` store write (the enrollment handshake) and
+    supervises it purely over heartbeats."""
+
+    #: True when spawned workers are not this coordinator's children
+    remote = False
+
+    def spawn(self, handle, cmd: list, env: dict):
+        raise NotImplementedError
+
+    def close(self) -> None:
+        """Release any wrapper processes the provisioner holds."""
+
+
+class LocalProvisioner(ProcessFleetProvisioner):
+    """The default: plain ``subprocess.Popen`` on this host."""
+
+    def spawn(self, handle, cmd: list, env: dict):
+        return subprocess.Popen(cmd, env=env)
+
+
+class TemplateProvisioner(ProcessFleetProvisioner):
+    """Cross-host spawn through a command template: ``{cmd}`` expands
+    to the shell-quoted worker command, ``{index}``/``{role}`` to the
+    replica's. ``"ssh host {cmd}"`` enrolls a worker on another box;
+    ``"{cmd}"`` runs locally but still exercises the full enrollment
+    handshake (the drill shape). The wrapper process (ssh, shell) is
+    NOT the worker — the coordinator never reads its pid; liveness is
+    heartbeats and identity is ``enroll/<idx>``."""
+
+    remote = True
+
+    def __init__(self, template: str) -> None:
+        if "{cmd}" not in template:
+            raise ValueError(
+                f"spawn template needs a {{cmd}} placeholder, got "
+                f"{template!r}")
+        self.template = template
+        self._wrappers: list[subprocess.Popen] = []
+
+    def spawn(self, handle, cmd: list, env: dict):
+        line = self.template.format(
+            cmd=" ".join(shlex.quote(c) for c in cmd),
+            index=handle.index, role=handle.role)
+        self._wrappers.append(
+            subprocess.Popen(line, shell=True, env=env))
+        return None
+
+    def close(self) -> None:
+        for p in self._wrappers:
+            if p.poll() is None:
+                p.terminate()
+        for p in self._wrappers:
+            try:
+                p.wait(timeout=5.0)
+            except subprocess.TimeoutExpired:
+                p.kill()
+        self._wrappers.clear()
+
+
+class _TransferPump:
+    """The coordinator's transfer-overlap thread (Breakwater): places
+    a handed-off decode leg and watches the KV wire WITHOUT ever
+    blocking the poll loop.
+
+    Owns its own store connection (a blocking native get occupies its
+    connection — the poll loop's client must stay free) and emits its
+    own flight-ring events (``pump:enqueue`` / ``pump:place`` /
+    ``pump:ready`` / ``pump:nometa``), which is how a drill proves the
+    poll loop and the transfer overlapped. The decode leg is placed
+    IMMEDIATELY — admission on the decode replica proceeds while the
+    prefill worker's push is still in flight; the worker's own bounded
+    :func:`serve.kv_wire.pull` decides warm vs cold at admit time. The
+    meta watch afterwards is pure disposition: ``pump:ready`` when the
+    commit point landed, ``pump:nometa`` when the wire went dead (the
+    decode leg re-prefills cold — already placed, never wedged)."""
+
+    def __init__(self, fleet: "ProcessFleet",
+                 wire_deadline_s: float = 2.0) -> None:
+        self._fleet = fleet
+        self._wire_deadline = wire_deadline_s
+        self._client = make_store(fleet.store_endpoint)
+        self._ns = PrefixStore(self._client, fleet.namespace)
+        self._q: queue.Queue = queue.Queue()
+        self._stop = threading.Event()
+        self.events = 0  # pump flight events emitted (drill assert)
+        self._thread = threading.Thread(
+            target=self._run, name="procfleet-pump", daemon=True)
+        self._thread.start()
+
+    def _emit(self, kind: str, note: str) -> None:
+        flight.record("fleet", f"pump:{kind}", note=note)
+        self.events += 1
+
+    def enqueue(self, ticket: "ProcTicket", src: int) -> None:
+        self._emit("enqueue", f"{ticket.request_id} src=r{src}")
+        self._q.put((ticket, src))
+
+    def _run(self) -> None:
+        while not self._stop.is_set():
+            try:
+                ticket, src = self._q.get(timeout=0.05)
+            except queue.Empty:
+                continue
+            try:
+                self._pump_one(ticket, src)
+            except Exception:
+                log.exception("transfer pump failed for %s",
+                              ticket.request_id)
+
+    def _pump_one(self, t: "ProcTicket", src: int) -> None:
+        with self._fleet._lock:
+            if self._fleet.dead:
+                return  # adoption replays the handoff, not this pump
+            if not t.done.is_set():
+                placed = self._fleet._place(t)
+                where = ("r%d" % placed if placed is not None
+                         else "pending")
+                self._emit("place", f"{t.request_id} -> {where}")
+            t.pumping = False  # _retry_unplaced may take over now
+        # disposition watch: bounded wait for the wire's commit point,
+        # counted retries through the one helper, never raises
+        raw = failure.store_call(
+            lambda: self._ns.get(kv_wire.meta_key(t.request_id),
+                                 timeout_ms=200),
+            op="pump_watch", deadline_s=self._wire_deadline,
+            fallback=None)
+        if raw is not None:
+            self._emit("ready", f"{t.request_id} wire committed")
+        else:
+            self._emit("nometa",
+                       f"{t.request_id} wire dead past "
+                       f"{self._wire_deadline:.1f}s — decode leg "
+                       f"runs cold")
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5.0)
+        try:
+            self._client.close()
+        except OSError:
+            pass
 
 
 class ProcessFleet:
     """N replica subprocesses behind one (replaceable) coordinator."""
 
     def __init__(self, *, replicas: int = 2, backend: str = "stub",
+                 prefill: int = 0, decode: int = 0,
+                 role: str = "unified",
+                 provisioner: Optional[ProcessFleetProvisioner] = None,
+                 wire_deadline_s: float = 2.0,
+                 preset: str = "", ckpt: str = "",
                  namespace: str = "fleet",
                  store_endpoint: Optional[str] = None,
                  server=None,
@@ -204,7 +402,20 @@ class ProcessFleet:
                  recover: bool = False) -> None:
         if replicas < 1:
             raise ValueError(f"replicas must be >= 1, got {replicas}")
+        if (prefill > 0) != (decode > 0):
+            raise ValueError(
+                "disaggregated process fleet needs BOTH prefill>=1 "
+                f"and decode>=1, got prefill={prefill} decode={decode}")
+        if role not in ("unified", "prefill", "decode"):
+            raise ValueError(
+                f"role must be unified|prefill|decode, got {role!r}")
         self.backend = backend
+        self.preset = preset
+        self.ckpt = ckpt
+        self.disagg = prefill > 0 and decode > 0
+        self.role = role  # the non-disagg pool's role (fleet_deploy)
+        self._provisioner = provisioner or LocalProvisioner()
+        self._wire_deadline = wire_deadline_s
         self.namespace = namespace
         self.metrics = metrics
         self._max_slots = max_slots
@@ -264,6 +475,11 @@ class ProcessFleet:
             "fleet_coordinator_recovered_total",
             "recovery dispositions (replicas adopted/respawned, "
             "requests finalized/readmitted)", labels=("outcome",))
+        # same name/labels serve/disagg.py registers — the registry
+        # get-or-creates by name, so both fleets share the instrument
+        self._g_role_replicas = reg.gauge(
+            "serve_fleet_replicas", "READY replicas by role",
+            labels=("role",))
         mode = "recover" if recover else "fresh"
         self.incarnation = self._ns.add("coord/inc", 1) - 1
         self.gap_s = 0.0
@@ -311,16 +527,37 @@ class ProcessFleet:
                 scaler.resume_from(self.helm_journal.read_all())
             self._helm = _autoscale.FleetAutoscaler(self, scaler)
 
+        # transfer pump: exists before recovery so a replayed handoff
+        # has somewhere to land its decode leg
+        self._pump = _TransferPump(self, wire_deadline_s)
+
         if recover:
             self._recover_members()
+            # disagg is a property of the fleet the journal describes,
+            # not of the successor's constructor args
+            self.disagg = any(h.role in ("prefill", "decode")
+                              for h in self._replicas)
             self._refresh_gauges()  # promotes adopted live replicas
             self._recover_tickets()
             self._target_replicas = len(
                 [h for h in self._replicas if not h.retiring]) or 1
+            self._pool_targets = {
+                pool: len([h for h in self._replicas
+                           if h.role == pool and not h.retiring]) or 1
+                for pool in ("prefill", "decode")} if self.disagg \
+                else {}
+        elif self.disagg:
+            for _ in range(prefill):
+                self._spawn_new(reason="init", role="prefill")
+            for _ in range(decode):
+                self._spawn_new(reason="init", role="decode")
+            self._target_replicas = prefill + decode
+            self._pool_targets = {"prefill": prefill, "decode": decode}
         else:
             for _ in range(replicas):
-                self._spawn_new(reason="init")
+                self._spawn_new(reason="init", role=self.role)
             self._target_replicas = replicas
+            self._pool_targets = {}
         self._write_members()
         self._rebuild_detector()
 
@@ -356,13 +593,15 @@ class ProcessFleet:
         slot's keys can't alias a newer replica's."""
         return self._ns.add("ridx", 1) - 1
 
-    def _new_handle(self, index: int) -> ProcReplica:
+    def _new_handle(self, index: int,
+                    role: str = "unified") -> ProcReplica:
         return ProcReplica(index,
                            RestartPolicy(seed=index, **self._policy_kw),
-                           self._max_queue, self._max_slots)
+                           self._max_queue, self._max_slots, role=role)
 
-    def _spawn_new(self, *, reason: str) -> ProcReplica:
-        h = self._new_handle(self._alloc_index())
+    def _spawn_new(self, *, reason: str,
+                   role: str = "unified") -> ProcReplica:
+        h = self._new_handle(self._alloc_index(), role=role)
         self._replicas.append(h)
         self._set_state(h, STARTING, reason=reason)
         self._launch(h)
@@ -384,6 +623,12 @@ class ProcessFleet:
                # a restarted index resumes the dispatch stream where
                # the store counter left it, not at zero
                "--start-k", str(self._ns.add(f"reqn/{h.index}", 0))]
+        if h.role != "unified":
+            cmd += ["--role", h.role]
+        if self.preset:
+            cmd += ["--preset", self.preset]
+        if self.ckpt:
+            cmd += ["--ckpt", self.ckpt]
         if self._progress_window is not None:
             cmd += ["--progress-window", str(self._progress_window)]
         env = worker_env(
@@ -392,17 +637,38 @@ class ProcessFleet:
             progress_timeout_s=self._progress_window,
             flight_dir=self._flight_dir,
             extra=self._worker_extra_env)
-        h.proc = subprocess.Popen(cmd, env=env)
-        h.pid = h.proc.pid
+        proc = self._provisioner.spawn(h, cmd, env)
+        if proc is not None:
+            h.proc = proc
+            h.pid = proc.pid
+            h.remote = False
+        else:
+            # remotely provisioned: pid/host arrive via the
+            # enroll/<idx> handshake; heartbeats own liveness
+            h.proc = None
+            h.pid = None
+            h.remote = True
         h.incarnations += 1
         h.restart_at = None
         h.spawned_at = time.monotonic()
         h.gauge_round = -1
 
     def _write_members(self) -> None:
-        members = [{"index": h.index, "pid": h.pid,
-                    "retiring": h.retiring}
-                   for h in self._replicas if h.state != DEAD]
+        # role/host keys ABSENT for unified local replicas so a
+        # pre-disagg fleet's members record stays byte-identical
+        members = []
+        for h in self._replicas:
+            if h.state == DEAD:
+                continue
+            m = {"index": h.index, "pid": h.pid,
+                 "retiring": h.retiring}
+            if h.role != "unified":
+                m["role"] = h.role
+            if h.remote:
+                m["remote"] = True
+                if h.host:
+                    m["host"] = h.host
+            members.append(m)
         try:
             self._ns.set("members",
                          json.dumps(members, sort_keys=True).encode())
@@ -421,6 +687,11 @@ class ProcessFleet:
         fall back to an existence probe."""
         if h.proc is not None:
             return h.proc.poll()
+        if h.remote:
+            # another host's process: no waitpid, no signal 0 — the
+            # heartbeat detector (and the STARTING join timeout)
+            # declare a remote worker dead, never this probe
+            return None
         if h.pid is None:
             return chaos.CRASH_EXIT_CODE
         try:
@@ -454,9 +725,11 @@ class ProcessFleet:
         ages = probe.last_beat_ages()
         for m in members:
             idx = int(m["index"])
-            h = self._new_handle(idx)
+            h = self._new_handle(idx, role=m.get("role", "unified"))
             h.pid = int(m["pid"]) if m.get("pid") else None
             h.retiring = bool(m.get("retiring"))
+            h.remote = bool(m.get("remote"))
+            h.host = m.get("host", "")
             age = ages.get(idx)
             beating = age is not None and age <= self._hb_timeout
             if beating and self._proc_exit_code(h) is None:
@@ -472,7 +745,7 @@ class ProcessFleet:
                 adopted += 1
             elif not h.retiring:
                 self._c_recovered.inc(outcome="respawned")
-                self._spawn_new(reason="recover_respawn")
+                self._spawn_new(reason="recover_respawn", role=h.role)
                 respawned += 1
         self.recovery.update(adopted=adopted, respawned=respawned)
         log.info("procfleet recover: adopted %d, respawned %d "
@@ -493,6 +766,15 @@ class ProcessFleet:
                     t.assigned = int(rec["replica"])
                     t.life = int(rec.get("life", 0))
                     t.prefix = [int(x) for x in rec.get("prefix", [])]
+                    t.stage = rec.get("stage", t.stage)
+            elif ev == "handoff":
+                t = tickets.get(rec["request_id"])
+                if t is not None:
+                    t.stage = "decode"
+                    t.assigned = None
+                    t.life = int(rec.get("life", t.life))
+                    t.prefix = [int(x) for x in rec.get("prefix",
+                                                        t.prefix)]
             elif ev == "final":
                 tickets.pop(rec["request_id"], None)
         self._tickets = tickets
@@ -505,8 +787,10 @@ class ProcessFleet:
             payload = self._read_done(t)
             if payload is not None:
                 # finished during the gap: stitch from the store, no
-                # token ever re-decoded
-                self._finalize_from_payload(t, payload)
+                # token ever re-decoded. A prefill leg's done payload
+                # is a handoff, not a finish — mid-handoff is exactly
+                # where the kill_coordinator drill lands
+                self._on_done_payload(t, payload)
                 self._c_recovered.inc(outcome="finalized")
                 finalized += 1
                 continue
@@ -566,10 +850,22 @@ class ProcessFleet:
     def _place(self, ticket: ProcTicket) -> Optional[int]:
         """One placement attempt through the shared router choke
         point; journal-then-dispatch. Returns the replica index, None
-        when nothing is READY (ticket stays pending)."""
-        remaining = ticket.max_new_tokens - len(ticket.prefix)
+        when nothing is READY (ticket stays pending).
+
+        Disaggregated fleets place in two legs through the UNMODIFIED
+        stage-aware router: the prefill leg gets a budget of 1 (emit
+        the first token, push the KV wire, retire), the decode leg the
+        remainder — same shape as :meth:`serve.disagg.DisaggFleet`,
+        but over store-fed gauges and the cross-process wire."""
+        if self.disagg and not ticket.stage:
+            ticket.stage = "prefill"
+        if ticket.stage == "prefill":
+            remaining = 1
+        else:
+            remaining = ticket.max_new_tokens - len(ticket.prefix)
         total = len(ticket.prompt) + len(ticket.prefix) + remaining
-        h = self.router.place(self._replicas, total)
+        h = self.router.place(self._replicas, total,
+                              stage=ticket.stage or None)
         if h is None:
             ticket.assigned = None
             return None
@@ -577,6 +873,10 @@ class ProcessFleet:
                "prompt": ticket.prompt + ticket.prefix,
                "max_new_tokens": remaining,
                "life": ticket.life}
+        # stage key ABSENT on a unified fleet so the dispatch wire
+        # stays byte-identical to the pre-disagg protocol
+        if ticket.stage:
+            rec["stage"] = ticket.stage
         # Causeway (obs/trace.py, lint-pinned): the trace context rides
         # the dispatch record to the worker process — key ABSENT when
         # unarmed so the wire bytes are unchanged byte-for-byte
@@ -588,10 +888,13 @@ class ProcessFleet:
         if ticket.tenant != "default":
             rec["tenant"] = ticket.tenant
         try:
-            self.journal.append({
+            place_rec = {
                 "event": "place", "request_id": ticket.request_id,
                 "replica": h.index, "life": ticket.life,
-                "prefix": ticket.prefix})
+                "prefix": ticket.prefix}
+            if ticket.stage:
+                place_rec["stage"] = ticket.stage
+            self.journal.append(place_rec)
             k = self._ns.add(f"reqn/{h.index}", 1) - 1
             self._ns.set(f"req/{h.index}/{k}",
                          json.dumps(rec, sort_keys=True).encode())
@@ -645,6 +948,7 @@ class ProcessFleet:
         supervision stop, worker PROCESSES are left running — exactly
         the wreckage :meth:`recover_from` must take over."""
         self.dead = True
+        self._pump.stop()
         flight.record("fleet", "coordinator_down",
                       note=f"inc={self.incarnation} {reason}")
         log.warning("procfleet coordinator %d down: %s",
@@ -670,6 +974,7 @@ class ProcessFleet:
             try:
                 self._ns.set("coord/beat", repr(time.time()).encode())
                 self._refresh_gauges()
+                self._check_enrollment()
                 self._check_exits()
                 self._check_stale()
                 self._restart_due()
@@ -677,8 +982,7 @@ class ProcessFleet:
                 self._check_progress()
                 self._reap_retiring()
                 if self._helm is not None:
-                    d = self._helm.step()
-                    if d is not None:
+                    for d in self._helm.step_all():
                         self.helm_journal.append_line(d.as_json())
             except (OSError, TimeoutError):
                 # partition window: absorb, retry next tick
@@ -707,6 +1011,51 @@ class ProcessFleet:
                 # join gate: a worker publishing gauges is live and
                 # serving — routable from here on
                 self._set_state(h, READY, reason="join:gauge")
+        self._publish_roles()
+
+    def _publish_roles(self) -> None:
+        """Refresh ``serve_fleet_replicas{role}`` from the live set.
+
+        Same store-fed gauges the router places over: a role's count is
+        its READY handles, so the gauge and ``Router.place(stage=)``
+        can never disagree about pool capacity."""
+        counts = {"unified": 0, "prefill": 0, "decode": 0}
+        for h in self._replicas:
+            if h.state == READY:
+                counts[h.role] = counts.get(h.role, 0) + 1
+        for role, n in counts.items():
+            self._g_role_replicas.set(float(n), role=role)
+
+    def _check_enrollment(self) -> None:
+        """Complete the cross-host handshake for remote spawns.
+
+        A :class:`TemplateProvisioner` launch returns no ``Popen`` —
+        the worker materializes on another host and announces itself by
+        writing ``enroll/<index>`` (pid + host) into the shared store.
+        Until that record lands the handle has no pid and
+        ``_proc_exit_code`` reports nothing; liveness is governed by
+        the join timeout and heartbeats, exactly like a local worker
+        whose process object was lost to a coordinator crash."""
+        for h in self._replicas:
+            if not h.remote or h.pid is not None or h.state == DEAD:
+                continue
+            try:
+                if not self._ns.check(f"enroll/{h.index}"):
+                    continue
+                rec = json.loads(self._ns.get(
+                    f"enroll/{h.index}", timeout_ms=500).decode())
+            except (OSError, TimeoutError, ValueError):
+                failure.count_store_error("coord_enroll")
+                continue
+            h.pid = int(rec.get("pid", 0)) or None
+            h.host = str(rec.get("host", ""))
+            flight.record("fleet", "enroll",
+                          note=f"r{h.index} pid={h.pid} host={h.host}")
+            self.journal.append_line(json.dumps({
+                "event": "enroll", "replica": h.index,
+                "pid": h.pid, "host": h.host, "role": h.role,
+            }, sort_keys=True))
+            self._write_members()
 
     def _check_exits(self) -> None:
         for h in self._replicas:
@@ -772,7 +1121,10 @@ class ProcessFleet:
         for t in stranded:
             payload = self._read_done(t)
             if payload is not None:  # it actually finished first
-                self._finalize_from_payload(t, payload)
+                # a prefill leg that published done before dying
+                # (kill_transfer mid-push) hands off — the decode leg
+                # pulls a dead wire and re-prefills cold
+                self._on_done_payload(t, payload)
                 continue
             self._readmit(t, self._read_prog(t), from_replica=h.index,
                           t_detect=t_detect, reason=reason)
@@ -821,6 +1173,11 @@ class ProcessFleet:
                  reason: str) -> None:
         t.prefix.extend(emitted)
         t.life += 1
+        # a prefill leg that already banked its first token re-admits
+        # as a decode leg (there is nothing left to prefill); its pull
+        # finds no wire and re-prefills cold on the decode replica
+        if t.stage == "prefill" and t.prefix:
+            t.stage = "decode"
         # Causeway: the re-admitted life is a child leg of the same
         # trace — linked to the original, never a fresh trace_id
         nxt = trace.on_resubmit(t.trace)
@@ -865,21 +1222,75 @@ class ProcessFleet:
 
     def _retry_unplaced(self) -> None:
         for t in self._tickets.values():
-            if not t.done.is_set() and t.assigned is None:
+            if (not t.done.is_set() and t.assigned is None
+                    and not t.pumping):
                 self._place(t)
 
     def _check_progress(self) -> None:
-        """Finalize finished requests; stamp first-token times."""
+        """Finalize finished requests; stamp first-token times. A
+        prefill leg's done payload routes to the handoff instead."""
         for t in list(self._tickets.values()):
             if t.done.is_set() or t.assigned is None:
                 continue
             payload = self._read_done(t)
             if payload is not None:
-                self._finalize_from_payload(t, payload)
+                self._on_done_payload(t, payload)
                 continue
             if t.t_first_token == 0.0 and (t.prefix
                                            or self._read_prog(t)):
                 t.t_first_token = time.monotonic()
+
+    def _on_done_payload(self, t: ProcTicket, payload: dict) -> None:
+        """Route one life-matched ``done/<rid>`` payload: a completed
+        prefill leg hands off to the decode pool; anything else
+        finalizes. The ONE junction all three readers use
+        (:meth:`_check_progress`, :meth:`_fail_replica`,
+        :meth:`_recover_tickets`) so a drill can land the death at any
+        of them and take the same path."""
+        if (t.stage == "prefill"
+                and payload.get("status", "done") == "done"):
+            self._handoff(t, payload)
+        else:
+            self._finalize_from_payload(t, payload)
+
+    def _handoff(self, t: ProcTicket, payload: dict) -> None:
+        """Prefill -> decode handoff: bank the first token, journal
+        the boundary, and hand the decode leg to the transfer pump —
+        placement and the KV wire watch happen on the pump thread, so
+        this (poll-loop) path never blocks on a transfer."""
+        tail = [int(x) for x in payload.get("tokens", [])]
+        src = t.assigned if t.assigned is not None else -1
+        t.prefix.extend(tail)
+        if t.t_first_token == 0.0 and t.prefix:
+            t.t_first_token = time.monotonic()
+        # EOS-on-first-token or a budget of 1: nothing left to decode
+        if not tail or len(t.prefix) >= t.max_new_tokens:
+            self._finalize_from_payload(
+                t, {"life": t.life, "status": "done", "tokens": []})
+            return
+        t.life += 1
+        t.stage = "decode"
+        t.assigned = None
+        # Causeway: the decode leg is a child leg of the same trace
+        nxt = trace.on_resubmit(t.trace)
+        if nxt is not None:
+            t.trace = nxt
+        failure.store_call(
+            lambda: self.journal.append({
+                "event": "handoff", "request_id": t.request_id,
+                "from_replica": src, "life": t.life,
+                "prefix": t.prefix}),
+            op="coord_journal", deadline_s=1.0, fallback=None)
+        flight.record("fleet", "handoff",
+                      note=f"{t.request_id} r{src}->decode "
+                           f"prefix={len(t.prefix)}")
+        if self.metrics is not None:
+            self.metrics.emit("fleet_handoff",
+                              request_id=t.request_id,
+                              from_replica=src,
+                              prefix_tokens=len(t.prefix))
+        t.pumping = True
+        self._pump.enqueue(t, src)
 
     def _finalize_from_payload(self, t: ProcTicket,
                                payload: dict) -> None:
@@ -915,27 +1326,39 @@ class ProcessFleet:
         except (OSError, TimeoutError):
             failure.count_store_error("coord_journal")
         self._tickets.pop(t.request_id, None)
+        if t.stage:
+            # best-effort wire GC: the decode leg is finalized, the
+            # kvwire/* records are dead weight in the store
+            kv_wire.cleanup(self._ns, t.request_id)
         t.done.set()
 
     # -- elastic scaling -------------------------------------------------
 
-    def scale_to(self, n: int, *, reason: str = "") -> dict:
+    def scale_to(self, n: int, *, reason: str = "",
+                 pool: str | None = None) -> dict:
         """Helm's actuator, process edition: up spawns fresh indexes
         (join gate: STARTING until the first gauge lands), down drains
         the highest non-retiring slots through ``ctl/<idx>=drain`` —
         the worker finishes everything it holds, exits
-        ``GRACEFUL_EXIT_CODE``, and a later poll reaps it."""
+        ``GRACEFUL_EXIT_CODE``, and a later poll reaps it.
+
+        ``pool=`` scopes the target to one disaggregated role: ``n``
+        then counts only that pool's replicas, new spawns carry the
+        pool as their ``--role``, and drains pick the highest index
+        *within* the pool — the other pool's slots are untouched."""
         n = int(n)
         if n < 1:
             raise ValueError(f"scale_to: n must be >= 1, got {n}")
+        role = pool if pool is not None else "unified"
         with self._lock:
             current = [h for h in self._replicas
-                       if not h.retiring and h.state != DEAD]
+                       if not h.retiring and h.state != DEAD
+                       and (pool is None or h.role == pool)]
             delta = n - len(current)
             added, retiring = 0, 0
             if delta > 0:
                 for _ in range(delta):
-                    self._spawn_new(reason="scale_up")
+                    self._spawn_new(reason="scale_up", role=role)
                     added += 1
             elif delta < 0:
                 doomed = sorted(current, key=lambda r: -r.index)
@@ -948,10 +1371,15 @@ class ProcessFleet:
                     except (OSError, TimeoutError):
                         failure.count_store_error("coord_ctl")
                     retiring += 1
-            self._target_replicas = n
+            if pool is None:
+                self._target_replicas = n
+            else:
+                self._pool_targets[pool] = n
+                self._target_replicas = sum(self._pool_targets.values())
             flight.record(
                 "fleet", "scale_to",
                 note=f"target={n} added={added} retiring={retiring}"
+                     + (f" pool={pool}" if pool else "")
                      + (f" {reason}" if reason else ""))
             if self.metrics is not None:
                 self.metrics.emit("fleet_scale", target=n, added=added,
@@ -959,6 +1387,15 @@ class ProcessFleet:
             self._write_members()
             self._rebuild_detector()
         return dict(target=n, added=added, retiring=retiring)
+
+    def scalable_pools(self) -> tuple:
+        """Pools Helm scales independently — disaggregated fleets
+        expose both stages; unified fleets scale as one pool (empty
+        tuple keeps :class:`FleetAutoscaler` on its legacy path)."""
+        return ("prefill", "decode") if self.disagg else ()
+
+    def pool_target(self, pool: str) -> int:
+        return int(self._pool_targets.get(pool, 1))
 
     def _reap_retiring(self) -> None:
         done = [h for h in self._replicas if h.retiring
@@ -985,6 +1422,7 @@ class ProcessFleet:
             self._sup_thread.join(timeout=5.0)
             self._sup_thread = None
         self._started = False
+        self._pump.stop()
         for h in self._replicas:
             try:
                 self._ns.set(f"ctl/{h.index}", b"stop")
@@ -1000,11 +1438,12 @@ class ProcessFleet:
                 h.proc.kill()
                 h.proc.wait(timeout=5.0)
         for h in self._replicas:
-            if h.proc is None and h.pid is not None:
+            if h.proc is None and h.pid is not None and not h.remote:
                 try:
                     os.kill(h.pid, 15)
                 except (OSError, ProcessLookupError):
                     pass
+        self._provisioner.close()
         try:
             self._client.close()
         except OSError:

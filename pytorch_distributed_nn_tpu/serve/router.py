@@ -68,7 +68,7 @@ DEAD = "dead"
 REPLICA_STATES = (STARTING, READY, DRAINING, RELOADING, DEAD)
 
 
-def fleet_pressure(replicas) -> dict:
+def fleet_pressure(replicas, *, role: str | None = None) -> dict:
     """Aggregate placement pressure over the READY replica set — the
     Helm autoscaler's queue/KV evidence (:mod:`serve.autoscale`).
 
@@ -76,15 +76,21 @@ def fleet_pressure(replicas) -> dict:
     fractions are fleet-wide (summed depths over summed capacities),
     not per-replica averages: one drowning replica in a fleet of idle
     ones is real headroom for the router, and the aggregate reflects
-    that. Reads the same scheduler/pool gauges :meth:`Router._score`
-    does, but computes the raw fractions directly — it is evidence for
-    the decision journal, not a placement decision, so it stays outside
-    the ``place``-only scoring choke point."""
+    that. ``role=`` narrows the aggregate to one disaggregated pool
+    (``"prefill"`` / ``"decode"``) so Helm can scale each pool on its
+    own pressure; ``None`` keeps the fleet-wide view. Reads the same
+    scheduler/pool gauges :meth:`Router._score` does, but computes the
+    raw fractions directly — it is evidence for the decision journal,
+    not a placement decision, so it stays outside the ``place``-only
+    scoring choke point."""
     queue_depth = queue_cap = 0
     kv_free = kv_total = 0
     ready = 0
     for handle in replicas:
         if handle.state != READY:
+            continue
+        if role is not None \
+                and getattr(handle, "role", "unified") != role:
             continue
         ready += 1
         sched = handle.engine.scheduler

@@ -10,6 +10,17 @@ The multi-host deployment loop (docs/serving.md has the full runbook):
     python scripts/fleet_deploy.py start --store hostA:7777 \
         --replicas 3 --backend tiny --autoscale 1
 
+    # disaggregated pools: prefill + decode replicas, KV handoff
+    # streamed cross-process over the store wire (serve/kv_wire.py)
+    python scripts/fleet_deploy.py start --store hostA:7777 \
+        --fleet-prefill 1 --fleet-decode 2
+
+    # cross-host provisioning: each worker spawn goes through the
+    # template ({cmd} = the shell-quoted worker command); the worker
+    # enrolls itself back through the store (pid, host, role)
+    python scripts/fleet_deploy.py start --store hostA:7777 \
+        --replicas 2 --spawn-template 'ssh hostC {cmd}'
+
     # host B died? any host: take over WITHOUT restarting workers —
     # live replicas are adopted pid-for-pid, stranded requests are
     # re-admitted with their emitted prefix, Helm's journal continues
@@ -79,24 +90,31 @@ def _run_fleet(fleet) -> int:
 
 
 def _cmd_start(args) -> int:
-    from pytorch_distributed_nn_tpu.serve.procfleet import ProcessFleet
+    from pytorch_distributed_nn_tpu.serve.procfleet import (
+        ProcessFleet,
+        TemplateProvisioner,
+    )
 
-    if args.fleet_prefill or args.fleet_decode:
-        # the process fleet keeps unified replicas until the store
-        # protocol carries a KV-block wire format (serve/procfleet.py)
-        print("error: --fleet-prefill/--fleet-decode need the "
-              "thread fleet (bench.py --fleet --disagg); the process "
-              "fleet serves unified replicas only", file=sys.stderr)
+    if bool(args.fleet_prefill) != bool(args.fleet_decode):
+        print("error: disaggregation needs BOTH --fleet-prefill and "
+              "--fleet-decode >= 1", file=sys.stderr)
         return 2
+    provisioner = (TemplateProvisioner(args.spawn_template)
+                   if args.spawn_template else None)
     fleet = ProcessFleet(
         replicas=args.replicas, backend=args.backend,
+        prefill=args.fleet_prefill, decode=args.fleet_decode,
+        role=args.role, provisioner=provisioner,
+        preset=args.preset, ckpt=args.ckpt,
         namespace=args.namespace, store_endpoint=args.store or None,
         autoscale_spec=args.autoscale,
         heartbeat_timeout_s=args.heartbeat_timeout)
     print(json.dumps({"event": "coordinator_up", "mode": "fresh",
                       "incarnation": fleet.incarnation,
+                      "disagg": fleet.disagg,
                       "store": fleet.store_endpoint,
-                      "namespace": args.namespace}), flush=True)
+                      "namespace": args.namespace},
+                     sort_keys=True), flush=True)
     return _run_fleet(fleet)
 
 
@@ -109,7 +127,8 @@ def _cmd_recover(args) -> int:
         return 2
     fleet = ProcessFleet.recover_from(
         store_endpoint=args.store, namespace=args.namespace,
-        backend=args.backend, autoscale_spec=args.autoscale,
+        backend=args.backend, preset=args.preset, ckpt=args.ckpt,
+        autoscale_spec=args.autoscale,
         heartbeat_timeout_s=args.heartbeat_timeout)
     print(json.dumps({"event": "coordinator_up", "mode": "recover",
                       "incarnation": fleet.incarnation,
@@ -169,21 +188,43 @@ def main() -> int:
                             "empty = own an in-process server)")
         p.add_argument("--namespace", default="fleet")
         if name != "status":
-            p.add_argument("--backend", choices=("stub", "tiny"),
+            p.add_argument("--backend",
+                           choices=("stub", "tiny", "preset"),
                            default="tiny")
+            p.add_argument("--preset", default="",
+                           help="config.PRESETS name for --backend "
+                                "preset (worker validates; the error "
+                                "names every preset)")
+            p.add_argument("--ckpt", default="",
+                           help="optional Orbax params checkpoint for "
+                                "--backend preset")
             p.add_argument("--autoscale", default="",
                            help="TPUNN_AUTOSCALE-grammar Helm spec "
-                                "(empty = no autoscaler)")
+                                "(empty = no autoscaler); on a "
+                                "disaggregated fleet Helm scales each "
+                                "pool on its own pressure")
             p.add_argument("--heartbeat-timeout", type=float,
                            default=5.0)
         if name == "start":
             p.add_argument("--replicas", type=int, default=2)
             p.add_argument("--fleet-prefill", type=int, default=0,
-                           help="reserved: disaggregated pools are "
-                                "thread-fleet only (bench.py --fleet "
-                                "--disagg); rejected here")
+                           help="disaggregated prefill pool size "
+                                "(needs --fleet-decode too); KV "
+                                "handoff streams over serve/kv_wire")
             p.add_argument("--fleet-decode", type=int, default=0,
-                           help="reserved: see --fleet-prefill")
+                           help="disaggregated decode pool size")
+            p.add_argument("--role",
+                           choices=("unified", "prefill", "decode"),
+                           default="unified",
+                           help="role for ALL --replicas workers "
+                                "(enrolling one pool of a fleet whose "
+                                "other pool runs elsewhere)")
+            p.add_argument("--spawn-template", default="",
+                           help="cross-host spawn command template; "
+                                "{cmd} = shell-quoted worker command, "
+                                "{index}/{role} available (e.g. "
+                                "'ssh hostC {cmd}'); workers enroll "
+                                "back through the store")
     args = ap.parse_args()
     return {"store": _cmd_store, "start": _cmd_start,
             "recover": _cmd_recover, "status": _cmd_status}[args.cmd](args)

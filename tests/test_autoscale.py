@@ -617,3 +617,102 @@ def test_obs_report_renders_autoscale_section(tmp_path):
     assert "autoscale decisions (Helm)" in proc.stdout
     assert "scale_up" in proc.stdout
     assert "Skyline forecast 2" in proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# Per-pool Helm (ISSUE 18 satellite): one hysteresis chain per
+# disaggregated pool, pool-tagged journal records that replay
+# standalone, and step_all routing through scale_to(pool=)
+# ---------------------------------------------------------------------------
+
+_POOL_SPEC = ("eval_interval_s=0:up_consecutive=1:cooldown_up_s=0:"
+              "cooldown_down_s=0:max_replicas=4:queue_up=0.5")
+
+
+def test_decision_carries_pool_and_replays_standalone():
+    scaler = autoscale.Autoscaler(autoscale.parse_spec(_POOL_SPEC),
+                                  spec=_POOL_SPEC)
+    scaler.set_pressure(queue_frac=0.9, kv_free_frac=1.0,
+                        pool="prefill")
+    d = scaler.evaluate(1.0, ready=1, target=1, pool="prefill")
+    assert d.action == autoscale.SCALE_UP and d.pool == "prefill"
+    rec = json.loads(d.as_json())
+    assert rec["pool"] == "prefill"
+    # the record replays from its own evidence, pool notwithstanding
+    action, _, to = autoscale.replay_decision(rec)
+    assert (action, to) == (d.action, d.to_replicas)
+    # a pool-less (pre-disagg) record still replays: absent pool means
+    # the decode/unified chain, so old journals never break
+    legacy = dict(rec)
+    del legacy["pool"]
+    action, _, to = autoscale.replay_decision(legacy)
+    assert (action, to) == (d.action, d.to_replicas)
+
+
+def test_per_pool_hysteresis_chains_are_independent():
+    """Consecutive-pressure counting is per pool: two hot prefill
+    ticks must scale prefill WITHOUT advancing decode's chain, and
+    vice versa — cross-pool bleed would let a prefill flash crowd
+    grow the decode pool it never pressured."""
+    spec = _POOL_SPEC.replace("up_consecutive=1", "up_consecutive=2")
+    scaler = autoscale.Autoscaler(autoscale.parse_spec(spec))
+    for t in (1.0, 2.0):
+        scaler.set_pressure(queue_frac=0.9, kv_free_frac=1.0,
+                            pool="prefill")
+        d_pre = scaler.evaluate(t, ready=1, target=1, pool="prefill")
+        scaler.set_pressure(queue_frac=0.0, kv_free_frac=1.0,
+                            pool="decode")
+        d_dec = scaler.evaluate(t, ready=1, target=1, pool="decode")
+        assert d_dec.action == autoscale.HOLD, d_dec
+    assert d_pre.action == autoscale.SCALE_UP, d_pre
+    # decode never saw pressure: a hot decode tick now still needs its
+    # OWN second consecutive tick (prefill's chain did not leak over)
+    scaler.set_pressure(queue_frac=0.9, kv_free_frac=1.0,
+                        pool="decode")
+    d = scaler.evaluate(3.0, ready=1, target=1, pool="decode")
+    assert d.action == autoscale.HOLD, d
+
+
+def _pool_handle(index, role, queue_depth):
+    h = _handle(index, READY, queue_depth=queue_depth)
+    h.role = role
+    return h
+
+
+class _FakeDisaggFleet:
+    """Duck-typed disaggregated fleet: scalable_pools() +
+    pool_target() + scale_to(pool=), handles tagged with roles."""
+
+    def __init__(self):
+        self.replicas = [_pool_handle(0, "prefill", queue_depth=8),
+                         _pool_handle(1, "decode", queue_depth=0)]
+        self.calls = []
+        self._targets = {"prefill": 1, "decode": 1}
+
+    def scalable_pools(self):
+        return ("prefill", "decode")
+
+    def pool_target(self, pool):
+        return self._targets[pool]
+
+    def scale_to(self, n, *, reason="", pool=None):
+        self.calls.append((pool, n))
+        self._targets[pool] = n
+
+
+def test_step_all_scales_the_pressured_pool_only():
+    """FleetAutoscaler.step_all on a disaggregated fleet: the hot
+    prefill pool (queue at capacity) scales up through
+    ``scale_to(pool="prefill")`` while the idle decode pool holds —
+    and every decision is journaled with its pool."""
+    fleet = _FakeDisaggFleet()
+    helm = autoscale.FleetAutoscaler(
+        fleet, autoscale.Autoscaler(autoscale.parse_spec(_POOL_SPEC)))
+    decisions = helm.step_all(now=1.0)
+    by_pool = {d.pool: d for d in decisions}
+    assert set(by_pool) == {"prefill", "decode"}
+    assert by_pool["prefill"].action == autoscale.SCALE_UP
+    assert by_pool["decode"].action == autoscale.HOLD
+    assert fleet.calls == [("prefill", by_pool["prefill"].to_replicas)]
+    assert fleet._targets["prefill"] == 2
+    assert fleet._targets["decode"] == 1

@@ -224,3 +224,69 @@ def test_beat_thread_survives_store_outage_and_counts_errors():
     finally:
         chaos.reset()
         rep.stop()
+
+
+# ---------------------------------------------------------------------------
+# store_call — THE counted retry helper (ISSUE 18): every store op on
+# a partition-survivable path (KV wire, daemon publish loops) rides it
+# ---------------------------------------------------------------------------
+
+
+def test_store_call_outage_survive_resume():
+    """The Breakwater regression shape: a transient outage is absorbed
+    as counted retries (store_errors_total{op} + on_retry per failed
+    attempt) and the call RESUMES with the healed store's answer —
+    no dead thread, no silent drop, no uncounted except site."""
+    from pytorch_distributed_nn_tpu import obs
+
+    obs.reset_registry()
+    calls = {"n": 0, "retries": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] <= 3:
+            raise OSError("partition window")
+        return b"healed"
+
+    out = failure.store_call(
+        flaky, op="drill", deadline_s=5.0, base_s=0.001, max_s=0.002,
+        on_retry=lambda: calls.__setitem__(
+            "retries", calls["retries"] + 1))
+    assert out == b"healed"
+    assert calls["n"] == 4 and calls["retries"] == 3
+    counted = obs.get_registry().counter(
+        "store_errors_total").value(op="drill")
+    assert counted == 3, "every failed attempt must be counted"
+
+
+def test_store_call_deadline_fallback_and_reraise():
+    """Past the deadline the caller owns the degradation: with
+    fallback= the sentinel comes back (kv_wire turns it into a cold
+    re-prefill); without it the last error re-raises — and either way
+    the call is BOUNDED, never a wedge."""
+    def dead():
+        raise TimeoutError("store gone")
+
+    t0 = time.monotonic()
+    out = failure.store_call(dead, op="drill_dead", deadline_s=0.15,
+                             base_s=0.001, max_s=0.01, fallback=None)
+    assert out is None
+    assert time.monotonic() - t0 < 2.0, "fallback path must be bounded"
+    with pytest.raises(TimeoutError):
+        failure.store_call(dead, op="drill_dead", deadline_s=0.1,
+                           base_s=0.001, max_s=0.01)
+
+
+def test_store_call_only_absorbs_transient_errors():
+    """OSError/TimeoutError are the transient shapes; anything else
+    (a bug, a decode error) propagates on the FIRST attempt —
+    retrying corruption would only hide it."""
+    calls = {"n": 0}
+
+    def broken():
+        calls["n"] += 1
+        raise ValueError("not a transient")
+
+    with pytest.raises(ValueError):
+        failure.store_call(broken, op="drill_bug", deadline_s=5.0)
+    assert calls["n"] == 1, "non-transient errors must not retry"

@@ -1,0 +1,303 @@
+"""Breakwater KV wire (ISSUE 18 tentpole): the versioned, checksummed
+chunk format a process-fleet prefill->decode handoff rides through the
+store. Unit-level — MemStore only (MemStore<->native parity for the
+same records lives in test_store_parity.py): tree codec byte-identity,
+chunk header validation (torn writes, version skew), order-independent
+reassembly, the push/pull degradation ladder under injected
+corrupt_wire@ / store_flaky@ chaos, GC, and the unset-env cleanliness
+contract (no registry writes, no flight events, byte-identical wire)."""
+
+import json
+import time
+import zlib
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_nn_tpu import obs
+from pytorch_distributed_nn_tpu.obs import flight
+from pytorch_distributed_nn_tpu.runtime import chaos
+from pytorch_distributed_nn_tpu.serve import kv_wire
+from pytorch_distributed_nn_tpu.serve.store import MemStore, PrefixStore
+
+
+@pytest.fixture(autouse=True)
+def _fresh():
+    chaos.reset()
+    obs.reset_registry()
+    flight.reset_recorder(enabled=True)
+    yield
+    chaos.reset()
+
+
+@pytest.fixture
+def store():
+    return PrefixStore(MemStore(), "fleet")
+
+
+def _tree():
+    return {
+        "tokens": np.arange(40, dtype=np.int32).reshape(1, 40),
+        "kv": [np.linspace(0.0, 1.0, 96).astype(np.float32).reshape(2, 48),
+               np.arange(16, dtype=np.uint8).reshape(4, 4)],
+        "nblk": np.asarray(2, np.int32),
+        "meta": {"adapter": 0, "name": "r0", "flag": True,
+                 "none": None, "pair": (1, 2)},
+    }
+
+
+def _assert_trees_equal(a, b):
+    assert sorted(a) == sorted(b)
+    np.testing.assert_array_equal(a["tokens"], b["tokens"])
+    assert a["tokens"].dtype == b["tokens"].dtype
+    for x, y in zip(a["kv"], b["kv"]):
+        np.testing.assert_array_equal(x, y)
+        assert x.dtype == y.dtype
+    assert (int(np.asarray(a["nblk"]).reshape(-1)[0])
+            == int(np.asarray(b["nblk"]).reshape(-1)[0]))
+    assert a["meta"] == b["meta"]
+
+
+# ---------------------------------------------------------------------------
+# tree codec
+# ---------------------------------------------------------------------------
+
+
+def test_encode_decode_tree_round_trips_byte_identical():
+    spec, payload = kv_wire.encode_tree(_tree())
+    back = kv_wire.decode_tree(spec, payload)
+    _assert_trees_equal(_tree(), back)
+    # tuples survive as tuples, None as None, scalars as themselves
+    assert isinstance(back["meta"]["pair"], tuple)
+    assert back["meta"]["none"] is None
+    # determinism: the SAME tree encodes to the SAME bytes (sorted
+    # dict keys, raw C-order leaves) — the wire's byte-identity anchor
+    spec2, payload2 = kv_wire.encode_tree(_tree())
+    assert payload2 == payload
+    assert json.dumps(spec2, sort_keys=True) == \
+        json.dumps(spec, sort_keys=True)
+
+
+def test_decode_tree_rejects_mismatched_payload():
+    spec, payload = kv_wire.encode_tree(_tree())
+    with pytest.raises(kv_wire.WireError):
+        kv_wire.decode_tree(spec, payload[:-4])
+
+
+# ---------------------------------------------------------------------------
+# chunk records
+# ---------------------------------------------------------------------------
+
+
+def test_chunk_record_round_trip_and_torn_shapes():
+    blob = kv_wire.encode_chunk(3, b"payload-bytes")
+    assert kv_wire.decode_chunk(blob) == (3, b"payload-bytes")
+    # truncated header
+    with pytest.raises(kv_wire.TornChunkError):
+        kv_wire.decode_chunk(blob[:6])
+    # bad magic
+    with pytest.raises(kv_wire.TornChunkError):
+        kv_wire.decode_chunk(b"XXXX" + blob[4:])
+    # truncated payload (header length disagrees)
+    with pytest.raises(kv_wire.TornChunkError):
+        kv_wire.decode_chunk(blob[:-3])
+    # flipped payload byte fails the CRC
+    torn = blob[:-1] + bytes([blob[-1] ^ 0xFF])
+    with pytest.raises(kv_wire.TornChunkError):
+        kv_wire.decode_chunk(torn)
+
+
+def test_chunk_version_skew_is_loud():
+    blob = kv_wire.encode_chunk(0, b"x")
+    skewed = kv_wire._HEADER.pack(
+        kv_wire.MAGIC, kv_wire.WIRE_VERSION + 1, 0,
+        zlib.crc32(b"x") & 0xFFFFFFFF, 1) + b"x"
+    assert len(skewed) == len(blob)
+    with pytest.raises(kv_wire.WireVersionError):
+        kv_wire.decode_chunk(skewed)
+
+
+def test_split_join_chunks_order_independent():
+    payload = bytes(range(256)) * 5
+    chunks = kv_wire.split_chunks(payload, chunk_bytes=300)
+    assert len(chunks) == 5 and b"".join(chunks) == payload
+    # reassembly is keyed by seq — arrival order cannot matter
+    shuffled = {i: c for i, c in reversed(list(enumerate(chunks)))}
+    assert kv_wire.join_chunks(shuffled, 5) == payload
+    with pytest.raises(kv_wire.WireError):
+        kv_wire.join_chunks({0: chunks[0]}, 5)
+    # empty payload still yields one committable record
+    assert kv_wire.split_chunks(b"") == [b""]
+
+
+# ---------------------------------------------------------------------------
+# push / pull ladder
+# ---------------------------------------------------------------------------
+
+
+def test_push_pull_round_trip_multi_chunk(store):
+    meta = kv_wire.push(store, "preq-0-0", _tree(), chunk_bytes=128)
+    assert meta is not None and meta["chunks"] > 1  # really chunked
+    assert store.check(kv_wire.chunk_key("preq-0-0", 0))
+    assert store.check(kv_wire.meta_key("preq-0-0"))
+    back = kv_wire.pull(store, "preq-0-0")
+    _assert_trees_equal(_tree(), back)
+    # GC drops every record; a second GC is a harmless no-op
+    kv_wire.cleanup(store, "preq-0-0")
+    for seq in range(int(meta["chunks"])):
+        assert not store.check(kv_wire.chunk_key("preq-0-0", seq))
+    assert not store.check(kv_wire.meta_key("preq-0-0"))
+    kv_wire.cleanup(store, "preq-0-0")
+
+
+def test_pull_absent_meta_degrades_cold_and_bounded(store):
+    t0 = time.monotonic()
+    assert kv_wire.pull(store, "preq-never", deadline_s=0.3) is None
+    assert time.monotonic() - t0 < 3.0, "cold path must be bounded"
+    events = [e for e in flight.get_recorder().snapshot()
+              if e["kind"] == "kvwire"]
+    assert any(e["op"] == "cold_fallback" for e in events), events
+
+
+def test_pull_meta_version_skew_is_loud(store):
+    kv_wire.push(store, "preq-0-1", _tree())
+    raw = json.loads(store.get(kv_wire.meta_key("preq-0-1"),
+                               timeout_ms=1000).decode())
+    raw["version"] = kv_wire.WIRE_VERSION + 1
+    store.set(kv_wire.meta_key("preq-0-1"),
+              json.dumps(raw, sort_keys=True).encode())
+    with pytest.raises(kv_wire.WireVersionError):
+        kv_wire.pull(store, "preq-0-1")
+
+
+def test_pull_torn_chunk_exhausts_repulls_then_cold(store):
+    kv_wire.push(store, "preq-0-2", _tree())
+    key = kv_wire.chunk_key("preq-0-2", 0)
+    blob = store.get(key, timeout_ms=1000)
+    store.set(key, blob[:-1] + bytes([blob[-1] ^ 0xFF]))  # torn write
+    t0 = time.monotonic()
+    assert kv_wire.pull(store, "preq-0-2", deadline_s=0.5,
+                        max_repulls=2) is None
+    assert time.monotonic() - t0 < 5.0
+    events = [e["op"] for e in flight.get_recorder().snapshot()
+              if e["kind"] == "kvwire"]
+    assert "torn_chunk" in events and "cold_fallback" in events, events
+
+
+def test_pull_whole_payload_checksum_guards_reassembly(store):
+    """A chunk whose OWN record validates but whose bytes differ from
+    what meta committed (same length, valid per-chunk CRC) must be
+    caught by the whole-transfer checksum — cold, not corrupt KV."""
+    kv_wire.push(store, "preq-0-3", _tree(), chunk_bytes=128)
+    key = kv_wire.chunk_key("preq-0-3", 0)
+    _, data = kv_wire.decode_chunk(store.get(key, timeout_ms=1000))
+    forged = bytes(b ^ 0xFF for b in data)  # valid record, wrong bytes
+    store.set(key, kv_wire.encode_chunk(0, forged))
+    assert kv_wire.pull(store, "preq-0-3", deadline_s=0.5) is None
+
+
+def test_injected_corrupt_wire_single_tear_repulls_warm(store):
+    """corrupt_wire@seq=N fires once: the first pull of chunk N is
+    treated as torn, the bounded re-pull succeeds — a drill-shaped
+    tear has the identical disposition to a real one."""
+    kv_wire.push(store, "preq-0-4", _tree())
+    chaos.maybe_init("corrupt_wire@seq=0", rank=0, seed=0)
+    back = kv_wire.pull(store, "preq-0-4")
+    _assert_trees_equal(_tree(), back)
+    events = [e["op"] for e in flight.get_recorder().snapshot()
+              if e["kind"] == "chaos"]
+    assert events, "injected tear must land a chaos flight event"
+
+
+def test_injected_corrupt_wire_every_attempt_degrades_cold(store):
+    kv_wire.push(store, "preq-0-5", _tree())
+    chaos.maybe_init("corrupt_wire@seq=0:p=1.0", rank=0, seed=0)
+    assert kv_wire.pull(store, "preq-0-5", deadline_s=0.5,
+                        max_repulls=2) is None
+
+
+class _FlakyStore:
+    """Store proxy whose first ``fail_n`` writes raise OSError — a
+    partition window that heals while the push is still inside its
+    retry loop."""
+
+    def __init__(self, inner, fail_n):
+        self._inner = inner
+        self._left = fail_n
+        self.failed = 0
+
+    def set(self, key, value):
+        if self._left > 0:
+            self._left -= 1
+            self.failed += 1
+            raise OSError("partition window")
+        return self._inner.set(key, value)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def test_push_survives_partition_window_with_counted_retries(store):
+    flaky = _FlakyStore(store, fail_n=2)
+    meta = kv_wire.push(flaky, "preq-0-6", _tree(), deadline_s=5.0)
+    assert meta is not None and flaky.failed == 2
+    retried = obs.get_registry().counter(
+        "kv_wire_retries_total").value(op="push")
+    assert retried >= 2, retried
+    # the healed wire pulls warm — nothing about the window leaked
+    _assert_trees_equal(_tree(), kv_wire.pull(store, "preq-0-6"))
+
+
+def test_push_abandons_past_deadline_and_decode_runs_cold(store):
+    """A store unreachable past the push deadline must ABANDON the
+    wire (return None, flight ``push_abandoned``) — never crash the
+    prefill worker; the uncommitted wire then pulls cold."""
+    chaos.maybe_init("store_flaky@p=1", rank=0, seed=0)
+    t0 = time.monotonic()
+    out = kv_wire.push(store, "preq-0-7", _tree(), deadline_s=0.3)
+    assert out is None
+    assert time.monotonic() - t0 < 5.0, "abandon must be bounded"
+    retried = obs.get_registry().counter(
+        "kv_wire_retries_total").value(op="push")
+    counted = obs.get_registry().counter(
+        "store_errors_total").value(op="kv_push")
+    assert retried > 0 and counted > 0, (retried, counted)
+    events = [e["op"] for e in flight.get_recorder().snapshot()
+              if e["kind"] == "kvwire"]
+    assert "push_abandoned" in events, events
+    chaos.reset()
+    assert kv_wire.pull(store, "preq-0-7", deadline_s=0.3) is None
+
+
+# ---------------------------------------------------------------------------
+# unset-env cleanliness (the Breakwater acceptance row)
+# ---------------------------------------------------------------------------
+
+
+def test_happy_path_writes_nothing_and_wire_is_byte_identical(store):
+    """With chaos/meter/trace unset a push+pull round trip moves NO
+    registry counter and lands NO kvwire flight event — and two pushes
+    of the same tree produce byte-identical store records."""
+    before = dict(obs.get_registry().snapshot())
+    kv_wire.push(store, "preq-0-8", _tree(), chunk_bytes=128)
+    back = kv_wire.pull(store, "preq-0-8")
+    _assert_trees_equal(_tree(), back)
+    after = dict(obs.get_registry().snapshot())
+    moved = {k for k in after
+             if ("kv_wire" in k or "store_errors" in k)
+             and after[k] != before.get(k, 0.0)}
+    assert not moved, f"happy path moved counters: {moved}"
+    assert not [e for e in flight.get_recorder().snapshot()
+                if e["kind"] == "kvwire"], \
+        "happy path must not touch the flight ring"
+
+    other = PrefixStore(MemStore(), "fleet")
+    kv_wire.push(other, "preq-0-8", _tree(), chunk_bytes=128)
+    n = int(json.loads(store.get(kv_wire.meta_key("preq-0-8"),
+                                 timeout_ms=1000).decode())["chunks"])
+    for seq in range(n):
+        k = kv_wire.chunk_key("preq-0-8", seq)
+        assert store.get(k, timeout_ms=1000) == \
+            other.get(k, timeout_ms=1000)
+    assert store.get(kv_wire.meta_key("preq-0-8"), timeout_ms=1000) \
+        == other.get(kv_wire.meta_key("preq-0-8"), timeout_ms=1000)

@@ -399,6 +399,27 @@ def test_bench_disagg_selftest_smoke():
     assert "disagg selftest ok" in proc.stdout
 
 
+def test_bench_disagg_procs_selftest_smoke():
+    """The Breakwater acceptance drill (ISSUE 18 tentpole), run exactly
+    as CI would: stub prefill/decode subprocess pools over a REAL
+    native store with the KV handoff streamed through serve/kv_wire.py.
+    Covers the three partition drills — a kvwire-scoped
+    ``store_partition@`` mid-stream, a ``kill_transfer@`` worker death
+    inside the push, and a coordinator death mid-handoff with
+    pid-for-pid adoption — each bit-identical to the stub reference,
+    plus the torn-wire re-pull/cold ladder and the pump-overlap
+    proof."""
+    repo = Path(__file__).parent.parent
+    proc = subprocess.run(
+        [sys.executable, str(repo / "bench.py"), "--fleet",
+         "--disagg-procs", "--selftest"],
+        capture_output=True, text=True, timeout=600, cwd=repo,
+        env={**__import__("os").environ, "JAX_PLATFORMS": "cpu"},
+    )
+    assert proc.returncode == 0, proc.stderr or proc.stdout
+    assert "disagg-procs selftest ok" in proc.stdout
+
+
 _AUTOSCALE = (Path(__file__).parent.parent
               / "pytorch_distributed_nn_tpu" / "serve" / "autoscale.py")
 
@@ -815,16 +836,18 @@ def test_router_placement_is_counted_and_scoring_is_internal():
     )
 
 def test_kv_transfer_is_the_single_streaming_choke_point():
-    """ISSUE 15 lint: every KV byte moved between replica engines goes
-    through ``ops.collectives.kv_transfer``, which must fan out to the
-    same three books as ``_record`` — the comm recorder (goodput's
+    """ISSUE 15 + 18 lint: every KV byte moved between replica engines
+    goes through ``ops.collectives.kv_transfer``, which must fan out to
+    the same three books as ``_record`` — the comm recorder (goodput's
     wire-byte cross-check), the flight ring, and the chaos hook
     (``on_transfer`` may raise mid-transfer). Structural proof: (a)
-    ``kv_transfer`` performs all three calls; (b) the ONLY caller of
-    ``kv_transfer`` in the serve package is
-    ``DisaggFleet._stream_blocks``; (c) the engine's
-    ``export_blocks``/``ingest_blocks`` pair is likewise called only
-    from that streaming path — nobody can ship blocks off the books."""
+    ``kv_transfer`` performs all three calls; (b) the ONLY serve-package
+    callers of ``kv_transfer`` are ``DisaggFleet._stream_blocks`` (the
+    thread fleet — the host arrays ARE the wire) and ``kv_wire.push``
+    (the process fleet — the tree is billed before it chunks into the
+    store wire); (c) the engine's ``export_blocks``/``ingest_blocks``
+    pair is likewise called only from those streaming paths — nobody
+    can ship blocks off the books."""
     tree = ast.parse((_OPS / "collectives.py").read_text())
     kv = next((n for n in tree.body if isinstance(n, ast.FunctionDef)
                and n.name == "kv_transfer"), None)
@@ -845,29 +868,117 @@ def test_kv_transfer_is_the_single_streaming_choke_point():
                "ingest_blocks": []}
     for path in sorted(_SERVE.glob("*.py")):
         tree = ast.parse(path.read_text())
+        scopes = [(fn.name, fn) for fn in tree.body
+                  if isinstance(fn, ast.FunctionDef)]
         for cls in [n for n in tree.body if isinstance(n, ast.ClassDef)]:
-            for fn in [n for n in cls.body
-                       if isinstance(n, ast.FunctionDef)]:
-                for node in ast.walk(fn):
-                    if (isinstance(node, ast.Call)
-                            and isinstance(node.func, ast.Attribute)
-                            and node.func.attr in callers):
-                        callers[node.func.attr].append(
-                            f"{path.name}:{cls.name}.{fn.name}")
-    assert callers["kv_transfer"] == \
-        ["disagg.py:DisaggFleet._stream_blocks"], (
+            scopes.extend((f"{cls.name}.{fn.name}", fn) for fn in cls.body
+                          if isinstance(fn, ast.FunctionDef))
+        for qual, fn in scopes:
+            for node in ast.walk(fn):
+                if (isinstance(node, ast.Call)
+                        and isinstance(node.func, ast.Attribute)
+                        and node.func.attr in callers):
+                    callers[node.func.attr].append(
+                        f"{path.name}:{qual}")
+    assert sorted(callers["kv_transfer"]) == \
+        ["disagg.py:DisaggFleet._stream_blocks", "kv_wire.py:push"], (
             f"ops.collectives.kv_transfer must be called only from "
-            f"DisaggFleet._stream_blocks, found {callers['kv_transfer']}"
+            f"DisaggFleet._stream_blocks and kv_wire.push, found "
+            f"{callers['kv_transfer']}"
         )
-    assert callers["export_blocks"] == \
-        ["disagg.py:DisaggFleet._stream_blocks"], (
+    assert sorted(callers["export_blocks"]) == \
+        ["disagg.py:DisaggFleet._stream_blocks",
+         "fleet_worker.py:_EngineBackend.export_kv"], (
             f"engine.export_blocks must be called only from the "
-            f"streaming path, found {callers['export_blocks']}"
+            f"streaming paths, found {callers['export_blocks']}"
         )
-    assert callers["ingest_blocks"] == \
-        ["disagg.py:DisaggFleet._stream_blocks"], (
+    assert sorted(callers["ingest_blocks"]) == \
+        ["disagg.py:DisaggFleet._stream_blocks",
+         "fleet_worker.py:_EngineBackend.ingest_kv"], (
             f"engine.ingest_blocks must be called only from the "
-            f"streaming path, found {callers['ingest_blocks']}"
+            f"streaming paths, found {callers['ingest_blocks']}"
+        )
+
+
+_KV_WIRE = (Path(__file__).parent.parent / "pytorch_distributed_nn_tpu"
+            / "serve" / "kv_wire.py")
+
+
+def test_kvwire_key_format_has_one_home():
+    """ISSUE 18 lint: the ``kvwire/<request_id>/...`` key layout exists
+    in exactly one place — serve/kv_wire.py's ``chunk_key``/``meta_key``
+    — so the wire format cannot fork. No other serve module may build a
+    ``kvwire/`` key in executable code (docstrings may DESCRIBE the
+    layout; runtime/chaos.py matches the substring to scope its
+    ``window=transfer`` partition, it never constructs a key)."""
+
+    def doc_ids(tree):
+        ids = set()
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.Module, ast.ClassDef,
+                                 ast.FunctionDef, ast.AsyncFunctionDef)):
+                body = node.body
+                if (body and isinstance(body[0], ast.Expr)
+                        and isinstance(body[0].value, ast.Constant)):
+                    ids.add(id(body[0].value))
+        return ids
+
+    offenders = []
+    for path in sorted(_SERVE.glob("*.py")):
+        if path.name == "kv_wire.py":
+            continue
+        tree = ast.parse(path.read_text())
+        docs = doc_ids(tree)
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)
+                    and "kvwire/" in node.value and id(node) not in docs):
+                offenders.append(f"{path.name}:{node.lineno}")
+    assert not offenders, (
+        f"kvwire/ keys may only be built by serve/kv_wire.py's "
+        f"chunk_key/meta_key, found literals at {offenders}"
+    )
+    wire = ast.parse(_KV_WIRE.read_text())
+    fns = {n.name for n in wire.body if isinstance(n, ast.FunctionDef)}
+    assert {"chunk_key", "meta_key"} <= fns, (
+        "kv_wire.py must define chunk_key and meta_key"
+    )
+
+
+def test_kvwire_store_ops_all_ride_the_counted_retry_helper():
+    """ISSUE 18 lint: on the transfer path every raw store op
+    (``set``/``get``/``delete``) is wrapped in a lambda handed to
+    ``runtime.failure.store_call`` — the ONE place allowed to catch
+    ``OSError``/``TimeoutError`` (counted, deadlined, backed off). A
+    bare store op or a local ``except OSError`` in kv_wire.py would
+    reopen the uncounted-thread-death hole Breakwater closed."""
+    tree = ast.parse(_KV_WIRE.read_text())
+    in_lambda = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Lambda):
+            for sub in ast.walk(node):
+                in_lambda.add(id(sub))
+    bare = []
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("set", "get", "delete", "add")
+                and isinstance(node.func.value, ast.Name)
+                and node.func.value.id == "store"
+                and id(node) not in in_lambda):
+            bare.append(f"store.{node.func.attr}:{node.lineno}")
+    assert not bare, (
+        f"kv_wire.py store ops must go through store_call lambdas, "
+        f"found bare ops at {bare}"
+    )
+    for handler in [n for n in ast.walk(tree)
+                    if isinstance(n, ast.ExceptHandler)]:
+        names = {n.id for n in ast.walk(handler.type)
+                 if isinstance(n, ast.Name)} if handler.type else set()
+        assert not names & {"OSError", "TimeoutError", "Exception"}, (
+            f"kv_wire.py:{handler.lineno} catches {names} — transient "
+            f"store failures are store_call's job (the sole counted "
+            f"except site)"
         )
 
 

@@ -269,3 +269,64 @@ def test_unarmed_worker_publishes_no_meter_key(store):
         assert not store.check("meter/7")
     finally:
         meter.reset()
+
+
+# ---------------------------------------------------------------------------
+# KV wire records (ISSUE 18): the prefill->decode handoff's chunk/meta
+# keys must behave identically on both backends — the selftest drills
+# run on the native wire, the unit suite on MemStore, and neither may
+# see a different disposition ladder.
+# ---------------------------------------------------------------------------
+
+
+def _wire_tree():
+    import numpy as np
+
+    return {"tokens": np.arange(64, dtype=np.int32).reshape(1, 64),
+            "kv": [np.linspace(0, 1, 128).astype(np.float32)],
+            "nblk": np.asarray(4, np.int32)}
+
+
+def test_kvwire_push_pull_round_trip_parity(store):
+    import numpy as np
+
+    from pytorch_distributed_nn_tpu.serve import kv_wire
+
+    ns = PrefixStore(store, "fleet")
+    meta = kv_wire.push(ns, "preq-p-0", _wire_tree(), chunk_bytes=128)
+    assert meta is not None and int(meta["chunks"]) > 1
+    for seq in range(int(meta["chunks"])):
+        assert ns.check(kv_wire.chunk_key("preq-p-0", seq))
+    assert ns.check(kv_wire.meta_key("preq-p-0"))
+    back = kv_wire.pull(ns, "preq-p-0")
+    np.testing.assert_array_equal(back["tokens"],
+                                  _wire_tree()["tokens"])
+    np.testing.assert_array_equal(back["kv"][0], _wire_tree()["kv"][0])
+    # GC drops every record on this backend too
+    kv_wire.cleanup(ns, "preq-p-0")
+    assert not ns.check(kv_wire.meta_key("preq-p-0"))
+    assert not ns.check(kv_wire.chunk_key("preq-p-0", 0))
+
+
+def test_kvwire_torn_write_detected_and_bounded(store):
+    from pytorch_distributed_nn_tpu.serve import kv_wire
+
+    ns = PrefixStore(store, "fleet")
+    kv_wire.push(ns, "preq-p-1", _wire_tree(), chunk_bytes=128)
+    key = kv_wire.chunk_key("preq-p-1", 1)
+    blob = ns.get(key, timeout_ms=1000)
+    ns.set(key, blob[: len(blob) // 2])  # torn mid-record
+    t0 = time.monotonic()
+    assert kv_wire.pull(ns, "preq-p-1", deadline_s=0.5,
+                        max_repulls=2) is None
+    assert time.monotonic() - t0 < 5.0, \
+        "torn wire must degrade cold in bounded time"
+
+
+def test_kvwire_absent_meta_times_out_cold(store):
+    from pytorch_distributed_nn_tpu.serve import kv_wire
+
+    ns = PrefixStore(store, "fleet")
+    t0 = time.monotonic()
+    assert kv_wire.pull(ns, "preq-p-never", deadline_s=0.3) is None
+    assert time.monotonic() - t0 < 3.0
