@@ -163,6 +163,11 @@ _METRIC_NAMES = {
     # (obs.xray.metric_direction); vs_baseline carries the
     # armed-vs-unset throughput ratio, the hook-overhead A/B
     "serve_cost": "serve cost-per-1k-tokens (tiny)",
+    # Lighthouse fingerprint chains (obs/audit.py): the SAME closed
+    # workload with TPUNN_AUDIT armed in chains-only trim (sample=0,
+    # no shadow legs) — vs_baseline carries the armed-vs-unset
+    # throughput ratio, i.e. the per-retire sha1-fold overhead
+    "serve_audit": "audited serving tokens/sec (tiny)",
     # higher-is-better on purpose: no latency/seconds substring, so the
     # ledger (obs.xray.metric_direction) gates a DROP in capacity
     "capacity": "capacity sustainable req/s (llama3_8b_zero)",
@@ -962,6 +967,40 @@ def bench_serve(args) -> int:
             vs_baseline_kind="metered_over_unmetered_tokens_per_s",
             unmetered_tokens_per_s=round(tps_unset, 1))
     MetricsLogger(stream=sink).emit_benchmark(**cost_rec)
+
+    # -- Lighthouse armed-vs-unset overhead A/B ------------------------
+    # (docs/observability.md "Lighthouse"): the SAME closed-loop ragged
+    # workload twice — audit unset, then armed in chains-only trim
+    # (sample=0: fingerprint folds at retire, no shadow legs, so the
+    # ratio isolates the per-token sha1 hook, not deliberate replay
+    # work). When TPUNN_AUDIT was already set for the whole bench the
+    # unset leg is impossible; the series still lands, un-ratioed.
+    if args.audit:
+        from pytorch_distributed_nn_tpu.obs import audit
+
+        audit_was_armed = audit.enabled()
+        tps_plain = 0.0
+        if not audit_was_armed:
+            tps_plain, _ = closed_pass()
+            audit.maybe_init("sample=0:shadow=0")
+        tps_audited, _ = closed_pass()
+        fp_total = (audit.summary() or {}).get("fingerprints", 0)
+        if not audit_was_armed:
+            audit.reset()  # leave the process as unarmed as it arrived
+        audit_rec = dict(
+            metric=_METRIC_NAMES["serve_audit"],
+            value=round(tps_audited, 1), unit="tokens/sec",
+            backend=backend, fingerprints=int(fp_total),
+            detail=f"{n_req} ragged requests, {slots} slots, "
+                   f"TPUNN_AUDIT=sample=0:shadow=0 vs unset"
+                   + (" [tiny dims]" if args.serve_tiny else ""),
+        )
+        if not audit_was_armed:
+            audit_rec.update(
+                vs_baseline=round(tps_audited / tps_plain, 3),
+                vs_baseline_kind="audited_over_unaudited_tokens_per_s",
+                unaudited_tokens_per_s=round(tps_plain, 1))
+        MetricsLogger(stream=sink).emit_benchmark(**audit_rec)
     return 0
 
 
@@ -2538,6 +2577,12 @@ def main(argv=None) -> int:
     ap.add_argument("--serve-tiny", action="store_true",
                     help="serve metric: CI-scale model dims (CPU-fast) "
                          "instead of the scaled llama stand-in")
+    ap.add_argument("--audit", action="store_true",
+                    help="serve metric: also run the Lighthouse A/B — "
+                         "the closed-loop workload with TPUNN_AUDIT "
+                         "armed (fingerprint chains only, sample=0) vs "
+                         "unset; vs_baseline is the hook overhead (its "
+                         "own ledger series)")
     ap.add_argument("--serve-prefix-frac", type=float, default=0.0,
                     help="serve metric: also run the shared-prefix A/B "
                          "(prefix cache ON vs OFF) with this fraction "

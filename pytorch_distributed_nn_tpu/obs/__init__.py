@@ -47,6 +47,13 @@ heartbeats) with:
   engine/scheduler/pool/collective choke points; ledgers publish at
   ``meter/<rank>`` for fleet rollup and feed ``scripts/obs_cost.py``'s
   showback report; inert unless ``TPUNN_METER`` is set;
+- :mod:`obs.audit` — Lighthouse output-integrity auditing (ISSUE 19):
+  rolling sha1 fingerprint chains over emitted token ids, shadow
+  replay of a sampled request slice to a second replica, golden
+  probes at idle cadence, and quarantine of a confirmed-diverging
+  replica through the counted state choke points; divergence pages
+  land in the watchtower and ``scripts/obs_audit.py`` renders the
+  integrity report; inert unless ``TPUNN_AUDIT`` is set;
 - :mod:`obs.xray` — anomaly-triggered device profiling (ISSUE 10):
   bounded, rate-limited ``jax.profiler`` captures (page/interval/
   on-demand triggers), per-op MFU/roofline attribution, compile
@@ -61,6 +68,7 @@ heartbeats) with:
 ``bench.py --goodput`` attaches the breakdown to benchmark records.
 """
 
+from pytorch_distributed_nn_tpu.obs import audit  # noqa: F401
 from pytorch_distributed_nn_tpu.obs import critpath  # noqa: F401
 from pytorch_distributed_nn_tpu.obs import flight  # noqa: F401
 from pytorch_distributed_nn_tpu.obs import meter  # noqa: F401

@@ -1,0 +1,471 @@
+"""Lighthouse: online output-integrity auditing.
+
+Every load-bearing invariant in this repo — failover stitching,
+prefix-cache restore, disagg handoff, process-fleet re-admission — is
+certified by greedy bit-identity goldens, but only in tests. A
+production replica that silently corrupts output (flaky HBM, a bad
+compile, a torn KV restore) serves wrong tokens with green metrics.
+This module turns the bit-identity discipline into an always-on
+observability layer with three escalating checks:
+
+1. **Fingerprint chains.** Every request accrues a rolling sha1 chain
+   over its emitted token ids (:func:`chain`), computed from the single
+   host fetch the engine already does per round — the decode hot loop
+   is untouched; the fold happens at retire in
+   ``ServingEngine._finish_record`` (the ONE engine call site,
+   lint-pinned). The chain is *resumable*: ``chain(chain(s, a), b) ==
+   chain(s, a + b)``, so every boundary that rewrites a request
+   (failover re-admission, disagg handoff, process-fleet adoption)
+   seeds the new leg with the chain over the prefix it carries and the
+   final fingerprint is identical to a single uninterrupted leg.
+   Fingerprints ride the ``serve_request`` JSONL (``fp`` key, absent
+   when unarmed), flight-ring ``audit`` events, Causeway decode spans,
+   and — process fleet — a ``fp/<rid>`` store key so worker
+   fingerprints are comparable coordinator-side.
+2. **Shadow replay.** A deterministic request-id-hash sample
+   (``sample=``) of fleet requests is duplicated by the Router onto a
+   second READY replica (``Router.place_shadow``). The shadow leg is
+   excluded from TTFT histograms (pre-set ``t_first_origin``) and from
+   Abacus billing (the reserved :data:`SHADOW_TENANT`). A fingerprint
+   mismatch between the legs is tie-broken by a third *referee* leg
+   (majority) or the golden-probe record, then raised as a Watchtower
+   ``output_divergence`` page naming the disagreeing pair — pages
+   auto-dump the flight ring and trigger an Xray capture.
+3. **Golden probes.** A background prober pushes a canned prompt
+   (:data:`PROBE_PROMPT`) through live replicas at ``probe_every_s``
+   idle cadence; the first fingerprint observed per prompt is golden
+   and every later disagreement is a confirmed probe failure — so even
+   replicas the sample never lands on get audited.
+
+A confirmed-diverging replica transitions to ``QUARANTINED`` through
+the fleet's counted ``_set_state`` choke point (``quarantine=1``): the
+router excludes it, its in-flight requests re-admit on survivors via
+the existing failover machinery (stitched output bit-identical), and
+it is never restarted. The ``flip@replica=K[:step=N]`` chaos spec
+perturbs one decode-step token to drive the end-to-end drill
+(``scripts/obs_audit.py --selftest``).
+
+Arming: ``TPUNN_AUDIT=`` (chaos-style spec grammar):
+
+    TPUNN_AUDIT=1                              # defaults
+    TPUNN_AUDIT=sample=1.0:probe_every_s=0.5   # shadow all, fast probes
+
+Design contract (the chaos/watchtower/trace/meter lint rules, enforced
+by tests/test_quality.py):
+
+- **Inert when unset.** Every ``on_*`` hook opens with the literal
+  ``if _audit is None: return`` — an unset ``TPUNN_AUDIT`` costs one
+  global load + one comparison per hook and performs ZERO registry or
+  flight-ring writes (instruments are registered at arm time), and no
+  ``fp`` key appears on any wire record.
+- **Emit-first.** Every audit observation lands in the flight ring
+  before the registry sees it (:meth:`AuditEngine._emit`'s first
+  statement).
+- **Single-homed fingerprints.** The engine folds a request's chain in
+  exactly one call site (``_finish_record`` → :func:`on_retire`).
+
+Stdlib-only (no jax, no numpy): ``fleet_worker.py`` imports this
+before deciding whether to touch a backend.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+import logging
+import os
+import time
+
+from pytorch_distributed_nn_tpu.obs import flight
+from pytorch_distributed_nn_tpu.obs.registry import get_registry
+from pytorch_distributed_nn_tpu.runtime import failure
+
+log = logging.getLogger(__name__)
+
+ENV_AUDIT = "TPUNN_AUDIT"
+
+# the chain seed of a fresh request (no emitted tokens yet)
+GENESIS = "0" * 40
+
+# reserved tenant for shadow/probe legs: the scheduler counts it like
+# any tenant, but Abacus drops it (the customer is never double-billed
+# for an audit duplicate) and the engine skips its TTFT observation
+SHADOW_TENANT = "audit-shadow"
+
+# canned golden-probe workload: tiny fixed prompt + budget, token ids
+# low enough for every test vocab; greedy decode makes the fingerprint
+# deterministic per (model, params)
+PROBE_PROMPT = (3, 1, 4, 1, 5)
+PROBE_BUDGET = 4
+
+
+def chain(seed: str, tokens) -> str:
+    """Rolling sha1 fingerprint chain over emitted token ids.
+
+    Token-by-token fold so the chain is resumable across request
+    rewrites: ``chain(chain(s, a), b) == chain(s, list(a) + list(b))``
+    — a re-admitted/handed-off leg seeded with the chain over its
+    carried prefix ends at exactly the fingerprint one uninterrupted
+    leg would have produced (tests/test_audit.py)."""
+    fp = seed or GENESIS
+    for t in tokens:
+        fp = hashlib.sha1(f"{fp}:{int(t)}".encode("ascii")).hexdigest()
+    return fp
+
+
+@dataclasses.dataclass
+class AuditConfig:
+    """``TPUNN_AUDIT`` spec knobs (chaos-grammar ``key=value:...``)."""
+
+    sample: float = 0.25     # shadow-replay fraction (request-id hash)
+    shadow: int = 1          # 0 disables shadow replay entirely
+    probe_every_s: float = 0.0  # golden-probe idle cadence (0 = off)
+    quarantine: int = 1      # 0 = page on divergence but never isolate
+
+
+_FIELD_TYPES = {f.name: f.type for f in dataclasses.fields(AuditConfig)}
+
+
+def parse_spec(spec: str) -> AuditConfig:
+    """``TPUNN_AUDIT`` spec → :class:`AuditConfig`. ``"1"`` / ``"on"``
+    mean defaults; otherwise ``:``-separated ``key=value`` overrides.
+    Unknown keys raise (a typo'd audit spec must fail loudly, not
+    silently audit nothing — the chaos-spec contract)."""
+    cfg = AuditConfig()
+    spec = (spec or "").strip()
+    if spec in ("", "1", "on", "true"):
+        return cfg
+    for field in filter(None, spec.split(":")):
+        key, eq, value = field.partition("=")
+        key = key.strip()
+        if not eq or key not in _FIELD_TYPES:
+            raise ValueError(
+                f"unknown audit key {key!r} in {spec!r}; have "
+                f"{sorted(_FIELD_TYPES)}")
+        try:
+            kind = _FIELD_TYPES[key]
+            setattr(cfg, key,
+                    value if kind in (str, "str")
+                    else int(value) if kind in (int, "int")
+                    else float(value))
+        except ValueError:
+            raise ValueError(
+                f"bad value for audit key {key!r}: {value!r}") from None
+    if not 0.0 <= cfg.sample <= 1.0:
+        raise ValueError(f"sample must be in [0, 1], got {cfg.sample}")
+    if cfg.shadow not in (0, 1):
+        raise ValueError(f"shadow must be 0 or 1, got {cfg.shadow}")
+    if cfg.probe_every_s < 0:
+        raise ValueError(
+            f"probe_every_s must be >= 0, got {cfg.probe_every_s}")
+    if cfg.quarantine not in (0, 1):
+        raise ValueError(
+            f"quarantine must be 0 or 1, got {cfg.quarantine}")
+    return cfg
+
+
+class AuditEngine:
+    """Per-process audit state. One instance per armed process (module
+    singleton); an in-process fleet's engines all record into the same
+    audit, and the store transport makes worker fingerprints comparable
+    coordinator-side."""
+
+    def __init__(self, config: AuditConfig, *, rank: int = 0,
+                 metrics=None) -> None:
+        self.cfg = config
+        self.rank = int(rank)
+        self.metrics = metrics  # MetricsLogger | None
+        # request_id -> {fp, n, replica} (latest leg wins; the final
+        # record IS the full chain because legs are seeded)
+        self.fingerprints: dict[str, dict] = {}
+        self.goldens: dict[str, str] = {}  # probe key -> golden fp
+        self.divergences: list[dict] = []
+        self.quarantines: list[dict] = []
+        self.probes = 0
+        self.probe_failures = 0
+        self.last_fp_t = 0.0
+        self._published = 0
+        reg = get_registry()
+        self._c_fps = reg.counter(
+            "audit_fingerprints_total",
+            "request fingerprints recorded (one per completed leg)")
+        self._c_div = reg.counter(
+            "audit_divergence_total",
+            "confirmed output divergences", labels=("kind",))
+        self._c_probe_fail = reg.counter(
+            "audit_probe_failures_total",
+            "golden-probe fingerprint mismatches")
+
+    # -- the one ring choke point (emit-first, lint-enforced) --------------
+
+    def _emit(self, op: str, *, note: str = "") -> None:
+        flight.record("audit", op, note=note)
+
+    # -- fingerprints ------------------------------------------------------
+
+    def sampled(self, request_id: str) -> bool:
+        """Deterministic shadow sample: same sha1-hash draw as
+        Causeway's sampler, so a request is in or out identically on
+        every process that asks."""
+        if self.cfg.sample >= 1.0:
+            return True
+        if self.cfg.sample <= 0.0:
+            return False
+        h = int(hashlib.sha1(request_id.encode()).hexdigest()[:8], 16)
+        return h / float(0xFFFFFFFF) < self.cfg.sample
+
+    def record(self, request_id: str, fp: str, *, n: int = 0,
+               replica: str = "") -> None:
+        self._emit("fingerprint",
+                   note=f"{request_id} {fp[:12]} n={n} {replica}".strip())
+        self.fingerprints[request_id] = dict(fp=fp, n=int(n),
+                                             replica=str(replica))
+        self.last_fp_t = time.time()
+        self._c_fps.inc()
+
+    def fingerprint_of(self, request_id: str) -> str | None:
+        rec = self.fingerprints.get(request_id)
+        return None if rec is None else rec["fp"]
+
+    # -- divergences / probes / quarantine ---------------------------------
+
+    def divergence(self, kind: str, *, request_id: str = "",
+                   pair=(), suspect: str = "", note: str = "") -> dict:
+        rec = dict(kind=str(kind), request_id=str(request_id),
+                   pair=[str(p) for p in pair], suspect=str(suspect))
+        self._emit("divergence",
+                   note=f"{kind} {request_id} pair={rec['pair']} "
+                        f"suspect={suspect} {note}".strip())
+        self.divergences.append(rec)
+        self._c_div.inc(kind=str(kind))
+        if self.metrics is not None:
+            self.metrics.emit("audit_divergence", **rec)
+        log.warning("audit divergence: %s %s pair=%s suspect=%s",
+                    kind, request_id, rec["pair"], suspect)
+        return rec
+
+    def probe_result(self, key: str, replica: str, fp: str) -> bool:
+        """Compare one probe completion against the golden. The first
+        fingerprint observed per probe key BECOMES the golden (greedy
+        decode is deterministic per (model, params), so any honest
+        replica produces it)."""
+        golden = self.goldens.get(key)
+        if golden is None:
+            self.goldens[key] = fp
+            ok = True
+        else:
+            ok = fp == golden
+        self._emit("probe", note=f"{key} {replica} ok={int(ok)}")
+        self.probes += 1
+        if not ok:
+            self.probe_failures += 1
+            self._c_probe_fail.inc()
+        if self.metrics is not None:
+            self.metrics.emit("audit_probe", key=key,
+                              replica=str(replica), ok=int(ok))
+        return ok
+
+    def quarantined(self, replica: str, reason: str) -> None:
+        """Bookkeeping only — the state change itself goes through the
+        fleet's counted ``_set_state`` choke point."""
+        self._emit("quarantine", note=f"{replica} {reason}".strip())
+        self.quarantines.append(dict(replica=str(replica),
+                                     reason=str(reason)))
+
+    def summary(self) -> dict:
+        return dict(
+            fingerprints=len(self.fingerprints),
+            divergences=len(self.divergences),
+            probes=self.probes,
+            probe_failures=self.probe_failures,
+            quarantines=list(self.quarantines),
+            rank=self.rank,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Module singleton + the inert hooks (chaos-style lint contract)
+# ---------------------------------------------------------------------------
+
+_audit: AuditEngine | None = None
+
+
+def maybe_init(spec: str | None = None, *, rank: int | None = None,
+               metrics=None,
+               config: AuditConfig | None = None) -> AuditEngine | None:
+    """Arm the process audit from ``TPUNN_AUDIT`` (or an explicit
+    ``spec``/``config``). No-op beyond one env read when unset or
+    ``"0"``; idempotent when armed."""
+    global _audit
+    if _audit is not None:
+        return _audit
+    spec = os.environ.get(ENV_AUDIT) if spec is None else spec
+    if not spec or spec == "0":
+        return None
+    _audit = AuditEngine(
+        config if config is not None else parse_spec(spec),
+        rank=flight.default_rank() if rank is None else rank,
+        metrics=metrics,
+    )
+    log.warning("audit armed: %s (rank %d)", spec, _audit.rank)
+    return _audit
+
+
+def enabled() -> bool:
+    return _audit is not None
+
+
+def spec() -> str:
+    """The armed config re-serialized as a spec string — what a
+    coordinator exports into worker-process environments so a
+    programmatically-armed fleet arms its subprocesses too. Empty when
+    unarmed (callers leave the env var unset)."""
+    if _audit is None:
+        return ""
+    c = _audit.cfg
+    return (f"sample={c.sample}:shadow={c.shadow}:"
+            f"probe_every_s={c.probe_every_s}:quarantine={c.quarantine}")
+
+
+def audit() -> AuditEngine | None:
+    return _audit
+
+
+def reset() -> None:
+    """Disarm (test isolation)."""
+    global _audit
+    _audit = None
+
+
+def attach_metrics(metrics) -> None:
+    """Late-bind the JSONL sink (engines/fleets construct after
+    arming). Not a hot-path hook, but still inert-guarded."""
+    if _audit is None:
+        return
+    if metrics is not None:
+        _audit.metrics = metrics
+
+
+def summary() -> dict | None:
+    """Fingerprint/divergence/probe tallies; None when unarmed
+    (consumers key their sections off the None)."""
+    if _audit is None:
+        return None
+    return _audit.summary()
+
+
+# -- policy accessors (inert-guarded, cheap) --------------------------------
+
+
+def shadow_sampled(request_id: str) -> bool:
+    """Should the fleet duplicate this request onto a shadow replica?
+    Deterministic per request id; always False unarmed."""
+    if _audit is None:
+        return False
+    if not _audit.cfg.shadow:
+        return False
+    return _audit.sampled(request_id)
+
+
+def probe_interval() -> float:
+    """Golden-probe cadence in seconds; 0.0 = no probing (or unarmed)."""
+    if _audit is None:
+        return 0.0
+    return _audit.cfg.probe_every_s
+
+
+def quarantine_enabled() -> bool:
+    if _audit is None:
+        return False
+    return bool(_audit.cfg.quarantine)
+
+
+def seed_of(tokens) -> str:
+    """Chain seed for a leg that carries ``tokens`` as its already-
+    emitted prefix (failover re-admission, disagg handoff, process
+    dispatch). Empty string when unarmed — so wire records stay
+    key-absent and byte-identical."""
+    if _audit is None:
+        return ""
+    return chain("", tokens)
+
+
+def fingerprint_of(request_id: str) -> str | None:
+    if _audit is None:
+        return None
+    return _audit.fingerprint_of(request_id)
+
+
+# -- hooks (every one: inert fast path, lint-enforced) ----------------------
+
+
+def on_retire(request_id: str, tokens, *, seed: str = "",
+              replica: str = "") -> str | None:
+    """Engine retire (``ServingEngine._finish_record`` — the single
+    lint-pinned fingerprint call site): fold the leg's emitted tokens
+    onto its chain seed. Returns the fingerprint, or None unarmed (the
+    ``fp`` key stays absent from every record)."""
+    if _audit is None:
+        return None
+    fp = chain(seed, tokens)
+    _audit.record(request_id, fp, n=len(tokens), replica=replica)
+    return fp
+
+
+def on_worker_done(rec: dict, tokens, *, host: int) -> dict | None:
+    """fleet_worker completion: the leg fingerprint, seeded by the
+    chain the coordinator dispatched (``rec["fp"]``, key-absent
+    unarmed). Returns the ``fp/<rid>`` payload to publish, or None."""
+    if _audit is None:
+        return None
+    seed = rec.get("fp", "")
+    fp = chain(seed, tokens)
+    rid = str(rec.get("request_id", ""))
+    _audit.record(rid, fp, n=len(tokens), replica=f"proc{host}")
+    return dict(fp=fp, n=len(tokens), replica=int(host),
+                life=int(rec.get("life", 0)))
+
+
+def on_divergence(kind: str, *, request_id: str = "", pair=(),
+                  suspect: str = "", note: str = "") -> dict | None:
+    if _audit is None:
+        return None
+    return _audit.divergence(kind, request_id=request_id, pair=pair,
+                             suspect=suspect, note=note)
+
+
+def on_probe_result(key: str, replica: str, fp: str) -> bool:
+    """True = probe matched golden (or audit unarmed — never a false
+    alarm on an unarmed process)."""
+    if _audit is None:
+        return True
+    return _audit.probe_result(key, replica, fp)
+
+
+def on_quarantine(replica: str, reason: str) -> None:
+    if _audit is None:
+        return
+    _audit.quarantined(replica, reason)
+
+
+def maybe_publish(client, *, rank: int) -> bool:
+    """Publish this process's audit summary at ``audit/<rank>`` (the
+    fleet_deploy status + coordinator rollup feed). Inert no-op when
+    unarmed or nothing new since the last publish; never raises into
+    the serve loop."""
+    if _audit is None:
+        return False
+    n = len(_audit.fingerprints) + len(_audit.divergences) + _audit.probes
+    if n == _audit._published:
+        return False
+    payload = dict(_audit.summary(), last_fp_t=_audit.last_fp_t)
+    wire = json.dumps(payload, sort_keys=True).encode()
+    out = failure.store_call(
+        lambda: (client.set(f"audit/{rank}", wire), True)[-1],
+        op="audit_publish", deadline_s=0.5, fallback=None)
+    if out is None:
+        log.warning("audit publish failed (rank %d)", rank)
+        return False
+    _audit._published = n
+    return True

@@ -54,7 +54,11 @@ Event kinds:
 - ``xray`` — profiler lifecycle (obs/xray.py): ``capture`` /
   ``capture_done`` markers (the note names the trigger and the capture
   directory) and per-compilation ``compile`` breadcrumbs, so a dump
-  names the captures that exist for the incident.
+  names the captures that exist for the incident;
+- ``audit`` — Lighthouse output-integrity observations (obs/audit.py):
+  ``fingerprint`` / ``divergence`` / ``probe`` / ``quarantine``
+  markers, emit-first — a divergence dump already names the
+  disagreeing replicas and the suspect.
 
 Stdlib-only on purpose: dump paths run inside signal handlers and
 heartbeat daemon threads of processes whose main thread is wedged
@@ -112,6 +116,7 @@ class FlightEvent:
     seq: int
     kind: str  # collective | dispatch | step | checkpoint | data
     #          # | chaos | preempt | serve | alert | fleet | xray
+    #          # | audit
     op: str
     step: int
     t0: float

@@ -74,7 +74,7 @@ import os
 import threading
 import time
 
-from pytorch_distributed_nn_tpu.obs import flight
+from pytorch_distributed_nn_tpu.obs import audit, flight
 from pytorch_distributed_nn_tpu.obs.registry import get_registry
 
 log = logging.getLogger(__name__)
@@ -499,8 +499,12 @@ def summary() -> dict | None:
 
 def on_request_state(request_id: str, tenant: str, state: str) -> None:
     """Scheduler ``_transition`` feed (lint-pinned to that one choke
-    point): binds seq -> tenant before any pool reservation bills."""
+    point): binds seq -> tenant before any pool reservation bills.
+    Lighthouse shadow/probe legs (the reserved audit tenant) are
+    duplicates of already-billed traffic and never enter a ledger."""
     if _meter is None:
+        return
+    if tenant == audit.SHADOW_TENANT:
         return
     _meter.request_state(request_id, tenant, state)
 

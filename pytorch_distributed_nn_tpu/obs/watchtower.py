@@ -104,7 +104,7 @@ PAGE = "page"
 ALERT_KINDS = ("step_time_outlier", "loss_spike", "loss_nonfinite",
                "straggler_drift", "queue_pressure", "kv_pressure",
                "slo_burn_rate", "goodput_drop", "replica_down",
-               "recompile_storm", "cost_anomaly")
+               "recompile_storm", "cost_anomaly", "output_divergence")
 
 
 @dataclasses.dataclass
@@ -552,6 +552,27 @@ class Watchtower:
             attribution={"replica": replica, "reason": reason,
                          "stranded_requests": stranded})
 
+    def _obs_output_divergence(self, ev: dict) -> None:
+        """Lighthouse feed (obs/audit.py): two legs of the same request
+        — or a golden probe — produced different fingerprint chains.
+        Always a page: every metric around the diverging replica is
+        green by construction (that is the failure mode), so this
+        alert is the ONLY line of defense. Names the disagreeing pair
+        and the suspected replica; the page auto-dump + Xray capture
+        preserve the evidence before quarantine tears the replica out
+        of the fleet."""
+        t = float(ev["t"])
+        kind = str(ev.get("check", ""))
+        rid = str(ev.get("request_id", ""))
+        pair = [str(p) for p in ev.get("pair", [])]
+        suspect = str(ev.get("suspect", ""))
+        self._raise(
+            "output_divergence", PAGE, t, value=1.0,
+            detail=f"output divergence ({kind}) on {rid or 'probe'}: "
+                   f"replicas {pair} disagree; suspect {suspect or '?'}",
+            attribution={"check": kind, "request_id": rid,
+                         "pair": pair, "suspect": suspect})
+
     def _obs_compile(self, ev: dict) -> None:
         """Compile-telemetry feed (obs/xray.py log watch): the same
         function re-compiling ``recompile_min`` times inside a
@@ -625,6 +646,7 @@ class Watchtower:
         "replica_down": _obs_replica_down,
         "compile": _obs_compile,
         "tenant_cost": _obs_tenant_cost,
+        "output_divergence": _obs_output_divergence,
     }
 
     # -- burn-rate core --------------------------------------------------
@@ -767,6 +789,13 @@ def events_from_jsonl(rec: dict) -> list[dict]:
                     "replica": int(rec.get("replica", -1)),
                     "reason": rec.get("reason", ""),
                     "stranded": rec.get("stranded", [])})
+    elif ev == "audit_divergence":
+        # Lighthouse replay: a recorded divergence re-raises the page
+        out.append({"ev": "output_divergence", "t": t,
+                    "check": rec.get("kind", ""),
+                    "request_id": rec.get("request_id", ""),
+                    "pair": rec.get("pair", []),
+                    "suspect": rec.get("suspect", "")})
     elif ev == "meter_request":
         # Abacus replay: a recorded run's per-request billing drives
         # the cost band exactly as the live on_tenant_cost hook did
@@ -913,6 +942,22 @@ def on_replica_down(replica: int, reason: str,
     _tower.observe({"ev": "replica_down", "t": time.time(),
                     "replica": int(replica), "reason": str(reason),
                     "stranded": list(stranded or [])})
+
+
+def on_output_divergence(kind: str, *, request_id: str = "",
+                         pair=(), suspect: str = "") -> None:
+    """Lighthouse hook (obs/audit.py): a confirmed fingerprint
+    divergence — shadow-replay mismatch or golden-probe failure.
+    ``pair`` names the disagreeing replicas, ``suspect`` the one the
+    tie-break blamed. Both layers armed independently (the audit
+    records the divergence either way; the page needs the tower)."""
+    if _tower is None:
+        return
+    _tower.observe({"ev": "output_divergence", "t": time.time(),
+                    "check": str(kind),
+                    "request_id": str(request_id),
+                    "pair": [str(p) for p in pair],
+                    "suspect": str(suspect)})
 
 
 def on_compile(name: str, seconds: float) -> None:

@@ -116,6 +116,17 @@ Spec grammar (faults joined by ``;``)::
                                          partition drill (bounded
                                          re-pull then cold re-prefill,
                                          never a wedged request)
+    flip@replica=K[:step=N][:after_s=...]
+                                         flip ONE emitted token id on
+                                         replica K (once; step= keys on
+                                         the replica's decode round) —
+                                         the silent-corruption drill
+                                         for Lighthouse (obs/audit.py):
+                                         every metric stays green, only
+                                         the output is wrong, and the
+                                         audit layer must detect the
+                                         fingerprint divergence, page,
+                                         and quarantine the replica
 
 ``rank`` / ``inc`` (incarnation, from ``TPUNN_RESTART``) are optional
 filters; a fault without them fires in every process / incarnation.
@@ -168,7 +179,7 @@ FAULT_KINDS = ("crash", "hang", "slow", "preempt", "corrupt_ckpt",
                "store_flaky", "serve_reject", "kill_replica",
                "hang_replica", "kill_coordinator", "store_partition",
                "evict_prefix", "tenant_flood", "kill_transfer",
-               "corrupt_wire")
+               "corrupt_wire", "flip")
 
 _INT_KEYS = ("step", "rank", "inc", "replica", "seq")
 _FLOAT_KEYS = ("ms", "p", "after_s", "rps")
@@ -277,6 +288,7 @@ def _validate(fault: Fault) -> None:
         "kill_coordinator": ("after_s",), "store_partition": ("ms",),
         "evict_prefix": ("p",), "tenant_flood": ("tenant", "rps"),
         "kill_transfer": ("step",), "corrupt_wire": (),
+        "flip": ("replica",),
     }[fault.kind]
     for key in need:
         missing = (getattr(fault, key) in (None, "", 0.0)
@@ -497,6 +509,27 @@ class ChaosEngine:
             else:
                 self._inject_hang_replica(fault, replica)
 
+    def flip_token(self, replica: int, step: int) -> bool:
+        """Serving token-collect hook (flip): True = the engine must
+        perturb the token it just fetched for this ``replica``'s
+        ``step``-th decode round. Fires once; ``step=`` keys on the
+        replica's own round counter, ``after_s=`` on wall time since
+        arming. The engine owns the actual bit-flip — chaos only
+        declares it, forensically (emit-first), so Lighthouse's later
+        divergence page can never be mistaken for real HBM rot."""
+        for i, fault in enumerate(self.faults):
+            if (fault.kind != "flip" or i in self._fired
+                    or fault.replica != replica
+                    or not self._matches(fault, step=step)):
+                continue
+            if fault.after_s \
+                    and time.monotonic() - self._t0 < fault.after_s:
+                continue
+            self._fired.add(i)
+            self._inject_flip(fault, replica, step)
+            return True
+        return False
+
     def transfer(self, src: int, dst: int) -> None:
         """KV block-streaming hook (kill_transfer). ``step=`` keys on
         the process-wide transfer ordinal (1-based: the Nth transfer),
@@ -619,6 +652,15 @@ class ChaosEngine:
         # handle (bounded re-pull, then cold re-prefill) — the flight
         # ring must already hold the injection when it does
         self._emit(fault, note=f"{fault.spec} [chunk {seq}]")
+
+    def _inject_flip(self, fault: Fault, replica: int,
+                     step: int) -> None:
+        # emit-first (lint): the perturbation itself happens in the
+        # engine's token collect — the flight ring must already name
+        # this as an *injected* flip when Lighthouse's divergence page
+        # fires, or the drill would be indistinguishable from real rot
+        self._emit(fault, step=step,
+                   note=f"{fault.spec} [replica {replica}]")
 
     def _inject_hang_replica(self, fault: Fault, replica: int) -> None:
         self._emit(fault, note=f"{fault.spec} [replica {replica}]")
@@ -787,6 +829,19 @@ def on_wire_chunk(seq: int) -> bool:
     if _engine is None:
         return False
     return _engine.wire_chunk(seq)
+
+
+def on_flip_token(replica: int, step: int) -> bool:
+    """``serve.engine`` token-collect hook (flip).
+
+    True when chaos says to perturb the one token this replica just
+    fetched this round; the engine owns the actual flip (the corrupted
+    id flows into the slot, the JSONL record, and the fingerprint
+    chain like a real silent corruption would). Lighthouse
+    (:mod:`obs.audit`) owns detection and quarantine."""
+    if _engine is None:
+        return False
+    return _engine.flip_token(replica, step)
 
 
 def on_replica_round(replica: int, round_: int) -> None:

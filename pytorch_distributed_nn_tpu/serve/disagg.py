@@ -76,7 +76,7 @@ import time
 import jax
 import numpy as np
 
-from pytorch_distributed_nn_tpu.obs import flight, trace
+from pytorch_distributed_nn_tpu.obs import audit, flight, trace
 from pytorch_distributed_nn_tpu.obs.registry import get_registry
 from pytorch_distributed_nn_tpu.ops import collectives
 from pytorch_distributed_nn_tpu.runtime import chaos
@@ -195,7 +195,11 @@ class DisaggFleet(Fleet):
             request_id=ticket.request_id, resubmit=resubmit,
             tenant=ticket.tenant,
             trace_ctx=ticket.trace, t_origin=ticket.t_submit,
-            t_first_origin=ticket.t_first_token)
+            t_first_origin=ticket.t_first_token,
+            # Lighthouse: the decode leg resumes the prefill leg's
+            # fingerprint chain (seed = chain over the stitched prefix)
+            fp_seed=audit.seed_of(ticket.prefix)
+            if audit.enabled() else "")
         ticket._attempt = (h.index, req)
         if req.done.is_set() and req.state == REJECTED:
             self._finalize_rejected(ticket, req.reject_reason)

@@ -49,6 +49,7 @@ from pytorch_distributed_nn_tpu.inference.generate import (
 )
 from pytorch_distributed_nn_tpu.nn.lora import num_adapters
 from pytorch_distributed_nn_tpu.obs import (
+    audit,
     flight,
     meter,
     trace,
@@ -274,6 +275,12 @@ class ServingEngine:
         trace.attach_metrics(metrics)
         # Abacus: same contract for an armed meter (TPUNN_METER)
         meter.attach_metrics(metrics)
+        # Lighthouse: same contract for an armed audit (TPUNN_AUDIT)
+        audit.attach_metrics(metrics)
+        # fleet replica index (stamped by the fleet supervisor): the
+        # chaos flip@replica=K drill keys on it; standalone engines
+        # keep 0
+        self.replica_index = 0
         # analytic FLOPs per token (utils/flops.py XLA count at batch
         # 1, seq 1): computed lazily on first metered billing, never
         # when the meter is unarmed; 0 = no cost model reachable
@@ -421,8 +428,11 @@ class ServingEngine:
         # enabled() gate so the slot scan + FLOPs lookup never run on
         # an unarmed process (the armed-vs-unset A/B contract)
         if meter.enabled():
+            # Lighthouse shadow/probe legs are audit duplicates, not
+            # customer traffic — their decode rounds are never billed
             meter.on_decode_round(
-                [s.req.tenant for s in self._slots if s is not None],
+                [s.req.tenant for s in self._slots if s is not None
+                 and s.req.tenant != audit.SHADOW_TENANT],
                 self.flops_per_token())
         retired = self._collect(host_tok)
         if retired:
@@ -533,7 +543,8 @@ class ServingEngine:
                            f"cached={m}")
         # Abacus prefill billing: the suffix actually computed, plus
         # the cached-prefix FLOPs the restore SKIPPED as a credit
-        if meter.enabled():
+        # (audit shadow/probe legs are never billed)
+        if meter.enabled() and req.tenant != audit.SHADOW_TENANT:
             meter.on_prefill(req.request_id, req.tenant,
                              new_tokens=T, cached_tokens=m,
                              flops_per_token=self.flops_per_token())
@@ -565,17 +576,35 @@ class ServingEngine:
     def _collect(self, host_tok: np.ndarray) -> int:
         """Fold one round's tokens into the host slot mirrors and
         retire rows that hit eos or budget. Returns retired count."""
+        # chaos flip@replica=K: perturb ONE fetched token (first active
+        # slot) this round — a silent corruption: the wrong id flows
+        # into the slot mirror, the JSONL record, and the fingerprint
+        # chain exactly as flaky HBM would ship it. Host-side, outside
+        # _decode_round (its hot-loop lint bans extras).
+        flip = chaos.on_flip_token(self.replica_index,
+                                   self.scheduler.round)
+        flipped = False
         for i, s in enumerate(self._slots):
             if s is None:
                 continue
             tok = int(host_tok[i])
+            if flip:
+                flip = False
+                flipped = True
+                tok = tok - 1 if tok > 0 else tok + 1
             s.tokens.append(tok)
             s.emitted += 1
             s.depth += 1
             self._h_last[i] = tok
             self._h_depth[i] = s.depth
             self.scheduler.pool.extend(s.req.request_id, s.depth)
-        return self._retire_finished()
+        retired = self._retire_finished()
+        if flipped:
+            # push the corrupted last-token mirror to device (mirrors
+            # are all current here) so the flip PROPAGATES: subsequent
+            # tokens condition on the wrong id, exactly like real rot
+            self._sync_slots()
+        return retired
 
     def _done(self, s: _Slot) -> bool:
         if s.emitted >= s.req.max_new_tokens:
@@ -700,13 +729,22 @@ class ServingEngine:
             # key absent when untraced, so replayed streams from an
             # unarmed run stay byte-identical)
             rec["trace"] = req.trace.trace_id
+        # Lighthouse fingerprint: THE one engine call site that folds a
+        # request's emitted tokens onto its chain seed (lint-pinned).
+        # None unarmed — the fp key stays absent and the record stream
+        # is byte-identical to a pre-audit run.
+        fp = audit.on_retire(req.request_id, s.tokens,
+                             seed=req.fp_seed, replica=self.tag)
+        if fp is not None:
+            rec["fp"] = fp
         self.completed.append(rec)
         if self.metrics is not None:
             self.metrics.emit("serve_request", **rec)
         watchtower.on_serve_request(rec)
         # Abacus lifecycle charges (queue/decode wall time, tokens,
-        # the per-request JSONL record, the cost-anomaly feed)
-        if meter.enabled():
+        # the per-request JSONL record, the cost-anomaly feed). Audit
+        # shadow/probe legs are duplicates, never billed.
+        if meter.enabled() and req.tenant != audit.SHADOW_TENANT:
             meter.on_request_done(rec, self.flops_per_token())
         # Causeway segments, retroactive from the scheduler's
         # lifecycle timestamps — the decode hot loop stays untouched
@@ -719,9 +757,14 @@ class ServingEngine:
                          req.t_first_token, request_id=req.request_id,
                          replica=self.tag, cached=s.cached,
                          prompt_len=len(req.prompt))
+        seg_kw = dict(request_id=req.request_id, replica=self.tag,
+                      tokens=s.emitted)
+        if fp is not None:
+            # the decode span carries the leg fingerprint so a trace
+            # waterfall can show WHERE a chain diverged across legs
+            seg_kw["fp"] = fp
         trace.on_segment(req.trace, "decode", req.t_first_token,
-                         req.t_done, request_id=req.request_id,
-                         replica=self.tag, tokens=s.emitted)
+                         req.t_done, **seg_kw)
         tracer = obs.current_recorder()
         if tracer is not None:
             # retroactive per-request span: duration is only known now

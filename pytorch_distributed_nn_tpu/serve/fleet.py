@@ -59,13 +59,20 @@ from typing import Optional
 import numpy as np
 
 from pytorch_distributed_nn_tpu.launch import RestartPolicy
-from pytorch_distributed_nn_tpu.obs import flight, meter, trace, watchtower
+from pytorch_distributed_nn_tpu.obs import (
+    audit,
+    flight,
+    meter,
+    trace,
+    watchtower,
+)
 from pytorch_distributed_nn_tpu.obs.registry import get_registry
 from pytorch_distributed_nn_tpu.runtime import chaos, failure
 from pytorch_distributed_nn_tpu.serve.engine import ServingEngine
 from pytorch_distributed_nn_tpu.serve.router import (
     DEAD,
     DRAINING,
+    QUARANTINED,
     READY,
     RELOADING,
     STARTING,
@@ -312,6 +319,14 @@ class Fleet:
         self._journal: dict[str, FleetTicket] = {}
         self.completed: list[dict] = []
         self.failovers = 0
+        # Lighthouse (obs/audit.py) shadow-replay bookkeeping: pending
+        # comparisons keyed by the primary's request id. Empty forever
+        # on an unarmed process (shadow_sampled is always False).
+        self._shadows: dict[str, dict] = {}
+        self._referees: dict[str, tuple[int, object]] = {}
+        self._probes: list[tuple[int, object]] = []
+        self._probe_n = 0
+        self._last_probe_t = time.monotonic()
         reg = get_registry()
         self._c_replica_state = reg.counter(
             "serve_replica_state_total", "replica state transitions",
@@ -366,6 +381,9 @@ class Fleet:
         h.engine = ServingEngine(
             self.model, params, eos_token=self.eos_token,
             metrics=self.metrics, tag=h.name, **self._engine_kw)
+        # chaos flip@replica=K keys on the fleet index (obs/audit.py
+        # silent-corruption drill); standalone engines keep 0
+        h.engine.replica_index = h.index
         h.reporter = failure.HeartbeatReporter(
             self._store, rank=h.index, incarnation=0,
             interval_s=self._hb_interval,
@@ -524,8 +542,17 @@ class Fleet:
         ticket.trace = trace.on_submit(ticket.request_id)
         with self._lock:
             self._journal[ticket.request_id] = ticket
-            self._place(ticket, prompt, int(max_new_tokens),
-                        resubmit=False)
+            placed = self._place(ticket, prompt, int(max_new_tokens),
+                                 resubmit=False)
+            # Lighthouse shadow replay: a deterministic request-id-hash
+            # sample runs AGAIN on a second replica; the fingerprint
+            # compare happens in _audit_poll once both legs finish.
+            # Inert one-call no-op unless TPUNN_AUDIT armed it.
+            if placed is not None \
+                    and audit.shadow_sampled(ticket.request_id):
+                self._submit_shadow(ticket, prompt,
+                                    int(max_new_tokens),
+                                    primary=placed)
         return ticket
 
     def generate(self, prompt, max_new_tokens: int,
@@ -578,7 +605,11 @@ class Fleet:
             request_id=ticket.request_id, resubmit=resubmit,
             tenant=ticket.tenant,
             trace_ctx=ticket.trace, t_origin=ticket.t_submit,
-            t_first_origin=ticket.t_first_token)
+            t_first_origin=ticket.t_first_token,
+            # Lighthouse: the leg resumes the chain over the tokens
+            # earlier lives already emitted ("" unarmed — key-absent)
+            fp_seed=audit.seed_of(ticket.prefix)
+            if audit.enabled() else "")
         ticket._attempt = (h.index, req)
         if req.done.is_set() and req.state == REJECTED:
             self._finalize_rejected(ticket, req.reject_reason)
@@ -598,6 +629,11 @@ class Fleet:
             self._promote_joining()
             self._reap_retiring()
             self._finalize_tickets()
+            # Lighthouse: golden probes at idle cadence + pending
+            # shadow/referee fingerprint comparisons. Both are inert
+            # one-call no-ops unless TPUNN_AUDIT armed the process.
+            self._maybe_probe()
+            self._audit_poll()
 
     def _check_exits(self) -> None:
         for h in self._replicas:
@@ -643,6 +679,15 @@ class Fleet:
                               reason=reason, stranded=ids)
         log.warning("fleet: replica %s down (%s), re-admitting %d "
                     "stranded request(s)", h.name, reason, len(ids))
+        # Lighthouse legs on the dead replica can never finish — drop
+        # their pending comparisons (shadows are never journaled, so
+        # the failover machinery above does not touch them)
+        self._shadows = {rid: p for rid, p in self._shadows.items()
+                         if p["sidx"] != h.index}
+        self._referees = {rid: r for rid, r in self._referees.items()
+                          if r[0] != h.index}
+        self._probes = [(i, r) for i, r in self._probes
+                        if i != h.index]
         t_detect = time.monotonic()
         for ticket, emitted in stranded:
             self._readmit(ticket, emitted, from_replica=h.index,
@@ -727,6 +772,227 @@ class Fleet:
         if self.metrics is not None:
             self.metrics.emit("fleet_failover",
                               request_id=ticket.request_id, **fo)
+
+    # -- Lighthouse output-integrity auditing (obs/audit.py) ---------------
+
+    def _submit_shadow(self, ticket: FleetTicket, prompt: np.ndarray,
+                       max_new: int, *, primary: int) -> None:
+        """Duplicate one sampled request onto a second READY replica
+        (caller holds the fleet lock). The shadow leg rides the
+        reserved audit tenant — never billed, never TTFT-observed
+        (``t_first_origin`` pre-set) — and is not journaled: it can
+        never fail over, only finish or die with its replica."""
+        h = self.router.place_shadow(
+            self._replicas, len(prompt) + max_new,
+            exclude=primary, prompt=prompt)
+        if h is None:
+            return  # single-replica fleet: nothing to compare against
+        try:
+            req = h.engine.submit(
+                prompt, max_new,
+                request_id=ticket.request_id + "#shadow",
+                tenant=audit.SHADOW_TENANT,
+                t_first_origin=ticket.t_submit)
+        except ValueError:
+            return
+        if req.done.is_set() and req.state == REJECTED:
+            return
+        self._shadows[ticket.request_id] = dict(
+            ticket=ticket, sreq=req, sidx=h.index)
+
+    def _maybe_probe(self) -> None:
+        """Push the canned golden probe through every READY replica at
+        ``probe_every_s`` cadence, only when the fleet is idle — the
+        probe audits capacity that real traffic (and the shadow
+        sample) is not reaching; it must never displace a customer."""
+        every = audit.probe_interval()
+        if not every:
+            return
+        now = time.monotonic()
+        if now - self._last_probe_t < every:
+            return
+        if any(h.state == READY and h.engine is not None
+               and h.engine.has_work for h in self._replicas):
+            return  # not idle; try again next poll
+        self._last_probe_t = now
+        self._probe_n += 1
+        for h in self._replicas:
+            if h.state != READY or h.engine is None:
+                continue
+            try:
+                req = h.engine.submit(
+                    np.asarray(audit.PROBE_PROMPT, np.int32),
+                    audit.PROBE_BUDGET,
+                    request_id=f"auditprobe-{self._probe_n}-r{h.index}",
+                    tenant=audit.SHADOW_TENANT,
+                    t_first_origin=now)
+            except ValueError:
+                continue
+            self._probes.append((h.index, req))
+
+    def _audit_poll(self) -> None:
+        """Settle pending audit comparisons (caller holds the fleet
+        lock): finished probes against the golden, finished shadow
+        pairs against each other — a mismatch launches a third
+        *referee* leg and the majority names the suspect."""
+        if not audit.enabled():
+            return
+        for idx, req in list(self._probes):
+            if not req.done.is_set():
+                continue
+            try:
+                self._probes.remove((idx, req))
+            except ValueError:
+                continue  # purged by a quarantine earlier this sweep
+            if req.state != DONE or req.tokens is None:
+                continue  # shed probe: no integrity evidence either way
+            fp = audit.chain("", req.tokens)
+            if not audit.on_probe_result("p0", f"r{idx}", fp):
+                self._confirm_divergence(
+                    "probe", request_id=req.request_id,
+                    pair=(f"r{idx}",), suspect_idx=idx,
+                    note="golden mismatch")
+        for rid, pend in list(self._shadows.items()):
+            if rid not in self._shadows:
+                continue  # purged by a quarantine earlier this sweep
+            ticket, sreq = pend["ticket"], pend["sreq"]
+            sidx = pend["sidx"]
+            if not (sreq.done.is_set() and ticket.done.is_set()):
+                continue
+            if ticket.status != "done" or sreq.state != DONE \
+                    or sreq.tokens is None or ticket.tokens is None:
+                self._shadows.pop(rid, None)  # a shed leg proves nothing
+                self._referees.pop(rid, None)
+                continue
+            pidx = (ticket._attempt[0] if ticket._attempt is not None
+                    else -1)
+            pfp = audit.chain("", ticket.tokens)
+            sfp = audit.chain("", sreq.tokens)
+            if pfp == sfp:
+                self._shadows.pop(rid, None)
+                continue
+            ref = self._referees.get(rid)
+            if ref is None:
+                # two-way disagreement: a third leg on a replica
+                # outside the pair breaks the tie by majority
+                h = self.router.place_shadow(
+                    self._replicas,
+                    len(ticket.prompt) + ticket.max_new_tokens,
+                    exclude=(pidx, sidx), prompt=ticket.prompt)
+                rreq = None
+                if h is not None:
+                    try:
+                        rreq = h.engine.submit(
+                            ticket.prompt, ticket.max_new_tokens,
+                            request_id=rid + "#referee",
+                            tenant=audit.SHADOW_TENANT,
+                            t_first_origin=time.monotonic())
+                    except ValueError:
+                        rreq = None
+                if rreq is None:
+                    # no third replica: blame the primary
+                    # (conservative — the customer-facing leg is the
+                    # one whose output we cannot vouch for)
+                    self._settle_shadow(rid, ticket, sreq,
+                                        pidx=pidx, sidx=sidx,
+                                        suspect_idx=pidx)
+                    continue
+                self._referees[rid] = (h.index, rreq)
+                continue
+            _ridx, rreq = ref
+            if not rreq.done.is_set():
+                continue
+            rfp = (audit.chain("", rreq.tokens)
+                   if rreq.state == DONE and rreq.tokens is not None
+                   else "")
+            # majority: the leg the referee agrees with is honest;
+            # three-way disagreement blames the primary (conservative)
+            suspect_idx = sidx if rfp == pfp else pidx
+            self._settle_shadow(rid, ticket, sreq, pidx=pidx,
+                                sidx=sidx, suspect_idx=suspect_idx)
+
+    def _settle_shadow(self, rid: str, ticket: FleetTicket, sreq, *,
+                       pidx: int, sidx: int,
+                       suspect_idx: int) -> None:
+        """A confirmed shadow divergence: page + quarantine, and when
+        the PRIMARY leg is the suspect, repair the client-facing
+        tokens with the majority (shadow) output — the customer gets
+        the honest stream even though the diverging replica already
+        'finished' the request."""
+        self._shadows.pop(rid, None)
+        self._referees.pop(rid, None)
+        repaired = False
+        if suspect_idx == pidx and sreq.tokens is not None:
+            ticket.tokens = np.asarray(sreq.tokens, np.int32)
+            repaired = True
+        self._confirm_divergence(
+            "shadow", request_id=rid,
+            pair=(f"r{pidx}", f"r{sidx}"), suspect_idx=suspect_idx,
+            note="repaired" if repaired else "")
+
+    def _confirm_divergence(self, kind: str, *, request_id: str,
+                            pair, suspect_idx: int,
+                            note: str = "") -> None:
+        """Record + page one confirmed divergence, then quarantine the
+        suspect (policy-gated). The watchtower page auto-dumps the
+        flight ring and triggers an Xray capture — evidence first,
+        isolation second."""
+        audit.on_divergence(kind, request_id=request_id, pair=pair,
+                            suspect=f"r{suspect_idx}", note=note)
+        watchtower.on_output_divergence(
+            kind, request_id=request_id, pair=pair,
+            suspect=f"r{suspect_idx}")
+        if not audit.quarantine_enabled():
+            return
+        h = next((x for x in self._replicas
+                  if x.index == suspect_idx), None)
+        if h is not None:
+            self._quarantine_replica(
+                h, reason=f"{kind}_divergence:{request_id}")
+
+    def _quarantine_replica(self, h: ReplicaHandle, *,
+                            reason: str) -> None:
+        """Isolate a confirmed-diverging replica: QUARANTINED through
+        the counted choke point (router excludes it exactly like
+        DEAD), worker stopped, in-flight requests re-admitted on
+        survivors through the existing failover machinery — and NO
+        restart, ever: the process passes every liveness check, which
+        is exactly why it must not serve."""
+        if h.state in (DEAD, QUARANTINED):
+            return
+        stranded = self._stranded_of(h)
+        ids = [t.request_id for t, _ in stranded]
+        self._set_state(h, QUARANTINED, reason=reason)
+        if h.worker is not None:
+            h.worker.request_stop()
+        if h.reporter is not None:
+            h.reporter.stop()
+        h.restart_at = None
+        h.stop_reason = f"quarantined:{reason}"
+        audit.on_quarantine(h.name, reason)
+        flight.record("fleet", "quarantine",
+                      note=f"{h.name} reason={reason} "
+                           f"stranded={','.join(ids)}")
+        flight.dump_now(f"quarantine:{h.name}", force=True)
+        if self.metrics is not None:
+            self.metrics.emit("fleet_quarantine", replica=h.index,
+                              reason=reason, stranded=ids)
+        log.warning("fleet: replica %s QUARANTINED (%s), re-admitting "
+                    "%d in-flight request(s)", h.name, reason,
+                    len(ids))
+        # audit legs queued on the quarantined replica will never
+        # finish (the worker is stopped): drop their comparisons
+        self._shadows = {rid: p for rid, p in self._shadows.items()
+                         if p["sidx"] != h.index}
+        self._referees = {rid: r for rid, r in self._referees.items()
+                          if r[0] != h.index}
+        self._probes = [(i, r) for i, r in self._probes
+                        if i != h.index]
+        t_detect = time.monotonic()
+        for ticket, emitted in stranded:
+            self._readmit(ticket, emitted, from_replica=h.index,
+                          t_detect=t_detect,
+                          reason=f"quarantine:{reason}")
 
     def _restart_due(self) -> None:
         now = time.monotonic()
@@ -979,4 +1245,6 @@ class Fleet:
             # Abacus rollup: all in-process engines share one module
             # meter, so the singleton's ledgers already cover the fleet
             out["meter"] = meter.summary()
+        if audit.enabled():
+            out["audit"] = audit.summary()
         return out

@@ -74,7 +74,7 @@ import time
 
 import numpy as np
 
-from pytorch_distributed_nn_tpu.obs import meter, trace
+from pytorch_distributed_nn_tpu.obs import audit, meter, trace
 from pytorch_distributed_nn_tpu.runtime import chaos, failure
 from pytorch_distributed_nn_tpu.runtime.platform import (
     apply_platform_overrides,
@@ -436,6 +436,15 @@ def _serve_loop(args, ps, idx: int, reporter, backend) -> int:
                          op="worker_prog")
         for rec, toks, status in completed:
             trace.on_worker_done(rec, toks, status, host=idx)
+            # Lighthouse: the leg fingerprint (seeded by the chain the
+            # coordinator dispatched as rec["fp"]) is published BEFORE
+            # done — the coordinator's verify at finalize never races
+            # the evidence; key/write absent entirely when unarmed
+            if status == "done":
+                fp_payload = audit.on_worker_done(rec, toks, host=idx)
+                if fp_payload is not None:
+                    _publish(ps, f"fp/{rec['request_id']}", fp_payload,
+                             op="worker_fp")
             # done FIRST, then the wire: the coordinator's handoff
             # rests on the done record alone — see _push_wire
             _publish_done(ps, rec, toks, status)
@@ -443,6 +452,7 @@ def _serve_loop(args, ps, idx: int, reporter, backend) -> int:
                 _push_wire(ps, idx, rec, toks, backend)
         trace.maybe_publish(ps, rank=idx)
         meter.maybe_publish(ps, rank=idx)
+        audit.maybe_publish(ps, rank=idx)
         _publish(ps, f"gauge/{idx}", dict(
             queue_depth=len(queue), max_queue=args.max_queue,
             pid=os.getpid(), round=rounds, draining=draining,
@@ -509,6 +519,10 @@ def main(argv=None) -> int:
     # arm metering from TPUNN_METER (inherited via worker_env) — each
     # worker process bills its own engine, published at meter/<idx>
     meter.maybe_init(rank=idx)
+    # arm auditing from TPUNN_AUDIT (inherited, or re-exported by a
+    # programmatically-armed coordinator) — leg fingerprints publish
+    # at fp/<rid>, the summary at audit/<idx>
+    audit.maybe_init(rank=idx)
     reporter = failure.HeartbeatReporter(
         ps, rank=idx, incarnation=0,
         interval_s=args.hb_interval,
@@ -524,6 +538,8 @@ def main(argv=None) -> int:
             max_slots=args.max_slots, max_seq_len=args.max_seq_len,
             block_size=args.block_size, max_queue=args.max_queue,
             tag=f"r{idx}", model=model, params=params)
+        # chaos flip@replica=K keys on this (silent-corruption drill)
+        backend.engine.replica_index = idx
     # enrollment handshake: tell the coordinator who actually
     # materialized behind this index — for a cross-host spawn
     # (TemplateProvisioner) this record is the ONLY way it learns

@@ -91,7 +91,13 @@ from typing import Optional
 import numpy as np
 
 from pytorch_distributed_nn_tpu.launch import RestartPolicy, worker_env
-from pytorch_distributed_nn_tpu.obs import flight, meter, trace, watchtower
+from pytorch_distributed_nn_tpu.obs import (
+    audit,
+    flight,
+    meter,
+    trace,
+    watchtower,
+)
 from pytorch_distributed_nn_tpu.obs.registry import get_registry
 from pytorch_distributed_nn_tpu.runtime import chaos, failure
 from pytorch_distributed_nn_tpu.serve import autoscale as _autoscale
@@ -99,6 +105,7 @@ from pytorch_distributed_nn_tpu.serve import kv_wire
 from pytorch_distributed_nn_tpu.serve.router import (
     DEAD,
     DRAINING,
+    QUARANTINED,
     READY,
     STARTING,
     Router,
@@ -631,12 +638,17 @@ class ProcessFleet:
             cmd += ["--ckpt", self.ckpt]
         if self._progress_window is not None:
             cmd += ["--progress-window", str(self._progress_window)]
+        extra = dict(self._worker_extra_env)
+        if audit.enabled():
+            # Lighthouse: a programmatically-armed coordinator arms its
+            # worker processes too (env-armed fleets inherit anyway)
+            extra.setdefault(audit.ENV_AUDIT, audit.spec())
         env = worker_env(
             rank=h.index, world_size=1, incarnation=0,
             heartbeat_interval_s=self._hb_interval,
             progress_timeout_s=self._progress_window,
             flight_dir=self._flight_dir,
-            extra=self._worker_extra_env)
+            extra=extra)
         proc = self._provisioner.spawn(h, cmd, env)
         if proc is not None:
             h.proc = proc
@@ -664,6 +676,10 @@ class ProcessFleet:
                  "retiring": h.retiring}
             if h.role != "unified":
                 m["role"] = h.role
+            # key ABSENT unless Lighthouse isolated the replica, so
+            # pre-audit members records stay byte-identical
+            if h.state == QUARANTINED:
+                m["quarantined"] = h.stop_reason or "quarantined"
             if h.remote:
                 m["remote"] = True
                 if h.host:
@@ -725,6 +741,11 @@ class ProcessFleet:
         ages = probe.last_beat_ages()
         for m in members:
             idx = int(m["index"])
+            if m.get("quarantined"):
+                # Lighthouse isolation outlives the coordinator: a
+                # quarantined index is never adopted OR respawned —
+                # integrity, not liveness, took it out
+                continue
             h = self._new_handle(idx, role=m.get("role", "unified"))
             h.pid = int(m["pid"]) if m.get("pid") else None
             h.retiring = bool(m.get("retiring"))
@@ -887,6 +908,12 @@ class ProcessFleet:
         # a caller actually names a tenant
         if ticket.tenant != "default":
             rec["tenant"] = ticket.tenant
+        # Lighthouse (obs/audit.py): the chain seed over the carried
+        # prefix rides the dispatch, so the worker's leg fingerprint
+        # resumes where the dead/prefill leg left off — key ABSENT
+        # unarmed, wire bytes unchanged
+        if audit.enabled():
+            rec["fp"] = audit.seed_of(ticket.prefix)
         try:
             place_rec = {
                 "event": "place", "request_id": ticket.request_id,
@@ -990,7 +1017,7 @@ class ProcessFleet:
 
     def _refresh_gauges(self) -> None:
         for h in self._replicas:
-            if h.state == DEAD:
+            if h.state in (DEAD, QUARANTINED):
                 continue
             try:
                 if not self._ns.check(f"gauge/{h.index}"):
@@ -1059,7 +1086,9 @@ class ProcessFleet:
 
     def _check_exits(self) -> None:
         for h in self._replicas:
-            if h.state == DEAD:
+            # a QUARANTINED worker's exit is the quarantine's own kill
+            # — it must not be reclassified as a crash and restarted
+            if h.state in (DEAD, QUARANTINED):
                 continue
             code = self._proc_exit_code(h)
             if code is None:
@@ -1138,6 +1167,83 @@ class ProcessFleet:
         else:
             h.restart_at = None
             h.stop_reason = decision.why
+        self._write_members()
+
+    # -- Lighthouse output-integrity auditing (obs/audit.py) -------------
+
+    def _verify_fp(self, t: ProcTicket, tail: list) -> None:
+        """Check the worker's published leg fingerprint (``fp/<rid>``,
+        life-matched, written BEFORE ``done/<rid>``) against the
+        coordinator's own chain over prefix + tail. A mismatch means
+        the stream was corrupted somewhere between decode and the wire
+        — page, then quarantine the worker (policy-gated)."""
+        try:
+            if not self._ns.check(f"fp/{t.request_id}"):
+                return  # store blip / pre-audit worker: no evidence
+            p = json.loads(self._ns.get(
+                f"fp/{t.request_id}", timeout_ms=500).decode())
+        except (OSError, TimeoutError, ValueError):
+            failure.count_store_error("coord_fp")
+            return
+        if int(p.get("life", -1)) != t.life:
+            return
+        got = str(p.get("fp", ""))
+        want = audit.chain("", list(t.prefix) + [int(x) for x in tail])
+        if not got or got == want:
+            return
+        idx = (t.assigned if t.assigned is not None
+               else int(p.get("replica", -1)))
+        audit.on_divergence("worker", request_id=t.request_id,
+                            pair=(f"p{idx}",), suspect=f"p{idx}",
+                            note="fp chain mismatch")
+        watchtower.on_output_divergence(
+            "worker", request_id=t.request_id, pair=(f"p{idx}",),
+            suspect=f"p{idx}")
+        if audit.quarantine_enabled():
+            h = next((x for x in self._replicas if x.index == idx),
+                     None)
+            if h is not None:
+                self._quarantine_replica(
+                    h, reason=f"worker_divergence:{t.request_id}")
+
+    def _quarantine_replica(self, h: ProcReplica, *,
+                            reason: str) -> None:
+        """Isolate a confirmed-diverging worker: QUARANTINED through
+        the counted choke point, the process killed, its in-flight
+        requests re-admitted on survivors — and never restarted (the
+        policy governor never sees this exit; :meth:`_check_exits`
+        skips quarantined handles)."""
+        if h.state in (DEAD, QUARANTINED):
+            return
+        stranded = [t for t in self._tickets.values()
+                    if not t.done.is_set() and t.assigned == h.index]
+        ids = [t.request_id for t in stranded]
+        self._set_state(h, QUARANTINED, reason=reason)
+        if h.proc is not None and h.proc.poll() is None:
+            h.proc.kill()
+        elif h.pid is not None:
+            try:
+                os.kill(h.pid, 9)
+            except (OSError, ProcessLookupError):
+                pass
+        h.restart_at = None
+        h.stop_reason = f"quarantined:{reason}"
+        audit.on_quarantine(h.name, reason)
+        flight.record("fleet", "quarantine",
+                      note=f"{h.name} reason={reason} "
+                           f"stranded={','.join(ids)}")
+        flight.dump_now(f"quarantine:{h.name}", force=True)
+        if self.metrics is not None:
+            self.metrics.emit("fleet_quarantine", replica=h.index,
+                              reason=reason, stranded=ids)
+        log.warning("procfleet: replica %s QUARANTINED (%s), "
+                    "re-admitting %d request(s)", h.name, reason,
+                    len(ids))
+        t_detect = time.monotonic()
+        for t in stranded:
+            self._readmit(t, self._read_prog(t), from_replica=h.index,
+                          t_detect=t_detect,
+                          reason=f"quarantine:{reason}")
         self._write_members()
 
     def _read_prog(self, t: ProcTicket) -> list[int]:
@@ -1296,6 +1402,8 @@ class ProcessFleet:
                                payload: dict) -> None:
         status = payload.get("status", "done")
         tail = [int(x) for x in payload.get("tokens", [])]
+        if status == "done" and audit.enabled():
+            self._verify_fp(t, tail)
         if status == "done":
             t.tokens = np.asarray(t.prefix + tail, np.int32)
             t.status = "done"
@@ -1352,7 +1460,8 @@ class ProcessFleet:
         role = pool if pool is not None else "unified"
         with self._lock:
             current = [h for h in self._replicas
-                       if not h.retiring and h.state != DEAD
+                       if not h.retiring
+                       and h.state not in (DEAD, QUARANTINED)
                        and (pool is None or h.role == pool)]
             delta = n - len(current)
             added, retiring = 0, 0
@@ -1525,4 +1634,6 @@ class ProcessFleet:
             out["meter"] = dict(
                 ledgers=ledgers,
                 totals=meter.ledger_totals(ledgers))
+        if audit.enabled():
+            out["audit"] = audit.summary()
         return out

@@ -58,14 +58,20 @@ from __future__ import annotations
 from pytorch_distributed_nn_tpu.obs.registry import get_registry
 
 # replica lifecycle (the fleet's _set_state is the only writer —
-# lint-enforced, see tests/test_quality.py)
+# lint-enforced, see tests/test_quality.py). QUARANTINED is Lighthouse's
+# isolation state (obs/audit.py): a confirmed output-diverging replica
+# is excluded from placement like DEAD but never restarted — its
+# process may still be healthy by every liveness signal, which is
+# exactly why it must not serve.
 STARTING = "starting"
 READY = "ready"
 DRAINING = "draining"
 RELOADING = "reloading"
 DEAD = "dead"
+QUARANTINED = "quarantined"
 
-REPLICA_STATES = (STARTING, READY, DRAINING, RELOADING, DEAD)
+REPLICA_STATES = (STARTING, READY, DRAINING, RELOADING, DEAD,
+                  QUARANTINED)
 
 
 def fleet_pressure(replicas, *, role: str | None = None) -> dict:
@@ -188,3 +194,18 @@ class Router:
         self._c_placements.inc(
             outcome="placed" if best is not None else "no_replica")
         return best
+
+    def place_shadow(self, replicas, total_tokens: int, *, exclude,
+                     prompt=None, adapter: int = 0):
+        """Lighthouse shadow-replay placement (obs/audit.py): pick a
+        READY replica for the duplicate leg, excluding the primary's
+        index (``exclude`` is an index or an iterable of indexes).
+        Funnels through :meth:`place`, so the shadow decision is
+        counted like any other and rides the same scoring — never the
+        ``_score*`` helpers directly (their caller lint)."""
+        if isinstance(exclude, int):
+            exclude = (exclude,)
+        banned = set(exclude)
+        cands = [h for h in replicas if h.index not in banned]
+        return self.place(cands, total_tokens, prompt=prompt,
+                          adapter=adapter)

@@ -130,6 +130,11 @@ class Request:
     # Causeway (obs/trace.py): the propagated TraceContext, or None
     # when tracing is unarmed / the request is not sampled
     trace: object = None
+    # Lighthouse (obs/audit.py): the fingerprint-chain seed this leg
+    # resumes from — the chain over the tokens an earlier leg already
+    # emitted (failover re-admission / disagg handoff), "" for a fresh
+    # request or an unarmed process
+    fp_seed: str = ""
     # True while this request holds a slot in its tenant's live-quota
     # count (set on QUEUED, dropped on any terminal transition)
     quota_held: bool = False
@@ -259,7 +264,8 @@ class Scheduler:
                adapter: int = 0,
                trace_ctx: object = None,
                t_origin: Optional[float] = None,
-               t_first_origin: float = 0.0) -> Request:
+               t_first_origin: float = 0.0,
+               fp_seed: str = "") -> Request:
         """Thread-safe admission attempt. Always returns a Request; a
         rejected one is already terminal (``done`` set, ``state ==
         REJECTED``, ``reject_reason`` says why). ``resubmit`` marks a
@@ -286,6 +292,7 @@ class Scheduler:
             tenant=str(tenant), adapter=int(adapter),
             t_origin=float(t_origin) if t_origin else 0.0,
             t_first_origin=float(t_first_origin),
+            fp_seed=str(fp_seed),
         )
         # fleet legs arrive with their context minted at Fleet.submit;
         # a bare engine/scheduler mints here (same choke point role)

@@ -169,6 +169,28 @@ def _cmd_status(args) -> int:
             beats[str(m["index"])] = round(
                 time.time() - float(ns.get(key, timeout_ms=2000)), 3)
     out["beat_age_s"] = beats
+    # Lighthouse (obs/audit.py): per-replica integrity state. Both
+    # keys are absent on an unarmed fleet — status output is
+    # byte-stable either way the fleet was launched.
+    audits = {}
+    for m in members:
+        key = f"audit/{m['index']}"
+        if not ns.check(key):
+            continue
+        p = json.loads(ns.get(key, timeout_ms=2000).decode())
+        ent = dict(fingerprints=p.get("fingerprints", 0),
+                   divergences=p.get("divergences", 0),
+                   probe_failures=p.get("probe_failures", 0))
+        if p.get("last_fp_t"):
+            ent["last_fp_age_s"] = round(
+                time.time() - float(p["last_fp_t"]), 3)
+        audits[str(m["index"])] = ent
+    if audits:
+        out["audit"] = audits
+    quarantined = [dict(replica=m["index"], reason=m["quarantined"])
+                   for m in members if m.get("quarantined")]
+    if quarantined:
+        out["quarantined"] = quarantined
     client.close()
     print(json.dumps(out, sort_keys=True))
     return 0

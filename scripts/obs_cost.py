@@ -308,12 +308,18 @@ def main(argv=None) -> int:
         return _selftest()
     if not args.jsonl:
         ap.error("need a metrics JSONL path (or --selftest)")
-    events = load_events(args.jsonl)
+    try:
+        events = load_events(args.jsonl)
+    except OSError as e:
+        print(f"cannot read {args.jsonl}: {e}", file=sys.stderr)
+        return 1
     ledgers = ledgers_from_events(events)
     if not ledgers:
+        # a quiet report, not a failure: the stream simply ran with
+        # the meter unarmed (or hasn't flushed a summary yet)
         print(f"no meter_ledger records in {args.jsonl} "
               f"(run with TPUNN_METER=1 and a metrics sink)")
-        return 1
+        return 0
     report = build_report(ledgers, price_per_pflop=args.price)
     print(to_json(report) if args.json else render(report))
     return 0

@@ -427,6 +427,43 @@ def print_cost_table(events: list[dict], last: int) -> bool:
     return True
 
 
+def print_audit_table(events: list[dict], last: int) -> bool:
+    """Lighthouse section (obs/audit.py): output-integrity coverage —
+    how many ``serve_request`` records carry a fingerprint chain,
+    every confirmed divergence with its replica pair and suspect,
+    golden-probe pass/fail tallies, and quarantined replicas with the
+    work re-admitted off them. Silently skipped when the file has no
+    audit events (TPUNN_AUDIT unset). The standalone report + the
+    tier-1 corruption drill: ``scripts/obs_audit.py``."""
+    reqs = [e for e in events if e.get("event") == "serve_request"]
+    fps = [e for e in reqs if e.get("fp")]
+    divs = [e for e in events if e.get("event") == "audit_divergence"]
+    probes = [e for e in events if e.get("event") == "audit_probe"]
+    quars = [e for e in events if e.get("event") == "fleet_quarantine"]
+    if not (fps or divs or probes or quars):
+        return False
+    print("\n== output integrity (Lighthouse) ==")
+    if reqs:
+        print(f"fingerprints: {len(fps)} of {len(reqs)} "
+              f"request record(s) carry a token chain")
+    if probes:
+        failed = sum(1 for e in probes if not int(_num(e, "ok", 1)))
+        print(f"golden probes: {len(probes)} ({failed} failed)")
+    if divs:
+        print(f"divergences: {len(divs)} confirmed")
+        for e in divs[-last:]:
+            pair = ",".join(str(p) for p in e.get("pair") or [])
+            print(f"  {e.get('kind', '?'):>8} "
+                  f"{str(e.get('request_id', '')):>10} "
+                  f"pair={pair or '-'} suspect={e.get('suspect', '?')}")
+    for e in quars[-last:]:
+        stranded = e.get("stranded") or []
+        ids = ", ".join(str(s) for s in stranded) or "(none)"
+        print(f"quarantined: replica {int(_num(e, 'replica', -1))} "
+              f"({e.get('reason', '?')}) — re-admitted: {ids}")
+    return True
+
+
 def print_capacity_table(events: list[dict], last: int,
                          requested: bool = False) -> bool:
     """Skyline capacity-planning section (obs/capacity.py): the
@@ -613,21 +650,27 @@ def main(argv=None) -> int:
     ap.add_argument("--last", type=int, default=5,
                     help="windows/rows to show per table")
     args = ap.parse_args(argv)
-    events = load_events(args.jsonl)
+    try:
+        events = load_events(args.jsonl)
+    except OSError as e:
+        print(f"cannot read {args.jsonl}: {e}", file=sys.stderr)
+        return 1
     if not events:
+        # an empty or torn stream is a quiet report, not a crash —
+        # monitoring wrappers run this before the workload has
+        # emitted anything
         print(f"no events in {args.jsonl}")
-        if not args.xray:
-            return 1
-        # the operator explicitly asked for the xray section — render
-        # it even when the run JSONL is missing/empty
-        return 0 if print_xray_table(args.xray, args.last) else 1
+        if args.xray:
+            print_xray_table(args.xray, args.last)
+        return 0
     has_serve = any(e.get("event") in
                     ("serve_request", "serve_summary", "fleet_state",
                      "fleet_replica_down", "fleet_failover",
                      "fleet_reload", "fleet_handoff", "kv_transfer",
                      "trace_span", "meter_ledger", "capacity_rung",
                      "capacity_frontier", "capacity_plan",
-                     "autoscale_decision")
+                     "autoscale_decision", "audit_divergence",
+                     "audit_probe", "fleet_quarantine")
                     for e in events)
     ok = print_goodput_table(events, args.last, quiet=has_serve)
     print_comms_table(events, args.trace or None)
@@ -635,14 +678,17 @@ def main(argv=None) -> int:
     fleet_ok = print_fleet_table(events, args.last)
     trace_ok = print_trace_table(events, args.last)
     cost_ok = print_cost_table(events, args.last)
+    audit_ok = print_audit_table(events, args.last)
     cap_ok = print_capacity_table(events, args.last,
                                   requested=args.capacity)
     helm_ok = print_autoscale_table(events, args.last,
                                     requested=args.autoscale)
     xray_ok = print_xray_table(args.xray or None, args.last)
     print_metric_tail(events, args.last)
-    return 0 if (ok or serve_ok or fleet_ok or trace_ok or cost_ok
-                 or cap_ok or helm_ok or xray_ok) else 1
+    if not (ok or serve_ok or fleet_ok or trace_ok or cost_ok
+            or audit_ok or cap_ok or helm_ok or xray_ok):
+        print("nothing to report (no recognized event families)")
+    return 0
 
 
 if __name__ == "__main__":

@@ -149,13 +149,17 @@ def print_rollup(spans: list[dict]) -> None:
 
 def _render(spans: list[dict], args) -> int:
     if not spans:
+        # a quiet report, not a failure: the stream simply ran with
+        # Causeway unarmed (TPUNN_TRACE unset)
         print("no trace spans found")
-        return 1
+        return 0
     trace_ids = sorted({str(s.get("trace", "")) for s in spans})
     if args.trace:
         trace_ids = [t for t in trace_ids
                      if t.startswith(args.trace)]
         if not trace_ids:
+            # an explicit trace-id filter that matches nothing IS an
+            # operator error — keep that loud
             print(f"no trace matching {args.trace!r}")
             return 1
     if args.json:
@@ -322,7 +326,12 @@ def main(argv=None) -> int:
                                   args.namespace), args)
     if not args.path:
         ap.error("need a file, --store, or --selftest")
-    return _render(load_spans(args.path), args)
+    try:
+        spans = load_spans(args.path)
+    except OSError as e:
+        print(f"cannot read {args.path}: {e}", file=sys.stderr)
+        return 1
+    return _render(spans, args)
 
 
 if __name__ == "__main__":

@@ -79,7 +79,7 @@ def main(argv=None) -> int:
         parse_overrides,
     )
     from pytorch_distributed_nn_tpu.models import get_model
-    from pytorch_distributed_nn_tpu.obs import meter, trace, watchtower
+    from pytorch_distributed_nn_tpu.obs import audit, meter, trace, watchtower
     from pytorch_distributed_nn_tpu.runtime import chaos
     from pytorch_distributed_nn_tpu.runtime.failure import (
         GRACEFUL_EXIT_CODE,
@@ -158,6 +158,7 @@ def main(argv=None) -> int:
     watchtower.maybe_init(metrics=metrics)
     trace.maybe_init(metrics=metrics)  # TPUNN_TRACE — Causeway
     meter.maybe_init(metrics=metrics)  # TPUNN_METER — Abacus
+    audit.maybe_init(metrics=metrics)  # TPUNN_AUDIT — Lighthouse
     t0 = time.monotonic()
     try:
         if args.closed_loop:

@@ -1,0 +1,356 @@
+"""Lighthouse output-integrity auditing (ISSUE 19): fingerprint-chain
+algebra, chain continuity across the disagg prefill->decode handoff
+and failover re-admission, golden probes against first-wins goldens,
+and the process-fleet ``fp/<rid>`` verification loop — plus proof the
+whole subsystem is inert (key-absent wire, empty ring, no registry
+writes) when ``TPUNN_AUDIT`` is unset. The full corruption drill
+(chaos ``flip@`` -> page -> quarantine -> re-admit -> bit-identical
+streams) runs as ``scripts/obs_audit.py --selftest`` via
+test_quality.py."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from pytorch_distributed_nn_tpu import obs
+from pytorch_distributed_nn_tpu.config import ModelConfig
+from pytorch_distributed_nn_tpu.inference.generate import generate
+from pytorch_distributed_nn_tpu.models import get_model
+from pytorch_distributed_nn_tpu.obs import audit, flight, watchtower
+from pytorch_distributed_nn_tpu.runtime import chaos
+from pytorch_distributed_nn_tpu.serve import Fleet, ServingEngine
+
+VOCAB = 97
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    """Disarmed audit/chaos, fresh ring + registry per test."""
+    monkeypatch.delenv(audit.ENV_AUDIT, raising=False)
+    monkeypatch.delenv(chaos.ENV_CHAOS, raising=False)
+    audit.reset()
+    chaos.reset()
+    watchtower.reset()
+    flight.reset_recorder(enabled=True)
+    obs.reset_registry()
+    yield
+    audit.reset()
+    chaos.reset()
+    watchtower.reset()
+
+
+@pytest.fixture(scope="module")
+def tiny_llama():
+    model = get_model(ModelConfig(
+        name="llama3_8b", compute_dtype="float32", dtype="float32",
+        extra=dict(num_layers=2, d_model=64, num_heads=4, num_kv_heads=2,
+                   mlp_dim=128, vocab_size=VOCAB),
+    ))
+    tokens = jnp.zeros((1, 8), jnp.int32)
+    params = model.init(jax.random.key(1), tokens, train=False)["params"]
+    return model, params
+
+
+def _prompts(lengths, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, VOCAB, size=(n,)).astype(np.int32)
+            for n in lengths]
+
+
+def _golden(model, params, prompt, n):
+    return np.asarray(generate(model, params, prompt[None], n))[
+        0, len(prompt):]
+
+
+# ---------------------------------------------------------------------------
+# chain algebra (no model)
+# ---------------------------------------------------------------------------
+
+def test_chain_is_deterministic_and_order_sensitive():
+    a = audit.chain("", [1, 2, 3])
+    assert a == audit.chain("", [1, 2, 3])
+    assert a != audit.chain("", [3, 2, 1])
+    assert a != audit.chain("", [1, 2, 4])
+    assert len(a) == 40  # sha1 hex
+
+
+def test_chain_empty_is_genesis_and_seed_equivalent():
+    assert audit.chain("", []) == audit.GENESIS
+    # the empty-prefix seed and the bare genesis are the same chain
+    assert audit.chain(audit.GENESIS, [7, 8]) == audit.chain("", [7, 8])
+
+
+def test_chain_is_resumable_across_leg_splits():
+    """The property every continuity path leans on: seeding a second
+    leg with the first leg's chain ends at exactly the fingerprint of
+    one uninterrupted leg — for every split point."""
+    stream = [5, 1, 9, 2, 2, 8, 0, 3]
+    whole = audit.chain("", stream)
+    for cut in range(len(stream) + 1):
+        seed = audit.chain("", stream[:cut])
+        assert audit.chain(seed, stream[cut:]) == whole
+
+
+def test_parse_spec_grammar_and_validation():
+    cfg = audit.parse_spec("sample=0.5:shadow=0:probe_every_s=2:"
+                           "quarantine=0")
+    assert (cfg.sample, cfg.shadow, cfg.probe_every_s,
+            cfg.quarantine) == (0.5, 0, 2.0, 0)
+    assert audit.parse_spec("1") == audit.AuditConfig()
+    with pytest.raises(ValueError, match="unknown audit key"):
+        audit.parse_spec("sampel=0.5")
+    with pytest.raises(ValueError, match="sample must be"):
+        audit.parse_spec("sample=1.5")
+    with pytest.raises(ValueError, match="shadow must be"):
+        audit.parse_spec("shadow=2")
+
+
+def test_spec_round_trips_through_reserialization():
+    """coordinator -> worker env re-export: parsing the re-serialized
+    spec yields the identical config."""
+    audit.maybe_init("sample=0.125:shadow=1:probe_every_s=0.5:"
+                     "quarantine=0")
+    assert audit.parse_spec(audit.spec()) == audit.AuditConfig(
+        sample=0.125, shadow=1, probe_every_s=0.5, quarantine=0)
+
+
+def test_unarmed_hooks_are_inert():
+    assert not audit.enabled()
+    assert audit.spec() == ""
+    assert audit.summary() is None
+    assert audit.seed_of([1, 2, 3]) == ""
+    assert audit.fingerprint_of("x") is None
+    assert not audit.shadow_sampled("x")
+    assert audit.probe_interval() == 0.0
+    assert not audit.quarantine_enabled()
+    assert audit.on_retire("x", [1], seed="", replica="r0") is None
+    assert audit.on_worker_done({"request_id": "x"}, [1], host=0) is None
+    assert audit.on_divergence("shadow") is None
+    assert audit.on_probe_result("p0", "r0", "f" * 40) is True
+    ring = [e for e in flight.get_recorder().snapshot()
+            if e["kind"] == "audit"]
+    assert not ring, "unarmed hooks wrote flight events"
+
+
+def test_shadow_sample_is_deterministic_hash():
+    audit.maybe_init("sample=0.25")
+    # sha1("lh-5")[:8] / 2^32 ~ 0.103 < 0.25; sha1("lh-0") ~ 0.606
+    assert audit.shadow_sampled("lh-5")
+    assert not audit.shadow_sampled("lh-0")
+    # same draw on every process that asks (the shadow contract)
+    assert audit.shadow_sampled("lh-5") == audit.shadow_sampled("lh-5")
+
+
+# ---------------------------------------------------------------------------
+# engine-level fingerprints: key-absent unarmed, chained armed
+# ---------------------------------------------------------------------------
+
+def _engine_run(model, params, prompts, budgets):
+    eng = ServingEngine(model, params, max_slots=2, max_seq_len=64,
+                        block_size=16)
+    reqs = [eng.submit(p, n) for p, n in zip(prompts, budgets)]
+    eng.run_until_idle()
+    return eng, reqs
+
+
+@pytest.mark.slow  # pays the serve jit warmup compile
+def test_engine_records_carry_fp_only_when_armed(tiny_llama):
+    model, params = tiny_llama
+    prompts, budgets = _prompts([10, 13], seed=3), [4, 6]
+
+    eng0, _ = _engine_run(model, params, prompts, budgets)
+    assert all("fp" not in r for r in eng0.completed), \
+        "unarmed serve_request records must stay key-absent"
+
+    audit.maybe_init("sample=0:shadow=0")
+    eng1, reqs = _engine_run(model, params, prompts, budgets)
+    by_id = {r["request_id"]: r for r in eng1.completed}
+    for req in reqs:
+        rec = by_id[req.request_id]
+        want = audit.chain("", [int(t) for t in req.tokens])
+        assert rec["fp"] == want
+        assert audit.fingerprint_of(req.request_id) == want
+    # armed records carry exactly one extra key: fp (values like
+    # timestamps differ run-to-run, so compare the key sets)
+    assert {tuple(sorted(set(r) - {"fp"})) for r in eng1.completed} \
+        == {tuple(sorted(r)) for r in eng0.completed}
+    reg = obs.get_registry()
+    assert reg.counter("audit_fingerprints_total").value() == len(reqs)
+
+
+# ---------------------------------------------------------------------------
+# continuity: disagg handoff + failover re-admission
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow  # ~10s: disagg jit warmup
+def test_fp_chain_continuous_across_disagg_handoff(tiny_llama):
+    """The decode leg is seeded with the chain over the prefill leg's
+    stitched prefix, so the final record fingerprints the WHOLE stream
+    — indistinguishable from a unified engine's chain."""
+    model, params = tiny_llama
+    audit.maybe_init("sample=0:shadow=0")
+    prompts = _prompts([34, 6, 9], seed=7)
+    budgets = [2, 8, 6]
+    fleet = Fleet(model, params, prefill=1, decode=2, max_slots=2,
+                  max_seq_len=64, block_size=16, max_queue=16)
+    tickets = [fleet.submit(p, n) for p, n in zip(prompts, budgets)]
+    fleet.run_until_idle()
+    for t, p, n in zip(tickets, prompts, budgets):
+        assert t.ok, (t.status, t.reject_reason)
+        np.testing.assert_array_equal(
+            t.tokens, _golden(model, params, p, n))
+        assert audit.fingerprint_of(t.request_id) == \
+            audit.chain("", [int(x) for x in t.tokens]), \
+            "handoff restarted the chain instead of resuming it"
+
+
+@pytest.mark.slow  # serve jit warmup + mid-decode failover
+def test_fp_chain_continuous_across_failover_readmission(tiny_llama):
+    """A leg killed mid-decode re-admits with its emitted prefix AND
+    the chain over it — the surviving leg's final fingerprint equals
+    the uninterrupted chain over the stitched stream."""
+    model, params = tiny_llama
+    audit.maybe_init("sample=0:shadow=0")
+    prompts = _prompts([12, 9, 14], seed=5)
+    budgets = [16, 16, 16]
+    fleet = Fleet(model, params, replicas=3, max_slots=2,
+                  max_seq_len=64, block_size=16)
+    tickets = [fleet.submit(p, n) for p, n in zip(prompts, budgets)]
+    # a few decode rounds so r1's leg has emitted a real prefix
+    for _ in range(4):
+        for h in fleet.replicas:
+            if h.engine is not None and h.engine.has_work:
+                h.engine.step()
+    with fleet._lock:
+        fleet._fail_replica(fleet.replicas[1], kind="crash",
+                            reason="test_kill")
+    fleet.run_until_idle()
+    for t, p, n in zip(tickets, prompts, budgets):
+        assert t.ok, (t.status, t.reject_reason)
+        np.testing.assert_array_equal(
+            t.tokens, _golden(model, params, p, n))
+        assert audit.fingerprint_of(t.request_id) == \
+            audit.chain("", [int(x) for x in t.tokens])
+    assert fleet.failovers >= 1
+    moved = [t for t in tickets if t.failovers]
+    assert moved, "the kill must actually strand a decoding leg"
+    # the re-admitted leg was seeded (not restarted): its carried
+    # prefix was non-empty, yet the final chain covers the full stream
+    assert any(fo["prefix_tokens"] > 0
+               for t in moved for fo in t.failovers)
+
+
+# ---------------------------------------------------------------------------
+# golden probes
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow  # serve jit warmup
+def test_golden_probes_run_at_idle_and_match(tiny_llama):
+    model, params = tiny_llama
+    # an hour-long cadence with the clock forced past it: exactly ONE
+    # probe sweep fires (a tiny cadence would re-arm on every poll and
+    # run_until_idle would chase probes forever)
+    audit.maybe_init("sample=0:shadow=0:probe_every_s=3600")
+    fleet = Fleet(model, params, replicas=2, max_slots=2,
+                  max_seq_len=64, block_size=16)
+    fleet._last_probe_t = -1e9  # due immediately
+    fleet.poll()                # idle fleet -> probes submitted
+    fleet.run_until_idle()
+    s = audit.summary()
+    assert s["probes"] == 2 and s["probe_failures"] == 0
+    # first fingerprint became the golden; both replicas matched it
+    assert audit.audit().goldens["p0"] is not None
+
+
+@pytest.mark.slow  # serve jit warmup
+def test_probe_mismatch_pages_without_quarantine_when_disabled(
+        tiny_llama):
+    """quarantine=0: a failed probe is a page, never an isolation —
+    the operator chose observe-only."""
+    model, params = tiny_llama
+    audit.maybe_init("sample=0:shadow=0:probe_every_s=3600:"
+                     "quarantine=0")
+    watchtower.maybe_init("1", rank=0)
+    fleet = Fleet(model, params, replicas=2, max_slots=2,
+                  max_seq_len=64, block_size=16)
+    # poison the golden: every honest replica now "mismatches"
+    audit.audit().goldens["p0"] = "f" * 40
+    fleet._last_probe_t = -1e9
+    fleet.poll()
+    fleet.run_until_idle()
+    s = audit.summary()
+    assert s["probes"] == 2 and s["probe_failures"] == 2
+    assert s["divergences"] >= 1
+    tw = watchtower.tower()
+    assert any(a.kind == "output_divergence" for a in tw.alerts)
+    # observe-only: nobody was isolated
+    assert all(h.state != "quarantined" for h in fleet.replicas)
+    assert not s["quarantines"]
+    reg = obs.get_registry()
+    assert reg.counter("audit_probe_failures_total").value() == 2
+    assert reg.counter("audit_divergence_total").value(
+        kind="probe") >= 1
+
+
+# ---------------------------------------------------------------------------
+# process fleet: fp/<rid> publish + coordinator verification
+# ---------------------------------------------------------------------------
+
+@pytest.mark.slow  # spawns stub worker subprocesses
+def test_procfleet_worker_publishes_fp_and_coordinator_verifies():
+    import json
+
+    from pytorch_distributed_nn_tpu.serve.procfleet import ProcessFleet
+    from pytorch_distributed_nn_tpu.serve.stub import stub_decode
+
+    audit.maybe_init("sample=0:shadow=0:quarantine=1")
+    with ProcessFleet(replicas=2, backend="stub", token_ms=0.5,
+                      heartbeat_interval_s=0.05,
+                      heartbeat_timeout_s=5.0) as fleet:
+        fleet.start()
+        assert fleet.wait_ready(2, timeout=120)
+        prompts = [[1, 2, 3], [4, 5]]
+        tickets = [fleet.submit(p, 6, request_id=f"pfa-{i}")
+                   for i, p in enumerate(prompts)]
+        assert fleet.wait_all(tickets, timeout=60)
+        for p, t in zip(prompts, tickets):
+            assert t.ok and list(t.tokens) == stub_decode(p, 6)
+            # the worker published the leg chain BEFORE done/<rid>,
+            # seeded by the dispatched fp key — so the coordinator
+            # could verify it at finalize (and did: no divergences)
+            payload = json.loads(fleet._ns.get(
+                f"fp/{t.request_id}", timeout_ms=2000).decode())
+            assert payload["fp"] == audit.chain(
+                "", [int(x) for x in t.tokens])
+            assert payload["life"] == 0
+            # the dispatch record carried the (genesis) seed
+            rec = json.loads(fleet._ns.get(
+                f"req/{t.assigned}/0", timeout_ms=2000).decode())
+            assert rec["fp"] == audit.GENESIS
+        s = fleet.summary()["audit"]
+        assert not s["divergences"], "honest fleet false-alarmed"
+        assert not s["quarantines"]
+
+
+@pytest.mark.slow  # spawns stub worker subprocesses
+def test_procfleet_unarmed_wire_has_no_fp_keys():
+    import json
+
+    from pytorch_distributed_nn_tpu.serve.procfleet import ProcessFleet
+    from pytorch_distributed_nn_tpu.serve.stub import stub_decode
+
+    with ProcessFleet(replicas=1, backend="stub", token_ms=0.5,
+                      heartbeat_interval_s=0.05,
+                      heartbeat_timeout_s=5.0) as fleet:
+        fleet.start()
+        assert fleet.wait_ready(1, timeout=120)
+        t = fleet.submit([1, 2, 3], 5, request_id="pfu-0")
+        assert fleet.wait_all([t], timeout=60)
+        assert t.ok and list(t.tokens) == stub_decode([1, 2, 3], 5)
+        rec = json.loads(fleet._ns.get(
+            f"req/{t.assigned}/0", timeout_ms=2000).decode())
+        assert "fp" not in rec, "unarmed dispatch wire grew an fp key"
+        assert not fleet._ns.check("fp/pfu-0"), \
+            "unarmed worker published a fingerprint"
+        assert "audit" not in fleet.summary()

@@ -422,6 +422,8 @@ def test_obs_doctor_selftest(capsys):
 
 
 def test_obs_doctor_empty_dir(tmp_path, capsys):
+    # nothing-to-report is a quiet rc-0 report, not a failure —
+    # monitoring wrappers run the doctor before anything has crashed
     rc = _doctor().main([str(tmp_path)])
-    assert rc == 1
+    assert rc == 0
     assert "no flight" in capsys.readouterr().out
