@@ -168,6 +168,12 @@ _METRIC_NAMES = {
     # no shadow legs) — vs_baseline carries the armed-vs-unset
     # throughput ratio, i.e. the per-retire sha1-fold overhead
     "serve_audit": "audited serving tokens/sec (tiny)",
+    # Prism seeded best-of-n (serve/decoding.py): the SAME closed
+    # workload greedy vs best_of=n sampled — vs_baseline carries the
+    # sampled-over-greedy winner-tokens/s ratio (< 1: n-way decode
+    # work per emitted winner token), and the record's pool accounting
+    # proves the COW fork cost is one prompt + n tails, not n prompts
+    "serve_sample": "sampled n-best serving tokens/sec (tiny)",
     # higher-is-better on purpose: no latency/seconds substring, so the
     # ledger (obs.xray.metric_direction) gates a DROP in capacity
     "capacity": "capacity sustainable req/s (llama3_8b_zero)",
@@ -850,6 +856,87 @@ def bench_serve(args) -> int:
                f"vs static batches of {slots}"
                + (" [tiny dims]" if args.serve_tiny else ""),
     )
+
+    # -- Prism sampled n-best A/B: greedy vs seeded best-of-n ----------
+    # (docs/serving.md "Sampling & n-best"): the SAME closed-loop
+    # workload twice — every request greedy, then every request
+    # best_of=n seeded sampling — so vs_baseline is the n-way decode
+    # cost per emitted winner token. The mid-flight pool probe proves
+    # the COW claim: n live branches hold one shared set of prompt
+    # blocks plus n private tails, not n full copies.
+    if args.sample:
+        from pytorch_distributed_nn_tpu.serve.decoding import DecodeSpec
+        from pytorch_distributed_nn_tpu.serve.scheduler import (
+            branch_seq_ids,
+        )
+
+        n_branch = 3
+        samp_spec = lambda i: DecodeSpec(  # noqa: E731
+            temperature=0.8, top_p=0.9, best_of=n_branch, seed=i)
+
+        def sample_pass(sampled: bool) -> float:
+            eng = ServingEngine(model, params, max_slots=slots,
+                                max_seq_len=max_seq, max_queue=n_req,
+                                prefix_cache=False)
+            # warmup: compile the prefill buckets and the sampled step
+            for p in buckets.values():
+                kw = {"decode": samp_spec(0)} if sampled else {}
+                eng.submit(p, 2, **kw)
+            eng.run_until_idle()
+            base = len(eng.completed)
+            t0 = time.perf_counter()
+            for i, (p, n) in enumerate(zip(prompts, budgets)):
+                kw = {"decode": samp_spec(i)} if sampled else {}
+                eng.submit(p, n, **kw)
+            eng.run_until_idle()
+            dt = time.perf_counter() - t0
+            return sum(c["new_tokens"]
+                       for c in eng.completed[base:]) / dt
+
+        tps_greedy = sample_pass(False)
+        tps_sampled = sample_pass(True)
+
+        # mid-flight COW accounting: one branched request, stepped past
+        # admission, then the pool's block tables are read while the
+        # branches are live
+        probe = ServingEngine(model, params, max_slots=slots,
+                              max_seq_len=max_seq, max_queue=n_req,
+                              prefix_cache=False)
+        pool = probe.scheduler.pool
+        # prompt spanning several full blocks, budget outlasting the
+        # probe step: the fork's sharing must be visible mid-flight
+        n_pb = max(2, (max_seq - 24) // pool.block_size)
+        probe_prompt = np.arange(
+            1, n_pb * pool.block_size + 1, dtype=np.int32)
+        probe_req = probe.submit(probe_prompt, 16, decode=samp_spec(0))
+        probe.step()  # admit + prefill + fork: branches are live now
+        tables = [pool.block_table(sid)
+                  for sid in branch_seq_ids(probe_req)]
+        blocks_held = len({b for t in tables for b in t})
+        blocks_naive = sum(len(t) for t in tables)
+        prompt_blocks = len(probe_prompt) // pool.block_size
+        tail_blocks = blocks_held - prompt_blocks
+        probe.run_until_idle()
+
+        MetricsLogger(stream=sink).emit_benchmark(
+            metric=_METRIC_NAMES["serve_sample"],
+            value=round(tps_sampled, 1), unit="tokens/sec",
+            vs_baseline=round(tps_sampled / tps_greedy, 3),
+            vs_baseline_kind="sampled_best_of_over_greedy",
+            backend=backend,
+            best_of=n_branch,
+            greedy_tokens_per_s=round(tps_greedy, 1),
+            blocks_held=blocks_held,
+            blocks_naive=blocks_naive,
+            prompt_blocks_shared=prompt_blocks,
+            tail_blocks=tail_blocks,
+            detail=f"{n_req} ragged requests, best_of={n_branch} "
+                   f"T=0.8 top_p=0.9 vs greedy, {slots} slots; "
+                   f"mid-flight KV: {blocks_held} blocks held "
+                   f"({prompt_blocks} prompt shared + {tail_blocks} "
+                   f"tails) vs {blocks_naive} naive copies"
+                   + (" [tiny dims]" if args.serve_tiny else ""),
+        )
 
     # -- shared-prefix A/B: cache ON vs OFF on the SAME workload -------
     if args.serve_prefix_frac > 0:
@@ -2577,6 +2664,13 @@ def main(argv=None) -> int:
     ap.add_argument("--serve-tiny", action="store_true",
                     help="serve metric: CI-scale model dims (CPU-fast) "
                          "instead of the scaled llama stand-in")
+    ap.add_argument("--sample", action="store_true",
+                    help="serve metric: also run the Prism sampled "
+                         "n-best A/B — the closed-loop workload greedy "
+                         "vs best_of=3 seeded sampling; vs_baseline is "
+                         "the n-way decode cost per winner token, and "
+                         "the record carries mid-flight COW pool "
+                         "accounting (its own ledger series)")
     ap.add_argument("--audit", action="store_true",
                     help="serve metric: also run the Lighthouse A/B — "
                          "the closed-loop workload with TPUNN_AUDIT "

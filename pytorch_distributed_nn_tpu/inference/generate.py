@@ -248,11 +248,14 @@ def _decode_loop_ragged(model, params, cache, next_logits, rng, n_steps,
 
 def _sample(logits, *, temperature, top_k: int, rng, top_p: float = 0.0):
     """logits (B, V) -> tokens (B,). ``temperature`` may be a traced
-    scalar (0 selects greedy via jnp.where — top-k/top-p membership is
-    temperature-invariant, so filtering before scaling is equivalent),
-    which keeps per-request temperatures from recompiling the decode
-    scan. ``top_k``/``top_p`` stay static (top_k needs a static k; p
-    changes the masking structure)."""
+    scalar OR a traced (B,) per-row vector (0 selects greedy via
+    jnp.where — top-k/top-p membership is temperature-invariant, so
+    filtering before scaling is equivalent), which keeps per-request
+    temperatures from recompiling the decode scan. A (B,) temperature
+    scales row-wise and picks greedy row-wise, so mixed greedy+sampled
+    batches compose with the top_p mask at batch granularity.
+    ``top_k``/``top_p`` stay static (top_k needs a static k; p changes
+    the masking structure)."""
     greedy = jnp.argmax(logits, axis=-1)
     if rng is None:
         return greedy
@@ -272,7 +275,12 @@ def _sample(logits, *, temperature, top_k: int, rng, top_p: float = 0.0):
             keepdims=True,
         )
         logits = jnp.where(logits < cutoff, -jnp.inf, logits)
-    scaled = logits / jnp.maximum(temperature, 1e-6)
+    temperature = jnp.asarray(temperature)
+    # a (B,) vector must scale along the batch axis, not broadcast
+    # against (B, V)'s vocab axis — the scalar shape is unchanged
+    scale_t = (temperature[:, None] if temperature.ndim == 1
+               else temperature)
+    scaled = logits / jnp.maximum(scale_t, 1e-6)
     sampled = jax.random.categorical(rng, scaled, axis=-1)
     return jnp.where(temperature == 0.0, greedy, sampled)
 

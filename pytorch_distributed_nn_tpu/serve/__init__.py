@@ -67,6 +67,10 @@ from pytorch_distributed_nn_tpu.serve.autoscale import (  # noqa: F401
     SimController,
 )
 from pytorch_distributed_nn_tpu.serve import autoscale  # noqa: F401
+from pytorch_distributed_nn_tpu.serve.decoding import (  # noqa: F401
+    DecodeSpec,
+    TokenStream,
+)
 from pytorch_distributed_nn_tpu.serve.disagg import (  # noqa: F401
     DisaggFleet,
 )

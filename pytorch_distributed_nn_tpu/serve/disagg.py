@@ -171,8 +171,16 @@ class DisaggFleet(Fleet):
         a decode-stage ticket (post-handoff, or a decode-leg failover
         re-admission) places by KV headroom + affinity and pulls warmth
         from the owning peer first."""
+        branches = (ticket.decode.branches
+                    if ticket.decode is not None else 1)
         if not ticket.stage:
-            ticket.stage = "prefill"
+            # Prism best-of-n skips the split: a branched request has
+            # no single "first token" to hand off (each branch forks
+            # its own stream at step 0), so it runs whole on a decode
+            # replica. Sampled n=1 requests split normally — the
+            # decode leg resumes RNG lane (seed, 0) at step
+            # len(prefix), stitching the exact single-leg stream.
+            ticket.stage = "prefill" if branches == 1 else "decode"
         if ticket.stage == "prefill":
             leg_budget = 1
             h = self.router.place(self._replicas, len(prompt) + 1,
@@ -181,7 +189,8 @@ class DisaggFleet(Fleet):
             leg_budget = max_new
             h = self.router.place(self._replicas,
                                   len(prompt) + max_new,
-                                  prompt=prompt, stage="decode")
+                                  prompt=prompt, stage="decode",
+                                  branches=branches)
         if h is None:
             self._finalize_rejected(ticket, "no_replica")
             return None
@@ -194,6 +203,10 @@ class DisaggFleet(Fleet):
             prompt, leg_budget, deadline_s=ticket.deadline_s,
             request_id=ticket.request_id, resubmit=resubmit,
             tenant=ticket.tenant,
+            # Prism: each leg continues the SAME (seed, branch, step)
+            # lanes — the decode leg's step0 is exactly the tokens the
+            # prefill leg (and any dead lives) already covered
+            decode=ticket.decode, decode_step0=len(ticket.prefix),
             trace_ctx=ticket.trace, t_origin=ticket.t_submit,
             t_first_origin=ticket.t_first_token,
             # Lighthouse: the decode leg resumes the prefill leg's

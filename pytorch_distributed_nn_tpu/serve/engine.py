@@ -57,10 +57,14 @@ from pytorch_distributed_nn_tpu.obs import (
     xray,
 )
 from pytorch_distributed_nn_tpu.runtime import chaos
-from pytorch_distributed_nn_tpu.serve import autoscale
+from pytorch_distributed_nn_tpu.serve import autoscale, decoding
 from pytorch_distributed_nn_tpu.serve.kv_pool import KVPool
 from pytorch_distributed_nn_tpu.serve.prefix_cache import PrefixCache
-from pytorch_distributed_nn_tpu.serve.scheduler import Request, Scheduler
+from pytorch_distributed_nn_tpu.serve.scheduler import (
+    Request,
+    Scheduler,
+    branch_seq_ids,
+)
 
 # TTFT spans queueing (ms..s under load); per-token latency is ms-scale
 _TTFT_BUCKETS = (0.001, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0,
@@ -146,6 +150,98 @@ def _serve_step_lora(model, params, cache, last_tok, lengths, active,
     nxt = jnp.where(active, nxt, last_tok)
     lengths = jnp.where(active, lengths + 1, lengths)
     return nxt, lengths, mutated["cache"]
+
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+def _serve_prefill_logits(model, params, cache, tokens, lengths, starts):
+    """Sampled-path prefill twin of :func:`_serve_prefill`: returns the
+    (1, V) next-token logits instead of their argmax, so the host can
+    fan ONE prompt's logits into n branch first-tokens (and their
+    logprobs) without a second forward. The greedy path never routes
+    here — its jit (and bytes) are untouched."""
+    return _apply_prefill_at(model, params, cache, tokens, lengths,
+                             starts)
+
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+def _serve_prefill_logits_lora(model, params, cache, tokens, lengths,
+                               starts, bank, ids):
+    """LoRA twin of :func:`_serve_prefill_logits`."""
+    return _apply_prefill_at(model, params, cache, tokens, lengths,
+                             starts, lora_bank=bank, adapter_ids=ids)
+
+
+@functools.partial(jax.jit, static_argnums=(1,))
+def _sample_first(next_logits, n, temp, top_k, top_p, seed, step0):
+    """First token for each of a request's ``n`` branches from one
+    prefill's (1, V) logits: branch k draws with key
+    ``fold_in(fold_in(key(seed), k), step0)`` — the same derivation
+    the decode-step jit uses, so a branch's whole stream is one
+    unbroken (seed, branch, step) sequence. Returns ((n,) int32
+    tokens, (n,) float32 logprobs under the model distribution).
+    ``n`` is static: one program per distinct branch count, not per
+    spec."""
+    row = next_logits[0]
+    logits = jnp.broadcast_to(row, (n, row.shape[-1]))
+    branches = jnp.arange(n, dtype=jnp.int32)
+    seeds = jnp.full((n,), seed, jnp.int32)
+    steps = jnp.full((n,), step0, jnp.int32)
+    temps = jnp.full((n,), temp, jnp.float32)
+    top_ks = jnp.full((n,), top_k, jnp.int32)
+    top_ps = jnp.full((n,), top_p, jnp.float32)
+    keys = decoding.row_keys(seeds, branches, steps)
+    toks = decoding.sample_rows(logits, temps, top_ks, top_ps,
+                                keys).astype(jnp.int32)
+    return toks, decoding.token_logprobs(logits, toks)
+
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+def _serve_step_sample(model, params, cache, last_tok, lengths, active,
+                       temps, top_ks, top_ps, seeds, branches, steps,
+                       logprob):
+    """Sampled twin of :func:`_serve_step`: the SAME ragged forward
+    (greedy rows in a mixed batch still see bit-identical logits and
+    take the per-row greedy ``where`` branch), then per-row seeded
+    sampling with traced temperature/top_k/top_p. Per-row RNG steps
+    and cumulative logprobs advance INSIDE the jit, so the hot loop
+    stays transfer-free and best-of-n ranking needs no per-round
+    fetch."""
+    logits, cache = _apply_decode_ragged(model, params, cache, last_tok,
+                                         lengths)
+    keys = decoding.row_keys(seeds, branches, steps)
+    drawn = decoding.sample_rows(logits, temps, top_ks, top_ps,
+                                 keys).astype(jnp.int32)
+    logprob = jnp.where(active,
+                        logprob + decoding.token_logprobs(logits, drawn),
+                        logprob)
+    nxt = jnp.where(active, drawn, last_tok)
+    lengths = jnp.where(active, lengths + 1, lengths)
+    steps = jnp.where(active, steps + 1, steps)
+    return nxt, lengths, steps, logprob, cache
+
+
+@functools.partial(jax.jit, static_argnums=(0,), donate_argnums=(2,))
+def _serve_step_sample_lora(model, params, cache, last_tok, lengths,
+                            active, bank, ids, temps, top_ks, top_ps,
+                            seeds, branches, steps, logprob):
+    """LoRA twin of :func:`_serve_step_sample`."""
+    raw, mutated = model.apply(
+        {"params": params, "cache": cache}, last_tok[:, None],
+        train=False, decode=True, last_only=True, mutable=["cache"],
+        cache_positions=lengths.astype(jnp.int32),
+        lora_bank=bank, adapter_ids=ids,
+    )
+    logits = raw[:, -1, :]
+    keys = decoding.row_keys(seeds, branches, steps)
+    drawn = decoding.sample_rows(logits, temps, top_ks, top_ps,
+                                 keys).astype(jnp.int32)
+    logprob = jnp.where(active,
+                        logprob + decoding.token_logprobs(logits, drawn),
+                        logprob)
+    nxt = jnp.where(active, drawn, last_tok)
+    lengths = jnp.where(active, lengths + 1, lengths)
+    steps = jnp.where(active, steps + 1, steps)
+    return nxt, lengths, steps, logprob, mutated["cache"]
 
 
 @functools.partial(jax.jit, static_argnums=(2,), donate_argnums=(1,))
@@ -237,17 +333,25 @@ def _bucket_len(n: int, floor: int = 16) -> int:
 
 
 class _Slot:
-    """Host-side mirror of one batch row."""
+    """Host-side mirror of one batch row (= one decode branch)."""
 
-    __slots__ = ("req", "emitted", "tokens", "depth", "cached")
+    __slots__ = ("req", "emitted", "tokens", "depth", "cached",
+                 "seq_id", "branch", "step0", "streamed")
 
     def __init__(self, req: Request, first_token: int, depth: int,
-                 cached: int = 0):
+                 cached: int = 0, seq_id: str = "", branch: int = 0):
         self.req = req
         self.tokens = [int(first_token)]
         self.emitted = 1
         self.depth = depth  # cache rows filled (prompt + emitted - 1)
         self.cached = cached  # prompt tokens restored from prefix cache
+        # Prism: which pool sequence this row extends (== request_id
+        # for branch 0 / unbranched requests), the branch's RNG lane,
+        # and the sampling step this leg started at
+        self.seq_id = seq_id or req.request_id
+        self.branch = branch
+        self.step0 = req.decode_step0
+        self.streamed = 0  # tokens already pushed to req.stream
 
 
 class ServingEngine:
@@ -258,7 +362,8 @@ class ServingEngine:
                  max_queue: int = 64, max_prefills_per_round: int = 2,
                  eos_token: Optional[int] = None, metrics=None,
                  tag: str = "", prefix_cache: bool = True,
-                 lora_bank=None, tenant_quotas=None) -> None:
+                 lora_bank=None, tenant_quotas=None,
+                 stream_chunk_tokens: int = 1) -> None:
         if max_slots < 1:
             raise ValueError(f"max_slots must be >= 1, got {max_slots}")
         self.model = model
@@ -329,6 +434,34 @@ class ServingEngine:
         self._d_depth = jnp.asarray(self._h_depth)
         self._d_active = jnp.asarray(self._h_active)
         self._d_adapter = jnp.asarray(self._h_adapter)
+        # Prism per-row sampling mirrors (serve/decoding.py): synced on
+        # admission/retirement like the four above; the decode-sample
+        # jit consumes them as traced arrays so any greedy/sampled row
+        # mix runs one compiled program. Steps + cumulative logprobs
+        # advance ON DEVICE inside the jit.
+        self._h_temp = np.zeros((max_slots,), np.float32)
+        self._h_topk = np.zeros((max_slots,), np.int32)
+        self._h_topp = np.zeros((max_slots,), np.float32)
+        self._h_seed = np.zeros((max_slots,), np.int32)
+        self._h_branch = np.zeros((max_slots,), np.int32)
+        self._h_step = np.zeros((max_slots,), np.int32)
+        self._d_temp = jnp.asarray(self._h_temp)
+        self._d_topk = jnp.asarray(self._h_topk)
+        self._d_topp = jnp.asarray(self._h_topp)
+        self._d_seed = jnp.asarray(self._h_seed)
+        self._d_branch = jnp.asarray(self._h_branch)
+        self._d_step = jnp.asarray(self._h_step)
+        self._d_logprob = jnp.zeros((max_slots,), jnp.float32)
+        # prefill-sampled first-token logprobs, applied to _d_logprob
+        # at the next sync (slot index -> value)
+        self._pending_logprob: dict[int, float] = {}
+        self._n_sampled = 0  # active slots needing the sampled jit
+        # best-of-n bookkeeping: request_id -> {branch: (tokens, logprob)}
+        self._branch_done: dict[str, dict[int, tuple]] = {}
+        # incremental streaming: tokens per chunk (1 = every token is
+        # a chunk). Chunking never changes the retired fingerprint —
+        # the Lighthouse fold runs over the full token list at retire.
+        self.stream_chunk_tokens = max(int(stream_chunk_tokens), 1)
         # bench/report feed: per-round wall seconds + finished requests
         self.round_seconds: list[float] = []
         self.completed: list[dict] = []
@@ -352,6 +485,9 @@ class ServingEngine:
             "serve_batch_occupancy", "active decode slots")
         self._c_tokens = reg.counter(
             "serve_tokens_total", "tokens emitted by the engine")
+        self._c_stream_chunks = reg.counter(
+            "serve_stream_chunks_total",
+            "token chunks pushed to streaming clients")
 
     # -- client surface ----------------------------------------------------
 
@@ -367,6 +503,12 @@ class ServingEngine:
             raise ValueError(
                 f"adapter {adapter} requested but the engine has no "
                 f"LoRA bank (pass lora_bank= to ServingEngine)")
+        spec = kw.get("decode")
+        if spec is not None \
+                and getattr(spec, "branches", 1) > self.max_slots:
+            raise ValueError(
+                f"best_of={getattr(spec, 'branches', 1)} branches can "
+                f"never fit a {self.max_slots}-slot engine")
         return self.scheduler.submit(prompt, max_new_tokens, **kw)
 
     @property
@@ -466,15 +608,25 @@ class ServingEngine:
         if not admitted:
             return False
         for req in admitted:
-            slot = free.pop(0)
-            self._prefill_into(slot, req)
+            # a branched request claims one row per branch (the
+            # scheduler already counted them against free_slots)
+            slots = [free.pop(0) for _ in range(req.branches)]
+            self._prefill_into(slots, req)
         # a budget-1 (or instant-eos) request retires in the same pass
         self._retire_finished()
         self._sync_slots()
         return True
 
-    def _prefill_into(self, slot: int, req: Request) -> None:
+    def _prefill_into(self, slots: list, req: Request) -> None:
+        """Prefill ONE request into ``len(slots)`` batch rows. The
+        prompt forward runs once; branched requests fan the resulting
+        row cache into every branch row (``_insert_row`` donates only
+        the batch cache, so one prefilled row inserts n times) and
+        draw each branch's first token from the same prompt logits
+        under its own RNG lane."""
         L = len(req.prompt)
+        spec = req.decode
+        sampled = spec is not None and spec.sampled
         match = req.prefix_match
         m = match.tokens if match is not None else 0
         bs = self.scheduler.pool.block_size
@@ -501,20 +653,44 @@ class ServingEngine:
                 row_cache, self._store, bs, table, np.int32(nb))
             trace.on_segment(req.trace, "restore", t_restore,
                              time.monotonic(), blocks=nb, cached=m)
+        logps: Optional[list] = None
         with obs.span("serve/prefill", request=req.request_id,
                       prompt_len=L, cached=m):
-            if self.lora_bank is None:
-                tok0, row_cache = _serve_prefill(
-                    self.model, self.params, row_cache,
-                    jnp.asarray(tokens), jnp.asarray([T], jnp.int32),
-                    jnp.asarray([m], jnp.int32))
+            if not sampled:
+                # inert-defaults contract: this arm is the EXACT
+                # pre-Prism call (test_quality pins its shape), so
+                # greedy requests stay byte-identical
+                if self.lora_bank is None:
+                    tok0, row_cache = _serve_prefill(
+                        self.model, self.params, row_cache,
+                        jnp.asarray(tokens), jnp.asarray([T], jnp.int32),
+                        jnp.asarray([m], jnp.int32))
+                else:
+                    tok0, row_cache = _serve_prefill_lora(
+                        self.model, self.params, row_cache,
+                        jnp.asarray(tokens), jnp.asarray([T], jnp.int32),
+                        jnp.asarray([m], jnp.int32), self.lora_bank,
+                        jnp.asarray([req.adapter], jnp.int32))
+                firsts = [int(np.asarray(tok0)[0])]
             else:
-                tok0, row_cache = _serve_prefill_lora(
-                    self.model, self.params, row_cache,
-                    jnp.asarray(tokens), jnp.asarray([T], jnp.int32),
-                    jnp.asarray([m], jnp.int32), self.lora_bank,
-                    jnp.asarray([req.adapter], jnp.int32))
-            first = int(np.asarray(tok0)[0])
+                if self.lora_bank is None:
+                    next_logits, row_cache = _serve_prefill_logits(
+                        self.model, self.params, row_cache,
+                        jnp.asarray(tokens), jnp.asarray([T], jnp.int32),
+                        jnp.asarray([m], jnp.int32))
+                else:
+                    next_logits, row_cache = _serve_prefill_logits_lora(
+                        self.model, self.params, row_cache,
+                        jnp.asarray(tokens), jnp.asarray([T], jnp.int32),
+                        jnp.asarray([m], jnp.int32), self.lora_bank,
+                        jnp.asarray([req.adapter], jnp.int32))
+                toks, lps = _sample_first(
+                    next_logits, len(slots),
+                    np.float32(spec.temperature), np.int32(spec.top_k),
+                    np.float32(spec.top_p), np.int32(spec.seed),
+                    np.int32(req.decode_step0))
+                firsts = [int(t) for t in np.asarray(toks)]
+                logps = [float(x) for x in np.asarray(lps)]
         if match is not None:
             # restored rows are copied out; the COW tail pin can drop
             self.prefix_cache.finish_restore(match)
@@ -531,16 +707,33 @@ class ServingEngine:
             ttft = now - (req.t_origin or req.t_submit)
             self._h_ttft.observe(ttft)
             self._h_ttft_tenant.observe(ttft, tenant=req.tenant)
-        self._cache = _insert_row(self._cache, row_cache, slot)
-        self._slots[slot] = _Slot(req, first, depth=L, cached=m)
-        self._h_last[slot] = first
-        self._h_depth[slot] = L
-        self._h_active[slot] = True
-        self._h_adapter[slot] = req.adapter
-        self._c_tokens.inc()  # the prefill-produced first token
-        flight.record("serve", "admit", step=self.scheduler.round,
-                      note=f"{req.request_id} slot={slot} L={L} "
-                           f"cached={m}")
+        sids = branch_seq_ids(req)
+        for k, slot in enumerate(slots):
+            self._cache = _insert_row(self._cache, row_cache, slot)
+            s = _Slot(req, firsts[k], depth=L, cached=m,
+                      seq_id=sids[k], branch=k)
+            self._slots[slot] = s
+            self._h_last[slot] = firsts[k]
+            self._h_depth[slot] = L
+            self._h_active[slot] = True
+            self._h_adapter[slot] = req.adapter
+            # reset the sampling mirrors: slots are reused, and a
+            # greedy row landing on a retired sampled row must read
+            # temperature 0 (the jit's per-row greedy branch)
+            self._h_temp[slot] = spec.temperature if sampled else 0.0
+            self._h_topk[slot] = spec.top_k if sampled else 0
+            self._h_topp[slot] = spec.top_p if sampled else 0.0
+            self._h_seed[slot] = spec.seed if sampled else 0
+            self._h_branch[slot] = k
+            self._pending_logprob[slot] = logps[k] if sampled else 0.0
+            self._c_tokens.inc()  # the prefill-produced first token
+            flight.record("serve", "admit", step=self.scheduler.round,
+                          note=f"{sids[k]} slot={slot} L={L} "
+                               f"cached={m}")
+            if k == 0:
+                # first chunk = the client-visible TTFT event (no-op
+                # for non-streaming requests)
+                self._emit_chunk(s)
         # Abacus prefill billing: the suffix actually computed, plus
         # the cached-prefix FLOPs the restore SKIPPED as a credit
         # (audit shadow/probe legs are never billed)
@@ -560,15 +753,34 @@ class ServingEngine:
         # injected slow round shows up in the latency histograms
         # exactly like a real one
         chaos.on_step(self.scheduler.round)
-        if self.lora_bank is None:
-            nxt, depth, self._cache = _serve_step(
-                self.model, self.params, self._cache, self._d_last,
-                self._d_depth, self._d_active)
+        if self._n_sampled == 0:
+            # inert-defaults contract: an all-greedy batch runs the
+            # EXACT pre-Prism jits (test_quality pins the call shape),
+            # so default requests stay byte-identical
+            if self.lora_bank is None:
+                nxt, depth, self._cache = _serve_step(
+                    self.model, self.params, self._cache, self._d_last,
+                    self._d_depth, self._d_active)
+            else:
+                nxt, depth, self._cache = _serve_step_lora(
+                    self.model, self.params, self._cache, self._d_last,
+                    self._d_depth, self._d_active, self.lora_bank,
+                    self._d_adapter)
+        elif self.lora_bank is None:
+            nxt, depth, self._d_step, self._d_logprob, self._cache = \
+                _serve_step_sample(
+                    self.model, self.params, self._cache, self._d_last,
+                    self._d_depth, self._d_active, self._d_temp,
+                    self._d_topk, self._d_topp, self._d_seed,
+                    self._d_branch, self._d_step, self._d_logprob)
         else:
-            nxt, depth, self._cache = _serve_step_lora(
-                self.model, self.params, self._cache, self._d_last,
-                self._d_depth, self._d_active, self.lora_bank,
-                self._d_adapter)
+            nxt, depth, self._d_step, self._d_logprob, self._cache = \
+                _serve_step_sample_lora(
+                    self.model, self.params, self._cache, self._d_last,
+                    self._d_depth, self._d_active, self.lora_bank,
+                    self._d_adapter, self._d_temp, self._d_topk,
+                    self._d_topp, self._d_seed, self._d_branch,
+                    self._d_step, self._d_logprob)
         self._d_last, self._d_depth = nxt, depth
         host_tok = np.asarray(nxt)
         return host_tok, time.monotonic() - t0
@@ -597,7 +809,10 @@ class ServingEngine:
             s.depth += 1
             self._h_last[i] = tok
             self._h_depth[i] = s.depth
-            self.scheduler.pool.extend(s.req.request_id, s.depth)
+            self.scheduler.pool.extend(s.seq_id, s.depth)
+            if s.req.stream is not None and \
+                    len(s.tokens) - s.streamed >= self.stream_chunk_tokens:
+                self._emit_chunk(s)
         retired = self._retire_finished()
         if flipped:
             # push the corrupted last-token mirror to device (mirrors
@@ -621,16 +836,91 @@ class ServingEngine:
             self._h_active[i] = False
             retired += 1
             req = s.req
+            if req.branches > 1:
+                self._retire_branch(i, s)
+                continue
             if self.prefix_cache is not None:
                 # donate BEFORE retire: release() indexes the physical
                 # blocks into the radix, so their bytes must already be
                 # in the store when another admission can match them
                 self._donate_blocks(i, s)
+            # final flush BEFORE retire: the closing chunk must be in
+            # the stream when done.set() wakes the client
+            self._emit_chunk(s, final=True)
             self.scheduler.retire(req, np.asarray(s.tokens, np.int32))
             flight.record("serve", "retire", step=self.scheduler.round,
                           note=f"{req.request_id} tokens={s.emitted}")
             self._finish_record(req, s)
         return retired
+
+    def _retire_branch(self, slot: int, s: _Slot) -> None:
+        """Retire ONE branch of a best-of-n request: bank its tokens +
+        device-accumulated logprob, free its KV tail (each branch
+        retires at its OWN eos/budget — a short branch's blocks return
+        to the pool while its siblings decode on). The request itself
+        retires when its last branch lands: rank by cumulative
+        logprob, hand the client the top ``n``."""
+        req = s.req
+        # outside the hot loop (retirement path), so the fetch is
+        # legal. A budget-1 branch retires in the same _admit pass
+        # that prefilled it — before _sync_slots merged its first
+        # token's logprob to device — so the pending value wins.
+        lp = self._pending_logprob.pop(slot, None)
+        if lp is None:
+            lp = float(np.asarray(self._d_logprob)[slot])
+        self.scheduler.release_branch(req, s.seq_id)
+        done = self._branch_done.setdefault(req.request_id, {})
+        done[s.branch] = (list(s.tokens), lp)
+        flight.record("serve", "retire_branch",
+                      step=self.scheduler.round,
+                      note=f"{s.seq_id} tokens={s.emitted} "
+                           f"logprob={lp:.4f}")
+        if len(done) < req.branches:
+            return
+        del self._branch_done[req.request_id]
+        # highest cumulative logprob wins; branch index breaks ties
+        # deterministically
+        order = sorted(done.items(), key=lambda kv: (-kv[1][1], kv[0]))
+        n_best = [dict(branch=k, tokens=list(t), logprob=lp)
+                  for k, (t, lp) in order][:req.decode.n]
+        win_tokens, win_lp = done[order[0][0]]
+        # the winning branch's view rides the JSONL record: reuse the
+        # last slot mirror as the record carrier
+        s.tokens = win_tokens
+        s.emitted = len(win_tokens)
+        self.scheduler.finish_branches(
+            req, np.asarray(win_tokens, np.int32), n_best, win_lp)
+        flight.record("serve", "retire", step=self.scheduler.round,
+                      note=f"{req.request_id} tokens={s.emitted} "
+                           f"branches={req.branches}")
+        self._finish_record(req, s)
+
+    def _emit_chunk(self, s: _Slot, final: bool = False) -> None:
+        """THE streaming funnel: every token chunk a client sees flows
+        through this one ``TokenStream._feed`` call site (lint-pinned),
+        so chunk accounting (counter, flight, JSONL) can never drift
+        from what was actually delivered. No-op for non-streaming
+        requests."""
+        stream = s.req.stream
+        if stream is None:
+            return
+        chunk = s.tokens[s.streamed:]
+        if chunk:
+            first = s.streamed == 0
+            s.streamed = len(s.tokens)
+            stream._feed(chunk)
+            self._c_stream_chunks.inc()
+            flight.record("serve", "stream_chunk",
+                          step=self.scheduler.round,
+                          note=f"{s.req.request_id} n={len(chunk)}"
+                               f"{' first' if first else ''}"
+                               f"{' final' if final else ''}")
+            if self.metrics is not None:
+                self.metrics.emit(
+                    "serve_stream_chunk", request_id=s.req.request_id,
+                    tokens=len(chunk), first=first, final=final)
+        if final:
+            stream.close()
 
     def _donate_blocks(self, slot: int, s: _Slot) -> None:
         """Copy the retiring slot's full KV blocks into the device
@@ -724,6 +1014,16 @@ class ServingEngine:
         )
         if self.tag:
             rec["replica"] = self.tag
+        # Prism keys: absent for default requests (key-absent wire
+        # discipline — a greedy, non-streaming run's JSONL is
+        # byte-identical to a pre-Prism build)
+        if req.decode is not None:
+            rec["decode"] = req.decode.to_wire()
+        if req.n_best is not None:
+            rec["branches"] = req.branches
+            rec["logprob"] = round(req.logprob, 6)
+        if req.stream is not None:
+            rec["stream_chunks"] = req.stream.chunks
         if req.trace is not None:
             # the record names its trace (watchtower pages attach it;
             # key absent when untraced, so replayed streams from an
@@ -792,6 +1092,31 @@ class ServingEngine:
         self._d_depth = jnp.asarray(self._h_depth)
         self._d_active = jnp.asarray(self._h_active)
         self._d_adapter = jnp.asarray(self._h_adapter)
+        # Prism mirrors: recompute which rows need the sampled jit and
+        # each row's RNG step (step0 + emitted — recomputable host-side
+        # by design, so a flip-drill mid-round resync cannot skew the
+        # device counter)
+        self._n_sampled = 0
+        for i, s in enumerate(self._slots):
+            if s is None:
+                continue
+            self._h_step[i] = s.step0 + s.emitted
+            if s.req.decode is not None and s.req.decode.sampled:
+                self._n_sampled += 1
+        if self._n_sampled or self._pending_logprob:
+            # logprobs accumulate ON DEVICE: pull, overlay the prefill
+            # first-token values, push back (retirement path only)
+            h_logprob = np.asarray(self._d_logprob).copy()
+            for slot, v in self._pending_logprob.items():
+                h_logprob[slot] = v
+            self._pending_logprob.clear()
+            self._d_logprob = jnp.asarray(h_logprob)
+        self._d_temp = jnp.asarray(self._h_temp)
+        self._d_topk = jnp.asarray(self._h_topk)
+        self._d_topp = jnp.asarray(self._h_topp)
+        self._d_seed = jnp.asarray(self._h_seed)
+        self._d_branch = jnp.asarray(self._h_branch)
+        self._d_step = jnp.asarray(self._h_step)
 
     def flops_per_token(self) -> int:
         """Analytic forward FLOPs of ONE token through this model

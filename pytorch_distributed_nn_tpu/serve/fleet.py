@@ -128,6 +128,12 @@ class FleetTicket:
         # re-linked (leg+1, parent=previous root span) on every
         # resubmission; None when unarmed or unsampled
         self.trace = None
+        # Prism (serve/decoding.py): the request's DecodeSpec (None =
+        # greedy, byte-identity path) — every leg (failover, shadow,
+        # referee, disagg decode) carries the same spec so seeded
+        # sampling reproduces deterministically across legs
+        self.decode = None
+        self.n_best = None  # ranked [{branch, tokens, logprob}] (best-of-n)
 
     @property
     def ok(self) -> bool:
@@ -529,14 +535,18 @@ class Fleet:
     def submit(self, prompt, max_new_tokens: int, *,
                deadline_s: Optional[float] = None,
                request_id: Optional[str] = None,
-               tenant: str = "default") -> FleetTicket:
+               tenant: str = "default",
+               decode=None) -> FleetTicket:
         """Admit once, place once (router-scored), journal for
         failover. Always returns a ticket; a rejected one is already
-        terminal."""
+        terminal. ``decode`` (a :class:`serve.decoding.DecodeSpec`)
+        rides the ticket so every leg — failover, shadow, referee,
+        disagg decode — reproduces the same seeded stream."""
         prompt = np.asarray(prompt, np.int32).reshape(-1)
         ticket = FleetTicket(
             request_id or f"freq-{next(_ids)}", prompt,
             max_new_tokens, deadline_s, tenant=tenant)
+        ticket.decode = decode
         # Causeway mint point: the context outlives every per-replica
         # Request this ticket will spawn
         ticket.trace = trace.on_submit(ticket.request_id)
@@ -595,8 +605,11 @@ class Fleet:
         fleet lock). Terminalizes the ticket when no replica is ready
         or the chosen replica rejects. Returns the replica index that
         accepted the request, None otherwise."""
+        branches = (ticket.decode.branches
+                    if ticket.decode is not None else 1)
         h = self.router.place(self._replicas,
-                              len(prompt) + max_new, prompt=prompt)
+                              len(prompt) + max_new, prompt=prompt,
+                              branches=branches)
         if h is None:
             self._finalize_rejected(ticket, "no_replica")
             return None
@@ -604,6 +617,9 @@ class Fleet:
             prompt, max_new, deadline_s=ticket.deadline_s,
             request_id=ticket.request_id, resubmit=resubmit,
             tenant=ticket.tenant,
+            # Prism: the leg samples the SAME (seed, branch, step)
+            # lanes, resumed at the step the prefix already covers
+            decode=ticket.decode, decode_step0=len(ticket.prefix),
             trace_ctx=ticket.trace, t_origin=ticket.t_submit,
             t_first_origin=ticket.t_first_token,
             # Lighthouse: the leg resumes the chain over the tokens
@@ -722,7 +738,12 @@ class Fleet:
             if idx != h.index or req.done.is_set():
                 continue  # terminal lives finalize normally
             emitted: list[int] = []
-            if h.engine is not None:
+            branched = (ticket.decode is not None
+                        and ticket.decode.branches > 1)
+            if h.engine is not None and not branched:
+                # best-of-n requests restart from the bare prompt: one
+                # branch's tail is not "the" stream, and deterministic
+                # seeding re-derives every branch identically anyway
                 for slot in h.engine._slots:
                     if slot is not None and slot.req is req:
                         emitted = [int(t) for t in slot.tokens]
@@ -792,6 +813,9 @@ class Fleet:
                 prompt, max_new,
                 request_id=ticket.request_id + "#shadow",
                 tenant=audit.SHADOW_TENANT,
+                # Prism: the shadow leg samples the same seeded lanes,
+                # so sampled streams are comparable fingerprints too
+                decode=ticket.decode,
                 t_first_origin=ticket.t_submit)
         except ValueError:
             return
@@ -886,6 +910,7 @@ class Fleet:
                             ticket.prompt, ticket.max_new_tokens,
                             request_id=rid + "#referee",
                             tenant=audit.SHADOW_TENANT,
+                            decode=ticket.decode,
                             t_first_origin=time.monotonic())
                     except ValueError:
                         rreq = None
@@ -1028,6 +1053,9 @@ class Fleet:
             _, req = ticket._attempt
             if req.tokens is not None:
                 tail = [int(t) for t in req.tokens]
+            # Prism best-of-n: the ranked alternates ride the ticket
+            # (None for unbranched requests — attribute stays inert)
+            ticket.n_best = getattr(req, "n_best", None)
         ticket.tokens = np.asarray(ticket.prefix + tail, np.int32)
         ticket.t_done = time.monotonic()
         ticket.status = "done"

@@ -184,12 +184,22 @@ class _EngineBackend:
         return bool(self._reqs) or self.engine.has_work
 
     def admit(self, rec: dict) -> None:
+        kw = {}
+        if rec.get("decode"):
+            # Prism: rebuild the spec from its wire dict (loud on
+            # unknown keys — a version-skewed coordinator fails the
+            # dispatch, never silently mis-samples)
+            from pytorch_distributed_nn_tpu.serve.decoding import (
+                DecodeSpec,
+            )
+            kw["decode"] = DecodeSpec.from_wire(rec["decode"])
+            kw["decode_step0"] = int(rec.get("step0", 0))
         req = self.engine.submit(
             self._np.asarray(rec["prompt"], self._np.int32),
             int(rec["max_new_tokens"]),
             request_id=rec["request_id"],
             resubmit=bool(rec.get("life", 0)),
-            tenant=rec.get("tenant", "default"))
+            tenant=rec.get("tenant", "default"), **kw)
         self._reqs.append((rec, req))
 
     def step(self) -> tuple[list, list]:

@@ -94,6 +94,9 @@ class KVPool:
         self._g_cached = reg.gauge(
             "serve_kv_blocks_cached", "refcount-0 prefix blocks parked "
             "in the cached-LRU ring")
+        self._c_branches = reg.counter(
+            "serve_branches_total", "n-best decode branches forked off "
+            "a primary reservation (COW prompt sharing)")
         self._g_total.set(num_blocks)
         self._publish_locked()
 
@@ -163,6 +166,32 @@ class KVPool:
         # Abacus residency start (outside the lock: the meter has its
         # own; inert one-comparison no-op unless TPUNN_METER armed)
         meter.on_kv_reserve(seq_id, table)
+        return True
+
+    def fork(self, parent_id: str, child_id: str, tokens: int, *,
+             shared_tokens: int) -> bool:
+        """COW-fork a decode branch off a live parent reservation (the
+        Prism n-best choke point — exactly one package call site,
+        lint-pinned). The parent's *full* blocks covering
+        ``shared_tokens`` prompt rows join the child's table by
+        reference (refcounted, exactly like a prefix-cache share: an
+        exclusively-owned parent block becomes live-shared, an
+        already-shared one gains a sharer); only the child's tail —
+        the partial prompt block plus its own generated tokens — comes
+        off the free list. n branches therefore hold ONE prompt block
+        set + n tails, not n full reservations. False (and no state
+        change) when the free list can't cover the tail — the
+        scheduler's backpressure signal, same as :meth:`reserve`."""
+        with self._lock:
+            table = self._tables.get(parent_id)
+            if table is None:
+                raise KeyError(
+                    f"fork parent {parent_id!r} has no reservation")
+            shared = list(table[:max(int(shared_tokens), 0)
+                                // self.block_size])
+        if not self.reserve(child_id, tokens, shared=shared):
+            return False
+        self._c_branches.inc()
         return True
 
     def extend(self, seq_id: str, tokens: int) -> None:

@@ -263,6 +263,16 @@ class PrefixCache:
                 self._account("miss", note=seq_id)
             return match
 
+    def make_room(self, blocks: int) -> int:
+        """Shed up to ``blocks`` unpinned LRU cached blocks to the free
+        list, returning the count actually shed. Branch tails
+        (:meth:`KVPool.fork`) allocate straight off the free list,
+        bypassing :meth:`admit`'s reclaim — the scheduler calls this
+        before retrying a fork that found the free list parked in the
+        cached ring."""
+        with self._lock:
+            return self._evict_locked(int(blocks))
+
     def finish_restore(self, match: PrefixMatch) -> None:
         """Unpin the COW tail once its content has been copied into the
         admitting sequence's rows."""

@@ -151,7 +151,16 @@ class ProcTicket:
         self.tokens: Optional[np.ndarray] = None
         self.assigned: Optional[int] = None  # replica index, None=unplaced
         self.trace = None  # TraceContext (obs/trace.py), None when unarmed
+        # Prism (serve/decoding.py): the DecodeSpec as its WIRE dict
+        # (journal + dispatch records are JSON); None = greedy default
+        self.decode: Optional[dict] = None
         self.done = threading.Event()
+
+    @property
+    def branches(self) -> int:
+        if not self.decode:
+            return 1
+        return self.decode.get("best_of", 0) or self.decode.get("n", 1)
 
     @property
     def ok(self) -> bool:
@@ -780,6 +789,7 @@ class ProcessFleet:
                 t = ProcTicket(rec["request_id"], rec["prompt"],
                                rec["max_new_tokens"],
                                tenant=rec.get("tenant", "default"))
+                t.decode = rec.get("decode")
                 tickets[t.request_id] = t
             elif ev == "place":
                 t = tickets.get(rec["request_id"])
@@ -839,16 +849,22 @@ class ProcessFleet:
 
     def submit(self, prompt, max_new_tokens: int, *,
                request_id: Optional[str] = None,
-               tenant: str = "default") -> ProcTicket:
+               tenant: str = "default",
+               decode=None) -> ProcTicket:
         """Admit once fleet-wide; journaled BEFORE dispatch so no
         coordinator death can lose it. Unplaceable requests (no READY
         replica yet, store blip) stay pending and are re-placed by the
-        next poll — the process fleet queues, it does not reject."""
+        next poll — the process fleet queues, it does not reject.
+        ``decode`` (a :class:`serve.decoding.DecodeSpec`) journals as
+        its wire dict, so a successor coordinator re-places the same
+        seeded sampling policy."""
         prompt = [int(t) for t in np.asarray(prompt).reshape(-1)]
         ticket = ProcTicket(
             request_id
             or f"preq-{self.incarnation}-{next(_ids)}",
             prompt, int(max_new_tokens), tenant=tenant)
+        if decode is not None:
+            ticket.decode = decode.to_wire() or None
         ticket.trace = trace.on_submit(ticket.request_id)
         with self._lock:
             self._tickets[ticket.request_id] = ticket
@@ -862,6 +878,10 @@ class ProcessFleet:
                 # journals stay byte-identical to pre-Abacus ones
                 if ticket.tenant != "default":
                     journal_rec["tenant"] = ticket.tenant
+                # Prism: same key-absent discipline — a greedy submit
+                # journals byte-identically to a pre-Prism one
+                if ticket.decode:
+                    journal_rec["decode"] = ticket.decode
                 self.journal.append(journal_rec)
             except (OSError, TimeoutError):
                 failure.count_store_error("coord_journal")
@@ -879,14 +899,18 @@ class ProcessFleet:
         remainder — same shape as :meth:`serve.disagg.DisaggFleet`,
         but over store-fed gauges and the cross-process wire."""
         if self.disagg and not ticket.stage:
-            ticket.stage = "prefill"
+            # Prism best-of-n skips the prefill/decode split (no single
+            # first token to hand off); see serve/disagg.py
+            ticket.stage = ("prefill" if ticket.branches == 1
+                            else "decode")
         if ticket.stage == "prefill":
             remaining = 1
         else:
             remaining = ticket.max_new_tokens - len(ticket.prefix)
         total = len(ticket.prompt) + len(ticket.prefix) + remaining
         h = self.router.place(self._replicas, total,
-                              stage=ticket.stage or None)
+                              stage=ticket.stage or None,
+                              branches=ticket.branches)
         if h is None:
             ticket.assigned = None
             return None
@@ -914,6 +938,12 @@ class ProcessFleet:
         # unarmed, wire bytes unchanged
         if audit.enabled():
             rec["fp"] = audit.seed_of(ticket.prefix)
+        # Prism: decode spec + RNG resume step ride the dispatch —
+        # keys ABSENT for greedy/fresh requests (wire bytes unchanged)
+        if ticket.decode:
+            rec["decode"] = ticket.decode
+            if ticket.prefix:
+                rec["step0"] = len(ticket.prefix)
         try:
             place_rec = {
                 "event": "place", "request_id": ticket.request_id,
@@ -1260,6 +1290,11 @@ class ProcessFleet:
             failure.count_store_error("coord_prog")
             return []
         if int(p.get("life", -1)) != t.life:
+            return []
+        if t.branches > 1:
+            # best-of-n re-admits from the bare prompt: one branch's
+            # tail is not "the" stream, and deterministic seeding
+            # re-derives every branch identically anyway
             return []
         return [int(x) for x in p.get("tokens", [])]
 

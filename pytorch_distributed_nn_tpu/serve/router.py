@@ -121,12 +121,28 @@ class Router:
             "serve_router_placements_total",
             "router placement decisions", labels=("outcome",))
 
-    def _score(self, handle, total_tokens: int) -> float:
+    @staticmethod
+    def _kv_need(pool, total_tokens: int, branches: int,
+                 prompt_tokens: int) -> int:
+        """Worst-case KV blocks for one request, COW-aware: a best-of-n
+        request reserves the prompt's blocks ONCE (branches share them
+        via ``KVPool.fork``) plus ``branches`` divergent tails — the
+        same arithmetic the bench accounting line proves, so placement
+        never overcharges n-way requests by ``n×`` the prompt."""
+        bs = pool.block_size
+        need = -(-int(total_tokens) // bs)
+        if branches > 1:
+            shared = max(int(prompt_tokens), 0) // bs
+            need += (branches - 1) * max(need - shared, 0)
+        return need
+
+    def _score(self, handle, total_tokens: int, branches: int = 1,
+               prompt_tokens: int = 0) -> float:
         """Higher is better; negative means the replica cannot reserve
         this request's KV budget right now (it would queue)."""
         pool = handle.engine.scheduler.pool
         sched = handle.engine.scheduler
-        need = -(-int(total_tokens) // pool.block_size)
+        need = self._kv_need(pool, total_tokens, branches, prompt_tokens)
         headroom = (pool.free_blocks - need) / max(pool.num_blocks, 1)
         queue_frac = sched.queue_depth / max(sched.max_queue, 1)
         return headroom - queue_frac
@@ -140,7 +156,9 @@ class Router:
         sched = handle.engine.scheduler
         return -sched.queue_depth / max(sched.max_queue, 1)
 
-    def _score_decode(self, handle, total_tokens: int) -> float:
+    def _score_decode(self, handle, total_tokens: int,
+                      branches: int = 1,
+                      prompt_tokens: int = 0) -> float:
         """Decode-stage score: KV headroom after this request's
         worst-case reservation. Decode is bandwidth/KV-bound — the leg
         holds its blocks for the whole emission — so free blocks after
@@ -149,13 +167,14 @@ class Router:
         top (a replica already holding the streamed blocks wins)."""
         pool = handle.engine.scheduler.pool
         sched = handle.engine.scheduler
-        need = -(-int(total_tokens) // pool.block_size)
+        need = self._kv_need(pool, total_tokens, branches, prompt_tokens)
         headroom = (pool.free_blocks - need) / max(pool.num_blocks, 1)
         queue_frac = sched.queue_depth / max(sched.max_queue, 1)
         return headroom - queue_frac
 
     def place(self, replicas, total_tokens: int, *, prompt=None,
-              adapter: int = 0, stage: str | None = None):
+              adapter: int = 0, stage: str | None = None,
+              branches: int = 1):
         """Pick the best READY replica for a request of
         ``total_tokens`` worst-case KV footprint; None when no replica
         is ready (the fleet rejects the request as ``no_replica``).
@@ -165,13 +184,15 @@ class Router:
         candidates to one disaggregated pool (``"prefill"`` /
         ``"decode"``) and switches to that stage's scoring; prefill
         placement ignores affinity (the leg is one shot — queue depth
-        dominates).
+        dominates). ``branches`` (best-of-n requests) charges the COW
+        footprint: one prompt + n tails, never n full sequences.
 
         THE placement choke point: every decision — including the
         failure to make one — lands in
         ``serve_router_placements_total{outcome}``."""
         best = None
         best_score = 0.0
+        prompt_tokens = len(prompt) if prompt is not None else 0
         for handle in replicas:
             if handle.state != READY:
                 continue
@@ -181,9 +202,11 @@ class Router:
             if stage == "prefill":
                 score = self._score_prefill(handle)
             elif stage == "decode":
-                score = self._score_decode(handle, total_tokens)
+                score = self._score_decode(handle, total_tokens,
+                                           branches, prompt_tokens)
             else:
-                score = self._score(handle, total_tokens)
+                score = self._score(handle, total_tokens, branches,
+                                    prompt_tokens)
             if stage != "prefill" and prompt is not None \
                     and len(prompt) > 0:
                 pc = getattr(handle.engine, "prefix_cache", None)

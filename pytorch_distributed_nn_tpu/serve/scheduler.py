@@ -74,6 +74,7 @@ import numpy as np
 from pytorch_distributed_nn_tpu.obs import flight, meter, trace, watchtower
 from pytorch_distributed_nn_tpu.obs.registry import get_registry
 from pytorch_distributed_nn_tpu.runtime import chaos
+from pytorch_distributed_nn_tpu.serve.decoding import DecodeSpec, TokenStream
 from pytorch_distributed_nn_tpu.serve.kv_pool import KVPool
 
 # request lifecycle (terminal states: REJECTED, DONE, FAILED)
@@ -138,14 +139,43 @@ class Request:
     # True while this request holds a slot in its tenant's live-quota
     # count (set on QUEUED, dropped on any terminal transition)
     quota_held: bool = False
+    # Prism (serve/decoding.py): how this request's tokens are chosen.
+    # None = greedy single-branch — the byte-identity default every
+    # pre-Prism caller gets. decode_step0 is the sampling-RNG step this
+    # leg resumes at (= tokens earlier legs already emitted: a disagg
+    # decode leg or a failover re-admission continues the fold_in
+    # sequence instead of restarting it).
+    decode: object = None
+    decode_step0: int = 0
+    # incremental streaming: the TokenStream the engine's _emit_chunk
+    # funnel feeds; None when the client didn't ask to stream
+    stream: object = None
+    # n-best results for branched requests, best-first:
+    # [{"tokens": [...], "logprob": float}]; logprob is the winner's
+    # cumulative logprob (req.tokens = the winner's stream)
+    n_best: object = None
+    logprob: float = 0.0
 
     @property
     def total_tokens(self) -> int:
         return len(self.prompt) + self.max_new_tokens
 
     @property
+    def branches(self) -> int:
+        """Batch rows / KV tails this request decodes in parallel."""
+        return self.decode.branches if self.decode is not None else 1
+
+    @property
     def ok(self) -> bool:
         return self.state == DONE
+
+
+def branch_seq_ids(req: Request) -> list[str]:
+    """Pool sequence ids for a request's decode branches. Branch 0 IS
+    the request id (an n=1 request's accounting is byte-identical to
+    pre-Prism); extra branches suffix ``#bK``."""
+    rid = req.request_id
+    return [rid] + [f"{rid}#b{k}" for k in range(1, req.branches)]
 
 
 class Scheduler:
@@ -252,6 +282,12 @@ class Scheduler:
         if state in (DONE, REJECTED, FAILED):
             req.t_done = time.monotonic()
             req.round_done = self.round
+            if req.stream is not None:
+                # idempotent terminal close: the engine's final
+                # _emit_chunk already closed a DONE stream; a rejected
+                # or failed request terminates its (empty) stream here
+                # so a streaming client never hangs on a dead request
+                req.stream.close()
             req.done.set()
 
     # -- client side -------------------------------------------------------
@@ -265,7 +301,10 @@ class Scheduler:
                trace_ctx: object = None,
                t_origin: Optional[float] = None,
                t_first_origin: float = 0.0,
-               fp_seed: str = "") -> Request:
+               fp_seed: str = "",
+               decode: object = None,
+               decode_step0: int = 0,
+               stream: bool = False) -> Request:
         """Thread-safe admission attempt. Always returns a Request; a
         rejected one is already terminal (``done`` set, ``state ==
         REJECTED``, ``reject_reason`` says why). ``resubmit`` marks a
@@ -284,6 +323,23 @@ class Scheduler:
         if max_new_tokens < 1:
             raise ValueError(
                 f"max_new_tokens must be >= 1, got {max_new_tokens}")
+        if decode is not None and not isinstance(decode, DecodeSpec):
+            raise ValueError(
+                f"decode must be a serve.decoding.DecodeSpec, got "
+                f"{type(decode).__name__}")
+        if decode == DecodeSpec():
+            # an explicit all-defaults spec IS the greedy path: drop it
+            # so every downstream key-absent / byte-identity contract
+            # holds trivially (the inert-defaults lint's runtime half)
+            decode = None
+        if decode_step0 < 0:
+            raise ValueError(
+                f"decode_step0 must be >= 0, got {decode_step0}")
+        if stream and decode is not None and decode.branches > 1:
+            raise ValueError(
+                "stream=True requires a single branch (best_of/n == 1):"
+                " n-best ranking needs every full stream before it can "
+                "pick a winner")
         req = Request(
             prompt=prompt, max_new_tokens=int(max_new_tokens),
             request_id=request_id or f"req-{next(_ids)}",
@@ -293,7 +349,10 @@ class Scheduler:
             t_origin=float(t_origin) if t_origin else 0.0,
             t_first_origin=float(t_first_origin),
             fp_seed=str(fp_seed),
+            decode=decode, decode_step0=int(decode_step0),
         )
+        if stream:
+            req.stream = TokenStream(req.request_id)
         # fleet legs arrive with their context minted at Fleet.submit;
         # a bare engine/scheduler mints here (same choke point role)
         req.trace = (trace_ctx if trace_ctx is not None or resubmit
@@ -332,7 +391,10 @@ class Scheduler:
         """One admission's KV reservation: through the prefix cache
         when attached (shared-prefix blocks reserved by reference, the
         match stored on the request for the engine's restore pass),
-        bare ``pool.reserve`` otherwise. False = backpressure."""
+        bare ``pool.reserve`` otherwise. A branched (best-of-n) head
+        then COW-forks one tail per extra branch off the primary —
+        all-or-nothing: a tail that doesn't fit rolls the whole
+        admission back. False = backpressure."""
         if self.prefix_cache is not None:
             match = self.prefix_cache.admit(
                 head.request_id, head.prompt, head.total_tokens,
@@ -340,8 +402,44 @@ class Scheduler:
             if match is None:
                 return False
             head.prefix_match = match
-            return True
-        return self.pool.reserve(head.request_id, head.total_tokens)
+        elif not self.pool.reserve(head.request_id, head.total_tokens):
+            return False
+        sids = branch_seq_ids(head)
+        for k, sid in enumerate(sids):
+            if k == 0:
+                continue
+            # THE pool.fork call site (lint-pinned): branches share the
+            # primary's full prompt blocks by refcount, so n branches
+            # cost one prompt block set + n tails
+            fork = lambda: self.pool.fork(
+                head.request_id, sid, head.total_tokens,
+                shared_tokens=len(head.prompt))
+            if fork():
+                continue
+            if self.prefix_cache is not None:
+                # the tail allocates straight off the free list, which
+                # may be parked in the cached ring; without the same
+                # LRU reclaim admit() gives the primary, a branched
+                # head wedges the whole queue once donations fill the
+                # pool (nothing running -> nothing ever frees)
+                short = (self.pool.blocks_for(head.total_tokens)
+                         - len(head.prompt) // self.pool.block_size
+                         - self.pool.free_blocks)
+                if short > 0 and self.prefix_cache.make_room(short) \
+                        and fork():
+                    continue
+            for forked in sids[1:k]:
+                self.pool.free(forked)
+            if self.prefix_cache is not None:
+                # unpin the COW tail the admit pinned, then drop the
+                # primary without donating anything new
+                self.prefix_cache.finish_restore(head.prefix_match)
+                head.prefix_match = None
+                self.prefix_cache.abandon(head.request_id)
+            else:
+                self.pool.free(head.request_id)
+            return False
+        return True
 
     def next_admissions(self, free_slots: int) -> list[Request]:
         """Pop eligible requests for this round: deficit round-robin
@@ -371,6 +469,9 @@ class Scheduler:
                     self._queued -= 1
                     self._transition(head, REJECTED, reason="deadline")
                     continue
+                if head.branches > free_slots:
+                    break  # n-way needs n rows NOW — no bypass, same
+                    # anti-starvation rule as a failed reservation
                 if not self._reserve_locked(head):
                     break  # no bypass: wait for blocks to free
                 q.popleft()
@@ -379,7 +480,7 @@ class Scheduler:
                 head.round_admitted = self.round
                 self._transition(head, RUNNING)
                 admitted.append(head)
-                free_slots -= 1
+                free_slots -= head.branches
                 self._rr.rotate(-1)  # this tenant's turn is spent
             self._g_queue.set(self._queued)
         return admitted
@@ -403,13 +504,43 @@ class Scheduler:
         with self._lock:
             self._transition(req, DONE)
 
+    def release_branch(self, req: Request, seq_id: str) -> None:
+        """Per-branch retirement for a best-of-n request: drop ONE
+        branch's reservation the moment it hits EOS/budget while its
+        siblings keep decoding (refcounted prompt blocks stay live
+        until the last sharer drops). Branched releases never donate
+        to the prefix radix — n near-duplicate chains would churn the
+        index for no reuse win — but the primary goes through
+        ``abandon`` so radix-owned prompt blocks it borrowed stay with
+        their chains."""
+        if self.prefix_cache is not None and seq_id == req.request_id:
+            self.prefix_cache.abandon(seq_id)
+        else:
+            self.pool.free(seq_id)
+
+    def finish_branches(self, req: Request, tokens, n_best: list,
+                        logprob: float) -> None:
+        """Terminal transition for a branched request: every branch's
+        reservation was already dropped via :meth:`release_branch`;
+        the engine hands over the ranked results (``tokens`` = the
+        winner's stream)."""
+        req.tokens = np.asarray(tokens, np.int32)
+        req.n_best = n_best
+        req.logprob = float(logprob)
+        with self._lock:
+            self._transition(req, DONE)
+
     def fail(self, req: Request, reason: str) -> None:
         """Evict a running sequence (engine error path). Blocks are
-        freed; the client sees FAILED, not a hang."""
-        if self.prefix_cache is not None:
-            self.prefix_cache.abandon(req.request_id)
-        else:
-            self.pool.free(req.request_id)
+        freed — every branch's, for a best-of-n request (freeing an
+        unknown seq id is a benign no-op, so branches that already
+        retired don't double-free); the client sees FAILED, not a
+        hang."""
+        for sid in branch_seq_ids(req):
+            if self.prefix_cache is not None and sid == req.request_id:
+                self.prefix_cache.abandon(sid)
+            else:
+                self.pool.free(sid)
         with self._lock:
             req.reject_reason = reason
             self._transition(req, FAILED)

@@ -121,6 +121,18 @@ class InferenceServer:
                                f"finish in {timeout}s")
         return req
 
+    def stream(self, prompt, max_new_tokens: int, **kw):
+        """Submit with incremental streaming and return the request's
+        :class:`serve.decoding.TokenStream`. The first chunk arriving
+        is the client-visible TTFT event; iteration ends when the
+        engine retires (or rejects/fails) the request — terminal
+        transitions close the stream, so a rejected request yields an
+        empty terminated stream, never a hang. The Request rides on
+        ``stream.request`` for state/record inspection."""
+        req = self.submit(prompt, max_new_tokens, stream=True, **kw)
+        req.stream.request = req
+        return req.stream
+
 
 def install_sigterm_drain() -> bool:
     """Arm SIGTERM-as-drain-notice (main thread only). The serve loop

@@ -46,8 +46,16 @@ Shape kinds:
   system prompts: each arrival's prompt starts with one of the
   tenant's ``n_prefixes`` (default 1) fixed seeded prefixes of
   ``prefix_len`` tokens (picked uniformly per arrival), followed by a
-  unique suffix — the load shape prefix caching is built for. Keys:
-  ``name`` (required), ``weight``, dist keys, prefix keys.
+  unique suffix — the load shape prefix caching is built for.
+  Prism decode-policy keys (serve/decoding.py): ``temperature=`` /
+  ``n=`` mark a tenant's requests sampled / best-of-n (each record
+  then carries an arithmetic per-arrival ``decode_seed``, so replays
+  reproduce the same sampled streams byte-for-byte); ``stream=p``
+  flags each arrival streaming with probability ``p`` (one extra
+  seeded draw, ONLY for tenants that set the key — the ``prefix_len``
+  byte-identity precedent: older specs generate byte-identical
+  traces). Keys: ``name`` (required), ``weight``, dist keys, prefix
+  keys, decode keys.
 
 Arrivals are a non-homogeneous Poisson process sampled by thinning
 (Lewis-Shedler) from a single ``random.Random(seed)`` stream — exact
@@ -80,11 +88,12 @@ TRAFFIC_KINDS = ("steady", "diurnal", "flash", "tenant")
 # typed key tables (the chaos parse_spec contract: every key is named
 # here or the spec fails loudly)
 _INT_KEYS = ("prompt_min", "prompt_max", "out_min", "out_max",
-             "prefix_len", "n_prefixes")
+             "prefix_len", "n_prefixes", "n")
 _FLOAT_KEYS = ("rps", "duration_s", "amplitude", "period_s", "phase",
                "at_s", "peak", "ramp_s", "hold_s", "weight",
                "prompt_med", "prompt_sigma", "prompt_a",
-               "out_med", "out_sigma", "out_a")
+               "out_med", "out_sigma", "out_a",
+               "temperature", "stream")
 _STR_KEYS = ("name", "prompt", "out")
 
 _DISTS = ("lognormal", "zipf")
@@ -131,6 +140,18 @@ def _validate(shape: Shape) -> None:
         raise ValueError(
             "traffic tenant: n_prefixes without prefix_len is "
             "meaningless (set prefix_len > 0)")
+    if a.get("temperature", 0.0) < 0:
+        raise ValueError("traffic tenant: temperature must be >= 0")
+    if a.get("n", 1) < 1:
+        raise ValueError("traffic tenant: n must be >= 1")
+    if not 0.0 <= a.get("stream", 0.0) <= 1.0:
+        raise ValueError("traffic tenant: stream must be a "
+                         "probability in [0, 1]")
+    if "stream" in a and a.get("n", 1) > 1:
+        raise ValueError(
+            "traffic tenant: stream= with n > 1 is invalid — n-best "
+            "ranking needs every full stream before picking a winner "
+            "(the scheduler rejects the combination too)")
     for side in ("prompt", "out"):
         dist = a.get(side, "lognormal")
         if dist not in _DISTS:
@@ -369,6 +390,23 @@ def generate_trace(spec: TrafficSpec, *, seed: int = 0,
             # the prompt must extend past its prefix by >= 1 token
             # (a cached prefix still needs a suffix to prefill)
             rec["prompt_len"] = max(rec["prompt_len"], prefix_len + 1)
+        # Prism decode-policy keys: present ONLY when the tenant set
+        # them, so specs without them generate byte-identical traces.
+        # decode_seed is arithmetic (prompt_seed's scheme, different
+        # multiplier) — no rng draw, so it perturbs nothing.
+        temp = float(ten.args.get("temperature", 0.0))
+        n_best = int(ten.args.get("n", 1))
+        if temp > 0.0 or n_best > 1:
+            if temp > 0.0:
+                rec["temperature"] = temp
+            if n_best > 1:
+                rec["n"] = n_best
+            rec["decode_seed"] = (seed * 1_000_081 + idx) & 0x7FFFFFFF
+        if "stream" in ten.args:
+            # the ONE extra rng draw, only for tenants using stream=
+            # (the prefix_len byte-identity precedent)
+            if rng.random() < float(ten.args["stream"]):
+                rec["stream"] = True
         trace.append(rec)
     return trace
 
@@ -439,7 +477,15 @@ def replay_trace(trace: list[dict], submit: Callable,
     controller a deterministic clock on the replay thread (Helm's
     ``FleetAutoscaler.step`` rides it in ``bench.py --autoscale``;
     workers must never drive control themselves). Returns the submit
-    handles in trace order."""
+    handles in trace order.
+
+    Records carrying Prism decode keys (``temperature``/``n`` +
+    ``decode_seed``, or ``stream``) submit with the matching
+    ``decode=DecodeSpec(...)`` / ``stream=True`` kwargs; records
+    without them call the plain two-argument form, so existing
+    ``lambda p, n: ...`` adapters replay older traces unchanged."""
+    from pytorch_distributed_nn_tpu.serve.decoding import DecodeSpec
+
     handles = []
     t0 = time.monotonic()
     for rec in trace:
@@ -449,6 +495,18 @@ def replay_trace(trace: list[dict], submit: Callable,
                 time.sleep(wait)
         if on_tick is not None:
             on_tick(float(rec["t"]))
-        handles.append(submit(prompt_tokens(rec, vocab_size),
-                              int(rec["max_new"])))
+        kw = {}
+        if "temperature" in rec or "n" in rec:
+            kw["decode"] = DecodeSpec(
+                temperature=float(rec.get("temperature", 0.0)),
+                n=int(rec.get("n", 1)),
+                seed=int(rec.get("decode_seed", 0)))
+        if rec.get("stream"):
+            kw["stream"] = True
+        if kw:
+            handles.append(submit(prompt_tokens(rec, vocab_size),
+                                  int(rec["max_new"]), **kw))
+        else:
+            handles.append(submit(prompt_tokens(rec, vocab_size),
+                                  int(rec["max_new"])))
     return handles

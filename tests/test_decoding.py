@@ -1,0 +1,534 @@
+"""Prism: seeded sampling, n-best COW decoding, token streaming
+(ISSUE 20 tentpole).
+
+Covers the spec's loud-validation/wire contract, the inert-defaults
+byte-identity golden (default requests == pre-Prism bytes: tokens,
+JSONL key set, fingerprint chains — streaming off AND on, any
+chunking), seeded end-to-end determinism (independent of batch
+composition; thread fleet, process-fleet backend, and the disagg
+prefill→decode handoff all byte-identical), the COW block accounting
+of n-way branch decoding (one prompt set + n tails, refcounts, no
+leak on fork backpressure), per-branch EOS retirement, and the
+streaming funnel (chunk boundaries are presentation only).
+"""
+
+import numpy as np
+import pytest
+
+from pytorch_distributed_nn_tpu import obs
+from pytorch_distributed_nn_tpu.inference.generate import generate
+from pytorch_distributed_nn_tpu.obs import audit, flight
+from pytorch_distributed_nn_tpu.runtime import chaos
+from pytorch_distributed_nn_tpu.serve import (
+    DecodeSpec,
+    Fleet,
+    InferenceServer,
+    KVPool,
+    Scheduler,
+    ServingEngine,
+    TokenStream,
+)
+from pytorch_distributed_nn_tpu.serve.scheduler import branch_seq_ids
+
+VOCAB = 97
+
+
+@pytest.fixture(autouse=True)
+def _fresh(monkeypatch):
+    """Disarmed chaos/audit, fresh flight ring + registry per test."""
+    monkeypatch.delenv(chaos.ENV_CHAOS, raising=False)
+    monkeypatch.delenv(chaos.ENV_CHAOS_SEED, raising=False)
+    monkeypatch.delenv(audit.ENV_AUDIT, raising=False)
+    chaos.reset()
+    audit.reset()
+    flight.reset_recorder(enabled=True)
+    obs.reset_registry()
+    yield
+    chaos.reset()
+    audit.reset()
+
+
+def _prompts(lengths, seed=0):
+    rng = np.random.default_rng(seed)
+    return [rng.integers(1, VOCAB, size=(n,)).astype(np.int32)
+            for n in lengths]
+
+
+def _engine(model, params, **kw):
+    kw.setdefault("max_slots", 4)
+    kw.setdefault("max_seq_len", 64)
+    kw.setdefault("block_size", 8)
+    kw.setdefault("max_queue", 16)
+    return ServingEngine(model, params, **kw)
+
+
+def _run_one(model, params, prompt, n_new, **kw):
+    eng = _engine(model, params)
+    req = eng.submit(prompt, n_new, **kw)
+    eng.run_until_idle()
+    assert req.state == "done", (req.state, req.reject_reason)
+    return req, eng
+
+
+# ---------------------------------------------------------------------------
+# DecodeSpec: loud validation + wire discipline (no model)
+# ---------------------------------------------------------------------------
+
+def test_spec_defaults_properties_and_validation():
+    d = DecodeSpec()
+    assert not d.sampled and d.branches == 1
+    assert DecodeSpec(temperature=0.5).sampled
+    assert DecodeSpec(best_of=3).branches == 3
+    assert DecodeSpec(n=2).branches == 2
+    assert DecodeSpec(best_of=4, n=2).branches == 4
+    # greedy single-branch stays on the fast path whatever the masks
+    # say (argmax survives any top-k/top-p filter)
+    assert not DecodeSpec(top_k=5, top_p=0.9).sampled
+    for bad in (dict(temperature=-0.1), dict(temperature=float("nan")),
+                dict(top_k=-1), dict(top_p=1.5), dict(top_p=-0.1),
+                dict(n=0), dict(best_of=-1), dict(best_of=2, n=3),
+                dict(seed=-1), dict(seed=2 ** 31)):
+        with pytest.raises(ValueError):
+            DecodeSpec(**bad)
+
+
+def test_spec_wire_roundtrip_key_absent_and_loud():
+    assert DecodeSpec().to_wire() == {}  # default spec adds no bytes
+    spec = DecodeSpec(temperature=0.8, top_p=0.9, best_of=3, seed=7)
+    wire = spec.to_wire()
+    assert "top_k" not in wire and "n" not in wire  # defaults absent
+    assert DecodeSpec.from_wire(wire) == spec
+    with pytest.raises(ValueError, match="unknown"):
+        DecodeSpec.from_wire({"temperature": 0.5, "beams": 4})
+
+
+def test_token_stream_close_idempotent_and_one_shot():
+    ts = TokenStream("r1")
+    ts._feed([1, 2])
+    ts._feed([3])
+    ts.close()
+    ts.close()  # idempotent: no double sentinel
+    assert ts.chunks == 2
+    np.testing.assert_array_equal(ts.tokens(), [1, 2, 3])
+
+
+# ---------------------------------------------------------------------------
+# Inert defaults: the byte-identity golden
+# ---------------------------------------------------------------------------
+
+def test_default_spec_requests_are_byte_identical_to_unset(tiny_llama):
+    """submit() with decode=DecodeSpec() (or an explicitly greedy
+    spec), with streaming off AND on, produces byte-identical tokens,
+    the same JSONL key set (no Prism keys beyond stream_chunks), and
+    the same Lighthouse fingerprint as a plain pre-Prism submit."""
+    model, params = tiny_llama
+    audit.maybe_init("sample=0:shadow=0")
+    (p,) = _prompts([9], seed=2)
+    runs = {}
+    for name, kw in [
+        ("unset", {}),
+        ("default", dict(decode=DecodeSpec())),
+        ("explicit", dict(decode=DecodeSpec(temperature=0.0, top_k=0,
+                                            top_p=0.0, n=1))),
+        ("streamed", dict(stream=True)),
+    ]:
+        req, eng = _run_one(model, params, p, 6,
+                            request_id=f"golden-{name}", **kw)
+        rec = eng.completed[-1]
+        fp = audit.fingerprint_of(req.request_id)
+        runs[name] = (np.asarray(req.tokens), rec, fp)
+        if kw.get("stream"):
+            np.testing.assert_array_equal(req.stream.tokens(),
+                                          req.tokens)
+    base_toks, base_rec, base_fp = runs["unset"]
+    assert base_fp is not None
+    assert "decode" not in base_rec and "branches" not in base_rec
+    assert "stream_chunks" not in base_rec
+    for name in ("default", "explicit", "streamed"):
+        toks, rec, fp = runs[name]
+        np.testing.assert_array_equal(toks, base_toks)
+        assert fp == base_fp, name  # chunking/specs never move the fp
+        extra = set(rec) - set(base_rec)
+        # the ONLY streaming-visible record key is stream_chunks; a
+        # normalized default spec adds no key at all
+        assert extra == ({"stream_chunks"} if name == "streamed"
+                         else set()), (name, extra)
+    # and the whole thing matches the sequential oracle
+    ref = np.asarray(generate(model, params, p[None], 6))
+    np.testing.assert_array_equal(base_toks, ref[0, len(p):])
+
+
+def test_mixed_batch_keeps_greedy_rows_bit_identical(tiny_llama):
+    """A greedy request sharing the batch with sampled strangers (the
+    sampled jit path) still emits exactly its solo sequential
+    tokens."""
+    model, params = tiny_llama
+    pg, ps1, ps2 = _prompts([7, 5, 11], seed=4)
+    eng = _engine(model, params)
+    rg = eng.submit(pg, 6)
+    rs1 = eng.submit(ps1, 6, decode=DecodeSpec(temperature=1.0, seed=1))
+    rs2 = eng.submit(ps2, 6, decode=DecodeSpec(temperature=0.9,
+                                               top_p=0.8, seed=2))
+    eng.run_until_idle()
+    assert rg.state == rs1.state == rs2.state == "done"
+    ref = np.asarray(generate(model, params, pg[None], 6))
+    np.testing.assert_array_equal(rg.tokens, ref[0, len(pg):])
+    assert (np.asarray(rs1.tokens) < VOCAB).all()
+    assert (np.asarray(rs2.tokens) < VOCAB).all()
+
+
+# ---------------------------------------------------------------------------
+# Seeded determinism
+# ---------------------------------------------------------------------------
+
+def test_same_seed_twice_is_byte_identical(tiny_llama):
+    model, params = tiny_llama
+    (p,) = _prompts([8], seed=5)
+    spec = DecodeSpec(temperature=0.9, top_k=20, top_p=0.95, seed=11)
+    r1, _ = _run_one(model, params, p, 8, decode=spec)
+    r2, _ = _run_one(model, params, p, 8, decode=spec)
+    np.testing.assert_array_equal(r1.tokens, r2.tokens)
+    # a different seed moves at least one token (overwhelmingly)
+    r3, _ = _run_one(model, params, p, 8,
+                     decode=DecodeSpec(temperature=0.9, top_k=20,
+                                       top_p=0.95, seed=12))
+    assert not np.array_equal(r1.tokens, r3.tokens)
+
+
+def test_sampling_independent_of_batch_composition(tiny_llama):
+    """The determinism contract's hard half: keys derive from
+    (seed, branch, step) only, so the same sampled request emits the
+    same bytes whether it decodes alone or packed among strangers."""
+    model, params = tiny_llama
+    p, q1, q2, q3 = _prompts([8, 5, 13, 6], seed=6)
+    spec = DecodeSpec(temperature=1.1, top_p=0.9, seed=21)
+    solo, _ = _run_one(model, params, p, 8, decode=spec)
+    eng = _engine(model, params)
+    crowd = eng.submit(p, 8, decode=spec)
+    for q in (q1, q2, q3):
+        eng.submit(q, 7)
+    eng.run_until_idle()
+    assert crowd.state == "done"
+    np.testing.assert_array_equal(crowd.tokens, solo.tokens)
+
+
+# ---------------------------------------------------------------------------
+# n-best COW branches
+# ---------------------------------------------------------------------------
+
+def test_n_best_branches_share_prompt_blocks(tiny_llama):
+    """Mid-flight, n live branches hold ONE refcounted set of full
+    prompt blocks plus n private tails — the COW acceptance
+    criterion — and retirement returns every block."""
+    model, params = tiny_llama
+    prompt = np.arange(1, 17, dtype=np.int32)  # 2 full 8-token blocks
+    eng = _engine(model, params, prefix_cache=False)
+    pool = eng.scheduler.pool
+    free0 = pool.free_blocks
+    spec = DecodeSpec(temperature=0.8, best_of=3, n=2, seed=9)
+    req = eng.submit(prompt, 8, request_id="cow", decode=spec)
+    eng.step()  # admit + prefill + fork: branches live now
+    sids = branch_seq_ids(req)
+    assert sids == ["cow", "cow#b1", "cow#b2"]
+    tables = [pool.block_table(s) for s in sids]
+    held = {b for t in tables for b in t}
+    naive = sum(len(t) for t in tables)
+    prompt_blocks = set(tables[0][:2])  # the 2 full prompt blocks
+    # every branch shares exactly the prompt blocks; tails are private
+    for t in tables[1:]:
+        assert set(t[:2]) == prompt_blocks
+        assert not (set(t[2:]) & set(tables[0][2:]))
+    # one shared prompt set + 3 private tails — NOT 3 full copies
+    assert len(held) == 2 + sum(len(t) - 2 for t in tables)
+    assert len(held) < naive
+    for b in prompt_blocks:
+        assert pool.refcount(b) == 3
+    reg = obs.get_registry()
+    assert reg.counter("serve_branches_total").value() == 2
+    eng.run_until_idle()
+    assert req.state == "done"
+    # ranking: top n of best_of, cumulative model logprob, descending
+    assert len(req.n_best) == 2
+    lps = [b["logprob"] for b in req.n_best]
+    assert lps == sorted(lps, reverse=True)
+    np.testing.assert_array_equal(req.tokens, req.n_best[0]["tokens"])
+    assert req.logprob == pytest.approx(lps[0])
+    rec = eng.completed[-1]
+    assert rec["branches"] == 3 and rec["decode"]["best_of"] == 3
+    # no leak: every block (shared and tails) came back
+    assert pool.free_blocks == free0
+    assert pool.live_sequences == 0
+
+
+def test_n_best_deterministic_and_winner_beats_losers(tiny_llama):
+    model, params = tiny_llama
+    (p,) = _prompts([10], seed=8)
+    spec = DecodeSpec(temperature=1.0, best_of=3, n=3, seed=17)
+    r1, _ = _run_one(model, params, p, 6, decode=spec)
+    r2, _ = _run_one(model, params, p, 6, decode=spec)
+    assert [b["tokens"] for b in r1.n_best] == \
+        [b["tokens"] for b in r2.n_best]
+    assert [b["branch"] for b in r1.n_best] == \
+        [b["branch"] for b in r2.n_best]
+    assert r1.n_best[0]["logprob"] >= r1.n_best[-1]["logprob"]
+
+
+def test_fork_backpressure_is_all_or_nothing_no_leak():
+    """A branched head whose tails don't fit rolls the WHOLE admission
+    back (no bypass, no leaked blocks) and admits cleanly once
+    capacity frees up."""
+    sched = Scheduler(KVPool(num_blocks=4, block_size=4), max_queue=8)
+    filler = sched.submit([1, 2], 2)  # 1 block
+    assert sched.next_admissions(4) == [filler]
+    assert sched.pool.free_blocks == 3
+    spec = DecodeSpec(temperature=1.0, best_of=2, seed=3)
+    b = sched.submit(np.arange(1, 9, dtype=np.int32), 4, decode=spec)
+    # primary needs 3 blocks (fits), the tail needs 1 more (doesn't):
+    # the reservation must roll back completely
+    assert sched.next_admissions(4) == []
+    assert b.state == "queued"
+    assert sched.pool.free_blocks == 3
+    assert sched.pool.live_sequences == 1  # just the filler
+    sched.retire(filler, np.asarray([5, 6], np.int32))
+    admitted = sched.next_admissions(4)
+    assert admitted == [b]
+    # 3 primary blocks + 1 forked tail, 2 prompt blocks shared
+    assert sched.pool.free_blocks == 0
+    t0 = sched.pool.block_table(b.request_id)
+    t1 = sched.pool.block_table(branch_seq_ids(b)[1])
+    assert t1[:2] == t0[:2] and t1[2] != t0[2]
+
+
+def test_branch_fork_reclaims_cached_blocks_not_wedge(tiny_llama):
+    """A branched head must not wedge an IDLE engine whose free list
+    is parked in the prefix-cache ring. The primary's reservation goes
+    through ``admit()`` (which evicts LRU on shortfall) but the tails
+    fork straight off the pool — without the same reclaim, donations
+    from earlier traffic permanently starve every later best-of-n
+    request (nothing is running, so nothing ever frees; regression:
+    traffic replay against a default engine wedged with active=0)."""
+    model, params = tiny_llama
+    eng = _engine(model, params)  # 32-block pool, prefix cache on
+    pool = eng.scheduler.pool
+    # park most of the pool in the cached ring: 9 distinct retired
+    # singles donate 3 full blocks each (27 cached, 5 free)
+    for i, p in enumerate(_prompts([24] * 9, seed=21)):
+        eng.submit(p, 8, request_id=f"fill-{i}")
+    eng.run_until_idle()
+    assert pool.free_blocks <= 8
+    (bp,) = _prompts([8], seed=22)
+    spec = DecodeSpec(temperature=1.0, best_of=3, seed=5)
+    req = eng.submit(bp, 16, request_id="branchy", decode=spec)
+    # primary fits the free list; the second tail does not — the fork
+    # path must shed cached blocks instead of rolling back forever
+    for _ in range(300):
+        eng.step()
+        if req.state == "done":
+            break
+    assert req.state == "done"
+    assert len(req.tokens) == 16
+    # everything the branches held went back: only cached blocks and
+    # free list remain, and they partition the pool exactly
+    assert pool.live_sequences == 0
+    assert pool.free_blocks + len(pool.cached_lru()) == pool.num_blocks
+
+
+def test_branch_count_validated_against_slots(tiny_llama):
+    model, params = tiny_llama
+    eng = _engine(model, params, max_slots=2)
+    with pytest.raises(ValueError, match="branches"):
+        eng.submit([1, 2, 3], 4, decode=DecodeSpec(best_of=3))
+    with pytest.raises(ValueError, match="DecodeSpec"):
+        eng.submit([1, 2, 3], 4, decode={"temperature": 1.0})
+
+
+# ---------------------------------------------------------------------------
+# Streaming
+# ---------------------------------------------------------------------------
+
+def test_streaming_chunks_concatenate_and_chunking_is_presentation(
+        tiny_llama):
+    """chunk=1 vs chunk=3 vs streaming-off: same tokens, same
+    fingerprint, same record (minus stream_chunks) — the chunk
+    boundary changes only how the stream is cut. The first chunk is
+    the prefill token (the client-visible TTFT event)."""
+    model, params = tiny_llama
+    audit.maybe_init("sample=0:shadow=0")
+    (p,) = _prompts([9], seed=12)
+    plain, _ = _run_one(model, params, p, 6, request_id="s-off")
+    fp0 = audit.fingerprint_of("s-off")
+
+    eng1 = _engine(model, params)  # stream_chunk_tokens=1 default
+    r1 = eng1.submit(p, 6, request_id="s-1", stream=True)
+    eng1.run_until_idle()
+    chunks1 = list(r1.stream)
+    assert len(chunks1) == 6  # every token its own chunk
+    assert len(chunks1[0]) == 1  # TTFT chunk: the prefill token
+
+    eng3 = _engine(model, params, stream_chunk_tokens=3)
+    r3 = eng3.submit(p, 6, request_id="s-3", stream=True)
+    eng3.run_until_idle()
+    chunks3 = list(r3.stream)
+    assert [len(c) for c in chunks3] == [1, 3, 2]  # prefill, 3, flush
+
+    for r, chunks in ((r1, chunks1), (r3, chunks3)):
+        np.testing.assert_array_equal(np.concatenate(chunks),
+                                      plain.tokens)
+        np.testing.assert_array_equal(r.tokens, plain.tokens)
+        assert audit.fingerprint_of(r.request_id) == fp0
+    assert eng1.completed[-1]["stream_chunks"] == 6
+    assert eng3.completed[-1]["stream_chunks"] == 3
+    reg = obs.get_registry()
+    assert reg.counter("serve_stream_chunks_total").value() == 9
+
+
+def test_stream_of_sampled_request_and_rejection_closes(tiny_llama):
+    model, params = tiny_llama
+    (p,) = _prompts([7], seed=13)
+    spec = DecodeSpec(temperature=0.9, seed=31)
+    ref, _ = _run_one(model, params, p, 6, decode=spec)
+    eng = _engine(model, params)
+    r = eng.submit(p, 6, decode=spec, stream=True)
+    eng.run_until_idle()
+    np.testing.assert_array_equal(r.stream.tokens(), ref.tokens)
+    # a rejected request's stream terminates instead of hanging
+    eng2 = _engine(model, params, max_queue=1)
+    eng2.scheduler.drain()
+    r2 = eng2.submit(p, 4, stream=True)
+    assert r2.state == "rejected"
+    assert r2.stream.tokens().size == 0
+
+
+def test_stream_with_branches_rejected_loudly(tiny_llama):
+    model, params = tiny_llama
+    eng = _engine(model, params)
+    with pytest.raises(ValueError, match="stream"):
+        eng.submit([1, 2, 3], 4, stream=True,
+                   decode=DecodeSpec(temperature=1.0, best_of=2))
+
+
+def test_server_stream_front_end(tiny_llama):
+    model, params = tiny_llama
+    (p,) = _prompts([8], seed=14)
+    srv = InferenceServer(_engine(model, params)).start()
+    try:
+        stream = srv.stream(p, 5)
+        got = [c for c in stream]
+    finally:
+        srv.stop()
+    req = stream.request
+    assert req.state == "done"
+    np.testing.assert_array_equal(np.concatenate(got), req.tokens)
+
+
+# ---------------------------------------------------------------------------
+# Per-branch EOS retirement
+# ---------------------------------------------------------------------------
+
+def test_branches_retire_independently_on_eos(tiny_llama):
+    """With an eos token armed, each branch retires at its OWN
+    eos/budget: short branches free their tails early (slots rejoin
+    the pool) and the surviving ranking still covers every branch."""
+    model, params = tiny_llama
+    (p,) = _prompts([8], seed=15)
+    budget = 10
+    # scan a few seeds for one where branches finish at different
+    # lengths under a hot temperature — deterministic once found
+    for seed in range(40):
+        flight.reset_recorder(enabled=True)
+        eng = _engine(model, params, prefix_cache=False, eos_token=7)
+        spec = DecodeSpec(temperature=1.5, best_of=3, n=3, seed=seed)
+        req = eng.submit(p, budget, decode=spec)
+        eng.run_until_idle()
+        assert req.state == "done"
+        lens = sorted(len(b["tokens"]) for b in req.n_best)
+        assert eng.scheduler.pool.live_sequences == 0  # no leak ever
+        for b in req.n_best:
+            toks = b["tokens"]
+            assert len(toks) == budget or toks[-1] == 7
+        if lens[0] < lens[-1]:
+            evs = [e for e in flight.get_recorder().snapshot()
+                   if e["kind"] == "serve"
+                   and e["op"] == "retire_branch"]
+            assert len(evs) == 3
+            return
+    pytest.fail("no seed produced ragged branch retirement")
+
+
+# ---------------------------------------------------------------------------
+# Fleet / process-backend / disagg determinism goldens
+# ---------------------------------------------------------------------------
+
+def test_thread_fleet_matches_single_engine_bytes(tiny_llama):
+    model, params = tiny_llama
+    p1, p2 = _prompts([8, 6], seed=16)
+    s1 = DecodeSpec(temperature=0.9, top_p=0.9, seed=41)
+    s2 = DecodeSpec(temperature=1.2, best_of=2, n=2, seed=42)
+    ref1, _ = _run_one(model, params, p1, 6, decode=s1)
+    ref2, _ = _run_one(model, params, p2, 6, decode=s2)
+    fleet = Fleet(model, params, replicas=2, max_slots=4,
+                  max_seq_len=64, block_size=8)
+    t1 = fleet.submit(p1, 6, decode=s1)
+    t2 = fleet.submit(p2, 6, decode=s2)
+    fleet.run_until_idle()
+    assert t1.ok and t2.ok
+    np.testing.assert_array_equal(t1.tokens, ref1.tokens)
+    np.testing.assert_array_equal(t2.tokens, ref2.tokens)
+    assert [b["tokens"] for b in t1.n_best or []] == []
+    assert [b["tokens"] for b in t2.n_best] == \
+        [b["tokens"] for b in ref2.n_best]
+
+
+def test_process_backend_matches_single_engine_bytes(tiny_llama):
+    """The process-fleet worker path, in-process: the wire dict a
+    coordinator dispatches rebuilds the spec and the backend's bytes
+    match the direct engine."""
+    from pytorch_distributed_nn_tpu.serve.fleet_worker import (
+        _EngineBackend,
+    )
+    model, params = tiny_llama
+    (p,) = _prompts([8], seed=17)
+    spec = DecodeSpec(temperature=0.9, top_p=0.85, seed=51)
+    ref, _ = _run_one(model, params, p, 6, decode=spec)
+    be = _EngineBackend(max_slots=4, max_seq_len=64, block_size=8,
+                        max_queue=16, tag="w0", model=model,
+                        params=params)
+    be.admit(dict(request_id="wire-1", prompt=[int(x) for x in p],
+                  max_new_tokens=6, decode=spec.to_wire()))
+    done = []
+    for _ in range(200):
+        _, completed = be.step()
+        done.extend(completed)
+        if done:
+            break
+    (rec, toks, status), = done
+    assert status == "done"
+    np.testing.assert_array_equal(np.asarray(toks, np.int32),
+                                  ref.tokens)
+
+
+def test_disagg_handoff_preserves_seeded_stream_and_fp(tiny_llama):
+    """A sampled n=1 request split across prefill/decode pools emits
+    the same bytes as the unified engine (the decode leg resumes at
+    step0 = len(prefix)), and its fingerprint chain — seeded with the
+    prefill leg's prefix (fp_seed) — ends at exactly the single-leg
+    fingerprint. Branched requests skip the split and still match."""
+    model, params = tiny_llama
+    audit.maybe_init("sample=0:shadow=0")
+    p1, p2 = _prompts([34, 8], seed=18)
+    s1 = DecodeSpec(temperature=0.8, top_p=0.9, seed=61)
+    s2 = DecodeSpec(temperature=1.0, best_of=2, n=1, seed=62)
+    ref1, _ = _run_one(model, params, p1, 6, decode=s1)
+    ref2, _ = _run_one(model, params, p2, 6, decode=s2)
+    fleet = Fleet(model, params, prefill=1, decode=1, max_slots=4,
+                  max_seq_len=64, block_size=8, max_queue=16)
+    t1 = fleet.submit(p1, 6, decode=s1)
+    t2 = fleet.submit(p2, 6, decode=s2)
+    fleet.run_until_idle()
+    assert t1.ok and t2.ok
+    np.testing.assert_array_equal(t1.tokens, ref1.tokens)
+    np.testing.assert_array_equal(t2.tokens, ref2.tokens)
+    # fp_seed continuity: the handed-off leg's chain ends where one
+    # uninterrupted leg would
+    assert audit.fingerprint_of(t1.request_id) == \
+        audit.chain("", t1.tokens)

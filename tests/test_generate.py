@@ -130,6 +130,41 @@ def test_top_p_restricts_to_nucleus():
     assert seen == {0, 1, 2, 3}
 
 
+def test_per_row_temperature_composes_with_top_p():
+    """Regression for the Prism per-request path: a traced (B,)
+    temperature must compose with the static top_p mask at batch
+    granularity — temperature=0 rows take the greedy ``where`` branch
+    bit-identically while sampled rows stay inside the nucleus, under
+    jit (the engine's decode step traces temperature)."""
+    from pytorch_distributed_nn_tpu.inference.generate import _sample
+
+    # rows share one distribution: probs ~ [0.6, 0.3, 0.06, 0.04],
+    # top_p=0.7 keeps {0, 1}; greedy is token 0
+    row = jnp.log(jnp.asarray([0.6, 0.3, 0.06, 0.04]))
+    logits = jnp.stack([row, row, row])
+    temps = jnp.asarray([0.0, 1.0, 0.0], jnp.float32)
+    samp = jax.jit(lambda lg, t, r: _sample(
+        lg, temperature=t, top_k=0, top_p=0.7, rng=r))
+    seen_mid = set()
+    for i in range(64):
+        toks = np.asarray(samp(logits, temps, jax.random.key(i)))
+        # temperature=0 rows are exactly greedy regardless of rng
+        assert toks[0] == 0 and toks[2] == 0
+        seen_mid.add(int(toks[1]))
+    # the sampled row never escapes the nucleus, and does explore it
+    assert seen_mid <= {0, 1} and seen_mid == {0, 1}
+    # one jitted shape serves any temperature vector: flipping which
+    # rows are greedy re-uses the trace (no static temperature arg)
+    toks = np.asarray(samp(logits, jnp.asarray([1.0, 0.0, 1.0],
+                                               jnp.float32),
+                           jax.random.key(3)))
+    assert toks[1] == 0
+    # scalar temperature still works unchanged (the pre-Prism shape)
+    toks = np.asarray(samp(logits,
+                           jnp.float32(0.0), jax.random.key(5)))
+    assert (toks == 0).all()
+
+
 def test_top_p_generate_in_vocab(tiny_llama):
     model, params = tiny_llama
     prompt = jnp.asarray([[5, 17, 42]], jnp.int32)

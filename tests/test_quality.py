@@ -1460,6 +1460,93 @@ def test_audit_fingerprint_fold_is_single_homed_in_engine():
         f"ServingEngine._finish_record, found {callers}")
 
 
+def test_decode_spec_defaults_are_provably_inert():
+    """ISSUE 20 lint: the all-greedy arm of ``_decode_round`` calls the
+    pre-Prism ``_serve_step`` with the EXACT original argument shape —
+    ``(self.model, self.params, self._cache, self._d_last,
+    self._d_depth, self._d_active)`` and nothing else. Default
+    ``DecodeSpec()`` requests ride this arm (the scheduler normalizes
+    an explicit default to None), so greedy outputs, JSONL records, and
+    fingerprint chains stay byte-identical to main; threading a sampled
+    mirror into this call would silently retrace every greedy batch."""
+    eng = (Path(__file__).parent.parent / "pytorch_distributed_nn_tpu"
+           / "serve" / "engine.py")
+    tree = ast.parse(eng.read_text())
+    calls = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and \
+                node.name == "_decode_round":
+            for call in ast.walk(node):
+                if (isinstance(call, ast.Call)
+                        and isinstance(call.func, ast.Name)
+                        and call.func.id == "_serve_step"):
+                    calls.append(call)
+    assert len(calls) == 1, "_decode_round must call _serve_step once"
+    call = calls[0]
+    got = []
+    for arg in call.args:
+        assert (isinstance(arg, ast.Attribute)
+                and isinstance(arg.value, ast.Name)
+                and arg.value.id == "self"), ast.dump(arg)
+        got.append(arg.attr)
+    assert not call.keywords, "greedy _serve_step call grew kwargs"
+    assert got == ["model", "params", "_cache", "_d_last", "_d_depth",
+                   "_d_active"], (
+        f"greedy _serve_step arg shape changed: {got} — the inert-"
+        f"defaults contract (DecodeSpec() == pre-Prism bytes) is off")
+
+
+def test_branch_fork_is_single_homed_in_scheduler():
+    """ISSUE 20 lint: ``<pool>.fork`` — the COW block-sharing call that
+    makes n-way decoding cost one prompt plus n tails — has exactly ONE
+    caller in the package: ``Scheduler._reserve_locked``, where the
+    all-or-nothing branch reservation (and its rollback) lives. A
+    second fork site would split refcount bookkeeping from the
+    backpressure gate and leak blocks on partial admission."""
+    pkg = Path(__file__).parent.parent / "pytorch_distributed_nn_tpu"
+    callers = []
+    for path in sorted(pkg.rglob("*.py")):
+        tree = ast.parse(path.read_text())
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.ClassDef)
+                    or not isinstance(node, ast.FunctionDef)):
+                continue
+            for call in ast.walk(node):
+                if (isinstance(call, ast.Call)
+                        and isinstance(call.func, ast.Attribute)
+                        and call.func.attr == "fork"):
+                    callers.append((path.name, node.name))
+    assert callers == [("scheduler.py", "_reserve_locked")], (
+        f"kv_pool fork must be single-homed in "
+        f"Scheduler._reserve_locked, found {callers}")
+
+
+def test_stream_emit_is_single_homed_in_engine():
+    """ISSUE 20 lint: ``<stream>._feed`` — the push that hands a chunk
+    of tokens to a client's ``TokenStream`` — has exactly ONE caller in
+    the package: ``ServingEngine._emit_chunk``. TTFT first-chunk,
+    chunk-boundary, and final-flush emission all funnel through it, so
+    per-chunk flight events, the ``serve_stream_chunks_total`` counter,
+    and the streamed-tokens bookkeeping (``_Slot.streamed``) cannot
+    drift from what clients actually received."""
+    pkg = Path(__file__).parent.parent / "pytorch_distributed_nn_tpu"
+    callers = []
+    for path in sorted(pkg.rglob("*.py")):
+        tree = ast.parse(path.read_text())
+        for node in ast.walk(tree):
+            if (isinstance(node, ast.ClassDef)
+                    or not isinstance(node, ast.FunctionDef)):
+                continue
+            for call in ast.walk(node):
+                if (isinstance(call, ast.Call)
+                        and isinstance(call.func, ast.Attribute)
+                        and call.func.attr == "_feed"):
+                    callers.append((path.name, node.name))
+    assert callers == [("engine.py", "_emit_chunk")], (
+        f"TokenStream._feed must be single-homed in "
+        f"ServingEngine._emit_chunk, found {callers}")
+
+
 def test_obs_audit_selftest_smoke():
     """The Lighthouse acceptance drill (ISSUE 19 tentpole), run
     exactly as CI would: a chaos ``flip@replica=1`` token corruption

@@ -184,6 +184,107 @@ def test_replay_preserves_order_and_budgets():
     assert [p for p, _ in seen] == [r["prompt_len"] for r in trace]
 
 
+DECODE_SPEC = ("steady@rps=40:duration_s=2;"
+               "tenant@name=sampler:temperature=0.8:n=3"
+               ":prompt_med=12:prompt_max=24;"
+               "tenant@name=streamer:stream=0.5"
+               ":prompt_med=12:prompt_max=24;"
+               "tenant@name=plain:prompt_med=12:prompt_max=24")
+
+
+def test_decode_tenant_records_and_determinism():
+    """ISSUE 20: Prism decode keys in the grammar. Key-absent wire
+    discipline (only tenants that set them emit them), decode_seed
+    derived arithmetically per record (no extra rng draw — non-decode
+    tenants are untouched), the seeded stream= coin is deterministic,
+    and the spec+seed byte-identity contract holds with the new
+    keys."""
+    spec = traffic.parse_spec(DECODE_SPEC)
+    trace = traffic.generate_trace(spec, seed=6)
+    samp = [r for r in trace if r["tenant"] == "sampler"]
+    strm = [r for r in trace if r["tenant"] == "streamer"]
+    plain = [r for r in trace if r["tenant"] == "plain"]
+    assert samp and strm and plain
+    decode_keys = {"temperature", "n", "decode_seed", "stream"}
+    for r in plain:
+        assert not (decode_keys & set(r))
+    for r in samp:
+        assert r["temperature"] == 0.8 and r["n"] == 3
+        assert 0 <= r["decode_seed"] < 2 ** 31
+        assert "stream" not in r
+    # per-record arithmetic derivation: all distinct, no collisions
+    assert len({r["decode_seed"] for r in samp}) == len(samp)
+    flags = [r.get("stream", False) for r in strm]
+    assert any(flags) and not all(flags)  # the 0.5 mix actually mixes
+    assert all("decode_seed" not in r for r in strm)
+    again = traffic.trace_to_jsonl(traffic.generate_trace(spec, seed=6))
+    assert again == traffic.trace_to_jsonl(trace)
+
+
+@pytest.mark.parametrize("bad, frag", [
+    ("steady@rps=1;tenant@name=x:temperature=-0.5",
+     "temperature must be >= 0"),
+    ("steady@rps=1;tenant@name=x:n=0", "n must be >= 1"),
+    ("steady@rps=1;tenant@name=x:stream=1.5", "probability"),
+    ("steady@rps=1;tenant@name=x:stream=0.5:n=2", "n-best"),
+    ("steady@rps=1;tenant@name=x:nbest=2", "unknown"),
+])
+def test_decode_keys_reject_loudly(bad, frag):
+    with pytest.raises(ValueError, match=frag):
+        traffic.parse_spec(bad)
+
+
+def test_replay_passes_decode_kwargs_and_spares_plain_adapters():
+    """Decode-carrying records submit with decode=/stream= kwargs;
+    records without them go through the plain two-argument call, so a
+    pre-Prism ``lambda p, n`` adapter replays old traces unchanged."""
+    from pytorch_distributed_nn_tpu.serve.decoding import DecodeSpec
+
+    spec = traffic.parse_spec(DECODE_SPEC)
+    trace = traffic.generate_trace(spec, seed=6)
+    calls = []
+
+    def submit(p, n, **kw):
+        calls.append((len(p), n, kw))
+        return len(calls)
+
+    traffic.replay_trace(trace, submit, vocab_size=97, realtime=False)
+    assert len(calls) == len(trace)
+    for rec, (_, _, kw) in zip(trace, calls):
+        if rec["tenant"] == "sampler":
+            assert kw["decode"] == DecodeSpec(
+                temperature=0.8, n=3, seed=rec["decode_seed"])
+        elif rec["tenant"] == "streamer":
+            assert kw == ({"stream": True} if rec.get("stream")
+                          else {})
+        else:
+            assert kw == {}
+    # plain records never see kwargs at all: a 2-arg lambda suffices
+    plain_only = [r for r in trace if r["tenant"] == "plain"]
+    handles = traffic.replay_trace(
+        plain_only, lambda p, n: True, vocab_size=97, realtime=False)
+    assert all(handles)
+
+
+def test_trace_without_decode_keys_is_unchanged():
+    """Adding the decode grammar must not move a byte of any existing
+    spec's trace: tenants without the keys draw from the same rng
+    stream in the same order (the prefix_len precedent)."""
+    base = traffic.generate_trace(traffic.parse_spec(SPEC), seed=3)
+    assert all("decode_seed" not in r and "stream" not in r
+               for r in base)
+    # the same tenants with decode keys added produce the SAME
+    # arrival/prompt/budget skeleton — decode keys only annotate
+    decorated_spec = SPEC.replace(
+        "tenant@name=chat:weight=3",
+        "tenant@name=chat:temperature=0.7:weight=3")
+    deco = traffic.generate_trace(traffic.parse_spec(decorated_spec),
+                                  seed=3)
+    strip = {"temperature", "n", "decode_seed", "stream"}
+    assert [{k: v for k, v in r.items() if k not in strip}
+            for r in deco] == base
+
+
 # ---------------------------------------------------------------------------
 # Service model + judge
 # ---------------------------------------------------------------------------
